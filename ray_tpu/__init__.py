@@ -1,0 +1,51 @@
+"""ray_tpu — a TPU-native distributed AI framework.
+
+Capabilities of Ray (actors/tasks/object store/Train/Data/Serve/Tune),
+re-designed TPU-first: the compute plane is JAX/XLA/pjit/Pallas over ICI
+device meshes; the control plane is a resource-aware actor/task runtime.
+
+Public surface (parity with /root/reference/python/ray/__init__.py):
+    init, shutdown, remote, get, put, wait, kill, cancel, get_actor,
+    placement_group, cluster_resources, available_resources, nodes, ...
+Subpackages:
+    ray_tpu.parallel — device meshes, sharding rules, collectives
+    ray_tpu.models   — flagship model families (GPT-2, Llama, MoE, ViT)
+    ray_tpu.ops      — Pallas TPU kernels (flash/ring/paged attention)
+    ray_tpu.train    — multi-host training controller (Train-equivalent)
+    ray_tpu.data     — streaming datasets (Data-equivalent)
+    ray_tpu.serve    — continuous-batching inference (Serve-equivalent)
+    ray_tpu.tune     — experiment sweeps (Tune-equivalent)
+"""
+
+from ._version import __version__  # noqa: F401
+from .api import (  # noqa: F401
+    available_resources,
+    cancel,
+    cluster_resources,
+    get,
+    get_actor,
+    init,
+    is_initialized,
+    kill,
+    list_actors,
+    nodes,
+    placement_group,
+    put,
+    remote,
+    remove_placement_group,
+    shutdown,
+    wait,
+)
+from .core.exceptions import (  # noqa: F401
+    ActorDiedError,
+    GetTimeoutError,
+    ObjectLostError,
+    RayTpuError,
+    TaskCancelledError,
+    TaskError,
+)
+from .core.runtime import ActorHandle, ObjectRef  # noqa: F401
+from .core.scheduler import (  # noqa: F401
+    NodeAffinitySchedulingStrategy,
+    PlacementGroupSchedulingStrategy,
+)

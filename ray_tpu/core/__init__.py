@@ -1,0 +1,15 @@
+"""Core runtime: ids, object store, scheduler, actors, control store."""
+
+from . import exceptions, ids  # noqa: F401
+from .gcs import GlobalControlStore  # noqa: F401
+from .object_store import ObjectStore, Tier  # noqa: F401
+from .resources import ResourceSet, default_node_resources  # noqa: F401
+from .runtime import ActorHandle, ObjectRef, Runtime  # noqa: F401
+from .scheduler import (  # noqa: F401
+    ClusterScheduler,
+    Node,
+    NodeAffinitySchedulingStrategy,
+    PlacementGroup,
+    PlacementGroupSchedulingStrategy,
+    TaskSpec,
+)

@@ -1,0 +1,340 @@
+"""Actor runtime: lifecycle FSM, mailbox execution, restarts.
+
+Parity map into the reference (/root/reference):
+- Actor FSM REGISTERED→PENDING→ALIVE→RESTARTING→DEAD:
+  src/ray/gcs/gcs_server/gcs_actor_manager.h:328
+- Sequential method ordering per caller: core_worker/transport/
+  sequential_actor_submit_queue.h; max_concurrency via concurrency groups
+  (concurrency_group_manager.h).
+- Restart-on-death with max_restarts: gcs_actor_manager restart path.
+
+An actor here is a dedicated thread owning a Python instance; methods are
+messages on a mailbox queue. The actor's resources are held for its lifetime
+(leased from a node or a placement-group bundle). Method exceptions do NOT
+kill the actor (matching ray semantics); only kill()/creation failure do.
+"""
+
+from __future__ import annotations
+
+import enum
+import logging
+import queue
+import threading
+import traceback
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from .exceptions import ActorDiedError, TaskError
+from .ids import ActorID, ObjectID, TaskID
+from .resources import ResourceDict, ResourceSet
+from .scheduler import (
+    ClusterScheduler,
+    Node,
+    PlacementGroupSchedulingStrategy,
+    NodeAffinitySchedulingStrategy,
+)
+
+logger = logging.getLogger("ray_tpu")
+
+
+class ActorState(enum.Enum):
+    PENDING = "PENDING"
+    ALIVE = "ALIVE"
+    RESTARTING = "RESTARTING"
+    DEAD = "DEAD"
+
+
+@dataclass
+class ActorMethodCall:
+    task_id: TaskID
+    method_name: str
+    args: Tuple[Any, ...]
+    kwargs: Dict[str, Any]
+    return_ids: List[ObjectID]
+    num_returns: int = 1
+
+
+_POISON = object()
+
+
+class ActorRuntime:
+    """The server half of an actor: placement + mailbox + executor thread."""
+
+    def __init__(
+        self,
+        actor_id: ActorID,
+        cls: type,
+        init_args: Tuple[Any, ...],
+        init_kwargs: Dict[str, Any],
+        resources: ResourceDict,
+        scheduler: ClusterScheduler,
+        object_store,
+        scheduling_strategy: Any = "DEFAULT",
+        max_restarts: int = 0,
+        max_concurrency: int = 1,
+        name: str = "",
+        on_death=None,
+        registered_name: Optional[str] = None,
+        registered_namespace: str = "default",
+    ):
+        self.actor_id = actor_id
+        self.cls = cls
+        self.init_args = init_args
+        self.init_kwargs = init_kwargs
+        self.resources = dict(resources)
+        self.scheduling_strategy = scheduling_strategy
+        self.max_restarts = max_restarts
+        self.max_concurrency = max_concurrency
+        self.name = name or cls.__name__
+        self.state = ActorState.PENDING
+        self.num_restarts = 0
+        self.death_cause = ""
+        self.registered_name = registered_name
+        self.registered_namespace = registered_namespace
+        self._on_death = on_death
+
+        self._scheduler = scheduler
+        self._store = object_store
+        self._mailbox: "queue.Queue[Any]" = queue.Queue()
+        self._node: Optional[Node] = None
+        self._pool: Optional[ResourceSet] = None
+        self._instance: Any = None
+        self._lock = threading.Lock()
+        self._alive_event = threading.Event()
+        self._thread = threading.Thread(
+            target=self._lifecycle, name=f"ray_tpu-actor-{self.name}", daemon=True
+        )
+        self._thread.start()
+
+    # ---------------------------------------------------------------- placement
+
+    def _acquire_placement(self) -> bool:
+        """Block until resources are leased; returns False if impossible."""
+        strategy = self.scheduling_strategy
+        deadline_warned = False
+        while True:
+            with self._lock:
+                if self.state == ActorState.DEAD:
+                    return False
+            if isinstance(strategy, PlacementGroupSchedulingStrategy):
+                pg = strategy.placement_group
+                idx = strategy.placement_group_bundle_index
+                try:
+                    bundles = pg.bundles if idx < 0 else [pg.bundles[idx]]
+                except IndexError:
+                    self.death_cause = f"bundle index {idx} out of range"
+                    return False
+                if not any(
+                    b.reserved is not None and b.reserved.can_ever_fit(self.resources)
+                    for b in bundles
+                ):
+                    self.death_cause = (
+                        f"no bundle in placement group can ever satisfy {self.resources}"
+                    )
+                    return False
+                for bundle in bundles:
+                    if bundle.reserved is not None and bundle.reserved.try_acquire(self.resources):
+                        self._node, self._pool = bundle.node, bundle.reserved
+                        return True
+            elif isinstance(strategy, NodeAffinitySchedulingStrategy):
+                node = next(
+                    (n for n in self._scheduler.nodes() if n.node_id == strategy.node_id), None
+                )
+                if node is not None and not node.resources.can_ever_fit(self.resources):
+                    self.death_cause = (
+                        f"affinity node cannot ever satisfy {self.resources}"
+                    )
+                    return False
+                if node is not None and node.resources.try_acquire(self.resources):
+                    self._node, self._pool = node, node.resources
+                    return True
+                if node is None and not strategy.soft:
+                    self.death_cause = f"affinity node {strategy.node_id} not found"
+                    return False
+            else:
+                nodes = sorted(self._scheduler.nodes(), key=lambda n: n.utilization())
+                feasible = [n for n in nodes if n.resources.can_ever_fit(self.resources)]
+                if not feasible and nodes:
+                    self.death_cause = (
+                        f"no node can ever satisfy actor resources {self.resources}"
+                    )
+                    return False
+                for node in feasible:
+                    if node.resources.try_acquire(self.resources):
+                        self._node, self._pool = node, node.resources
+                        return True
+            if not deadline_warned:
+                deadline_warned = True
+                logger.debug("actor %s waiting for resources %s", self.name, self.resources)
+            import time
+
+            time.sleep(0.005)
+
+    # ---------------------------------------------------------------- lifecycle
+
+    def _lifecycle(self) -> None:
+        while True:
+            if not self._acquire_placement():
+                self._die(self.death_cause or "unschedulable")
+                return
+            try:
+                self._instance = self.cls(*self.init_args, **self.init_kwargs)
+            except BaseException as exc:  # noqa: BLE001
+                tb = traceback.format_exc()
+                self._die(f"__init__ raised: {exc}\n{tb}")
+                return
+            with self._lock:
+                self.state = ActorState.ALIVE
+            self._alive_event.set()
+            restart = self._serve_mailbox()
+            self._release()
+            if restart and self.num_restarts < self.max_restarts:
+                self.num_restarts += 1
+                with self._lock:
+                    self.state = ActorState.RESTARTING
+                self._alive_event.clear()
+                logger.warning(
+                    "restarting actor %s (%d/%d)", self.name, self.num_restarts, self.max_restarts
+                )
+                continue
+            if restart:
+                self._die("exceeded max_restarts")
+            return
+
+    def _serve_mailbox(self) -> bool:
+        """Process calls until poison. Returns True if death was a restartable
+        failure, False for clean termination."""
+        executor = (
+            ThreadPoolExecutor(max_workers=self.max_concurrency,
+                               thread_name_prefix=f"actor-{self.name}")
+            if self.max_concurrency > 1 else None
+        )
+        try:
+            while True:
+                msg = self._mailbox.get()
+                if msg is _POISON:
+                    return False
+                if isinstance(msg, _RestartSignal):
+                    self._fail_inflight_after_restart(msg)
+                    return True
+                if executor is not None:
+                    executor.submit(self._execute, msg)
+                else:
+                    self._execute(msg)
+        finally:
+            if executor is not None:
+                executor.shutdown(wait=True)
+
+    def _execute(self, call: ActorMethodCall) -> None:
+        try:
+            if call.method_name == "__ray_ready__":
+                result = True
+            elif call.method_name == "__ray_terminate__":
+                self._mailbox.put(_POISON)
+                result = None
+            else:
+                method = getattr(self._instance, call.method_name)
+                args = tuple(
+                    a.resolve() if getattr(a, "__ray_tpu_lazy__", False) else a
+                    for a in call.args
+                )
+                kwargs = {
+                    k: (v.resolve() if getattr(v, "__ray_tpu_lazy__", False) else v)
+                    for k, v in call.kwargs.items()
+                }
+                result = method(*args, **kwargs)
+            if call.num_returns == 1:
+                self._store.seal(call.return_ids[0], result)
+            else:
+                values = list(result)
+                if len(values) != call.num_returns:
+                    raise ValueError(
+                        f"{self.name}.{call.method_name} declared "
+                        f"num_returns={call.num_returns} but returned {len(values)} values"
+                    )
+                for oid, value in zip(call.return_ids, values):
+                    self._store.seal(oid, value)
+        except BaseException as exc:  # noqa: BLE001 - boundary
+            tb = traceback.format_exc()
+            err = TaskError(f"{self.name}.{call.method_name}", exc, tb)
+            for oid in call.return_ids:
+                self._store.seal_error(oid, err)
+
+    def _fail_inflight_after_restart(self, signal: "_RestartSignal") -> None:
+        # Drain whatever was queued before the failure; those calls fail
+        # (the reference likewise fails in-flight actor tasks on restart
+        # unless max_task_retries covers them).
+        try:
+            while True:
+                msg = self._mailbox.get_nowait()
+                if isinstance(msg, ActorMethodCall):
+                    err = ActorDiedError(self.actor_id, signal.reason)
+                    for oid in msg.return_ids:
+                        self._store.seal_error(oid, err)
+        except queue.Empty:
+            pass
+
+    def _release(self) -> None:
+        if self._pool is not None:
+            self._pool.release(self.resources)
+        self._node = None
+        self._pool = None
+        self._instance = None
+
+    def _die(self, reason: str) -> None:
+        with self._lock:
+            self.state = ActorState.DEAD
+            self.death_cause = reason
+        self._alive_event.set()  # unblock waiters; they will observe DEAD
+        if self._on_death is not None:
+            try:
+                self._on_death(self)
+            except Exception:  # noqa: BLE001 - death cleanup must not mask cause
+                pass
+        # Fail everything still queued.
+        try:
+            while True:
+                msg = self._mailbox.get_nowait()
+                if isinstance(msg, ActorMethodCall):
+                    err = ActorDiedError(self.actor_id, reason)
+                    for oid in msg.return_ids:
+                        self._store.seal_error(oid, err)
+        except queue.Empty:
+            pass
+
+    # ----------------------------------------------------------------- client
+
+    def submit(self, call: ActorMethodCall) -> None:
+        with self._lock:
+            if self.state == ActorState.DEAD:
+                err = ActorDiedError(self.actor_id, self.death_cause)
+                for oid in call.return_ids:
+                    self._store.seal_error(oid, err)
+                return
+        self._mailbox.put(call)
+
+    def kill(self, no_restart: bool = True, reason: str = "ray_tpu.kill") -> None:
+        """Simulates hard process death (reference KillActor core_worker.h:948)."""
+        if no_restart or self.num_restarts >= self.max_restarts:
+            with self._lock:
+                if self.state == ActorState.DEAD:
+                    return
+            self._die(reason)
+            self._mailbox.put(_POISON)
+        else:
+            self._mailbox.put(_RestartSignal(reason))
+
+    def terminate(self) -> None:
+        """Graceful exit: runs all queued calls, then stops."""
+        self._mailbox.put(_POISON)
+
+    def wait_alive(self, timeout: Optional[float] = None) -> bool:
+        ok = self._alive_event.wait(timeout)
+        with self._lock:
+            return ok and self.state == ActorState.ALIVE
+
+
+@dataclass
+class _RestartSignal:
+    reason: str = "injected failure"

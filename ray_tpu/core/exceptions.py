@@ -1,0 +1,78 @@
+"""Exception hierarchy for the runtime.
+
+Parity targets: RayError/RayTaskError/RayActorError/GetTimeoutError/
+ObjectLostError in the reference (/root/reference/python/ray/exceptions.py).
+"""
+
+from __future__ import annotations
+
+import traceback
+from typing import Optional
+
+
+class RayTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+class RuntimeNotInitializedError(RayTpuError):
+    pass
+
+
+class TaskError(RayTpuError):
+    """A remote task raised; re-raised at `get` with the remote traceback.
+
+    Equivalent of RayTaskError (reference python/ray/exceptions.py): the
+    original exception is chained as __cause__ so user `except` clauses on
+    the original type still work via `.cause`.
+    """
+
+    def __init__(self, function_name: str, cause: BaseException, tb: Optional[str] = None):
+        self.function_name = function_name
+        self.cause = cause
+        self.remote_traceback = tb or "".join(
+            traceback.format_exception(type(cause), cause, cause.__traceback__)
+        )
+        super().__init__(
+            f"task {function_name} failed:\n{self.remote_traceback}"
+        )
+
+
+class ActorError(RayTpuError):
+    pass
+
+
+class ActorDiedError(ActorError):
+    def __init__(self, actor_id, reason: str = "actor died"):
+        self.actor_id = actor_id
+        self.reason = reason
+        super().__init__(f"Actor {actor_id} is dead: {reason}")
+
+
+class ActorUnavailableError(ActorError):
+    pass
+
+
+class GetTimeoutError(RayTpuError, TimeoutError):
+    pass
+
+
+class ObjectLostError(RayTpuError):
+    def __init__(self, object_id, note: str = ""):
+        self.object_id = object_id
+        super().__init__(f"Object {object_id} was lost or evicted. {note}")
+
+
+class TaskCancelledError(RayTpuError):
+    pass
+
+
+class OutOfResourcesError(RayTpuError):
+    """A task requires resources no node in the cluster can ever satisfy."""
+
+
+class ObjectStoreFullError(RayTpuError):
+    pass
+
+
+class PlacementGroupUnschedulableError(RayTpuError):
+    pass

@@ -1,0 +1,115 @@
+"""Global control store: KV, pub/sub, named-actor registry, node table.
+
+Single-process equivalent of the reference GCS (/root/reference/src/ray/gcs/
+gcs_server/gcs_server.h:90 — internal KV gcs_kv_manager.h, pub/sub, node
+manager gcs_node_manager.h:49, named actors in gcs_actor_manager.h:328).
+The interface is deliberately small and async-free; a gRPC-backed
+implementation for multi-host control can replace it behind the same API.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+
+class KVStore:
+    """Namespaced key-value store (reference: gcs_kv_manager.h)."""
+
+    def __init__(self):
+        self._data: Dict[Tuple[str, str], Any] = {}
+        self._lock = threading.Lock()
+
+    def put(self, key: str, value: Any, namespace: str = "default", overwrite: bool = True) -> bool:
+        with self._lock:
+            k = (namespace, key)
+            if not overwrite and k in self._data:
+                return False
+            self._data[k] = value
+            return True
+
+    def get(self, key: str, namespace: str = "default", default: Any = None) -> Any:
+        with self._lock:
+            return self._data.get((namespace, key), default)
+
+    def delete(self, key: str, namespace: str = "default") -> bool:
+        with self._lock:
+            return self._data.pop((namespace, key), None) is not None
+
+    def keys(self, pattern: str = "*", namespace: str = "default") -> List[str]:
+        with self._lock:
+            return [k for (ns, k) in self._data if ns == namespace and fnmatch.fnmatch(k, pattern)]
+
+
+class PubSub:
+    """In-process publish/subscribe with per-channel history.
+
+    Reference: the generalized long-poll pubsub (src/ray/pubsub/) used for
+    GCS notifications and object-ref-removed messages. In-process we can use
+    direct callbacks; subscribers may also poll.
+    """
+
+    def __init__(self):
+        self._subs: Dict[str, List[Callable[[Any], None]]] = {}
+        self._history: Dict[str, List[Tuple[float, Any]]] = {}
+        self._lock = threading.Lock()
+
+    def publish(self, channel: str, message: Any) -> None:
+        with self._lock:
+            subs = list(self._subs.get(channel, ()))
+            self._history.setdefault(channel, []).append((time.time(), message))
+            hist = self._history[channel]
+            if len(hist) > 1000:
+                del hist[: len(hist) - 1000]
+        for cb in subs:
+            try:
+                cb(message)
+            except Exception:  # noqa: BLE001 - subscriber bugs must not kill publishers
+                pass
+
+    def subscribe(self, channel: str, callback: Callable[[Any], None]) -> None:
+        with self._lock:
+            self._subs.setdefault(channel, []).append(callback)
+
+    def unsubscribe(self, channel: str, callback: Callable[[Any], None]) -> None:
+        with self._lock:
+            cbs = self._subs.get(channel, [])
+            if callback in cbs:
+                cbs.remove(callback)
+
+    def poll(self, channel: str, since: float = 0.0) -> List[Tuple[float, Any]]:
+        with self._lock:
+            return [m for m in self._history.get(channel, ()) if m[0] > since]
+
+
+class GlobalControlStore:
+    """Composite control plane: KV + pubsub + registries + health."""
+
+    def __init__(self):
+        self.kv = KVStore()
+        self.pubsub = PubSub()
+        self._named_actors: Dict[Tuple[str, str], Any] = {}
+        self._lock = threading.Lock()
+
+    # Named actors (reference: gcs_actor_manager.h GetActorByName path).
+    def register_named_actor(self, name: str, handle: Any, namespace: str = "default") -> None:
+        with self._lock:
+            key = (namespace, name)
+            if key in self._named_actors:
+                raise ValueError(f"Actor name {name!r} already taken in namespace {namespace!r}")
+            self._named_actors[key] = handle
+        self.pubsub.publish("actors", {"event": "registered", "name": name})
+
+    def get_named_actor(self, name: str, namespace: str = "default") -> Optional[Any]:
+        with self._lock:
+            return self._named_actors.get((namespace, name))
+
+    def unregister_named_actor(self, name: str, namespace: str = "default") -> None:
+        with self._lock:
+            self._named_actors.pop((namespace, name), None)
+
+    def list_named_actors(self, namespace: str = "default") -> List[str]:
+        with self._lock:
+            return [n for (ns, n) in self._named_actors if ns == namespace]

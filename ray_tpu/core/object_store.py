@@ -1,0 +1,338 @@
+"""In-memory, tiered object store with spilling.
+
+Plasma-equivalent for a single host. The reference keeps one shared-memory
+store per node served from the raylet (/root/reference/src/ray/object_manager/
+plasma/store.h:55) with LRU eviction (eviction_policy.h:159) and fallback
+allocation / spilling to disk (raylet/local_object_manager.h:42). Our design
+differs deliberately:
+
+- **Device tier is first-class.** On TPU the valuable objects are jax.Arrays
+  living in HBM. Plasma assumes host shared memory; we instead keep *handles*
+  to device buffers and only materialize host copies on spill. HBM pressure
+  is XLA's job; the store tracks but does not allocate device memory.
+- **In-process by default.** Ray needs shared memory because every worker is
+  a separate OS process doing fine-grained microtasks. Our hot loop is a
+  compiled XLA program; Python-level tasks default to threads, so objects
+  pass by reference with zero copies. A native shared-memory tier
+  (ray_tpu/core/_native) backs multi-process CPU workers.
+
+Eviction: LRU over unpinned, sealed, host-tier objects; spill to a disk
+directory before dropping (reference: local_object_manager.h:112 SpillObjects).
+Entries record the creating task for lineage-based recovery
+(reference: object_recovery_manager.h:43).
+"""
+
+from __future__ import annotations
+
+import enum
+import os
+import pickle
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Callable, Dict, List, Optional
+
+from .exceptions import GetTimeoutError, ObjectLostError, TaskError
+from .ids import ObjectID
+
+
+class Tier(enum.Enum):
+    INLINE = "inline"      # small host objects, kept as-is in process
+    HOST = "host"          # large host objects (numpy etc.), spillable
+    DEVICE = "device"      # jax.Array handles (HBM); spill via host copy
+    SPILLED = "spilled"    # on disk
+
+
+_INLINE_MAX_BYTES = 100 * 1024  # mirrors reference task_transport inline cutoff
+
+
+def _estimate_nbytes(value: Any) -> int:
+    nbytes = getattr(value, "nbytes", None)
+    if isinstance(nbytes, int):
+        return nbytes
+    if isinstance(value, (bytes, bytearray)):
+        return len(value)
+    if isinstance(value, str):
+        return len(value)
+    # Cheap structural estimate; exact size does not matter for eviction
+    # decisions, only relative magnitude.
+    if isinstance(value, (list, tuple)):
+        return 64 + sum(_estimate_nbytes(v) for v in value[:100]) * max(1, len(value) // max(1, min(len(value), 100)))
+    if isinstance(value, dict):
+        items = list(value.items())[:100]
+        per = sum(_estimate_nbytes(k) + _estimate_nbytes(v) for k, v in items)
+        return 64 + per * max(1, len(value) // max(1, min(len(value), 100)))
+    return 64
+
+
+def _is_device_array(value: Any) -> bool:
+    # Duck-typed so the store never imports jax (keeps core import light).
+    t = type(value)
+    return t.__module__.startswith("jax") and t.__name__ in ("Array", "ArrayImpl")
+
+
+class ObjectState(enum.Enum):
+    PENDING = "pending"   # task not finished yet
+    READY = "ready"
+    ERROR = "error"       # creating task raised
+    LOST = "lost"         # evicted without spill, or node died
+
+
+class ObjectEntry:
+    __slots__ = (
+        "object_id", "state", "value", "error", "tier", "nbytes",
+        "pin_count", "event", "callbacks", "spill_path", "owner_task",
+        "last_access", "lock",
+    )
+
+    def __init__(self, object_id: ObjectID):
+        self.object_id = object_id
+        self.state = ObjectState.PENDING
+        self.value: Any = None
+        self.error: Optional[BaseException] = None
+        self.tier = Tier.INLINE
+        self.nbytes = 0
+        self.pin_count = 0
+        self.event = threading.Event()
+        self.callbacks: List[Callable[["ObjectEntry"], None]] = []
+        self.spill_path: Optional[str] = None
+        # TaskSpec of the creating task, for lineage reconstruction.
+        self.owner_task = None
+        self.last_access = time.monotonic()
+        # RLock: _restore (under this lock, via get) may trigger _maybe_spill
+        # which revisits the same entry.
+        self.lock = threading.RLock()
+
+
+class ObjectStore:
+    """Thread-safe object table with futures semantics and LRU spilling."""
+
+    def __init__(self, capacity_bytes: int = 8 << 30, spill_dir: Optional[str] = None):
+        self._entries: "OrderedDict[ObjectID, ObjectEntry]" = OrderedDict()
+        self._lock = threading.RLock()
+        self._capacity = capacity_bytes
+        self._host_bytes = 0
+        self._device_bytes = 0
+        self._spill_dir = spill_dir
+        self.stats = {
+            "puts": 0, "gets": 0, "spills": 0, "restores": 0, "evictions": 0,
+        }
+
+    # ------------------------------------------------------------------ write
+
+    def create(self, object_id: ObjectID, owner_task=None) -> ObjectEntry:
+        """Register a pending object (a task return slot)."""
+        with self._lock:
+            entry = self._entries.get(object_id)
+            if entry is None:
+                entry = ObjectEntry(object_id)
+                self._entries[object_id] = entry
+            entry.owner_task = owner_task
+            return entry
+
+    def put(self, object_id: ObjectID, value: Any, owner_task=None) -> ObjectEntry:
+        """Seal a value into the store (create + fulfill in one step)."""
+        entry = self.create(object_id, owner_task=owner_task)
+        self.seal(object_id, value)
+        return entry
+
+    def seal(self, object_id: ObjectID, value: Any) -> None:
+        with self._lock:
+            entry = self._entries[object_id]
+            nbytes = _estimate_nbytes(value)
+            if _is_device_array(value):
+                tier = Tier.DEVICE
+                self._device_bytes += nbytes
+            elif nbytes <= _INLINE_MAX_BYTES:
+                tier = Tier.INLINE
+                self._host_bytes += nbytes
+            else:
+                tier = Tier.HOST
+                self._host_bytes += nbytes
+            entry.value = value
+            entry.nbytes = nbytes
+            entry.tier = tier
+            entry.state = ObjectState.READY
+            entry.last_access = time.monotonic()
+            callbacks = list(entry.callbacks)
+            entry.callbacks.clear()
+        self.stats["puts"] += 1
+        entry.event.set()
+        for cb in callbacks:
+            cb(entry)
+        # Spill/evict outside the store lock: disk I/O must not block
+        # unrelated puts/gets (the reference spills asynchronously too,
+        # local_object_manager.h:112).
+        self._maybe_spill()
+
+    def seal_error(self, object_id: ObjectID, error: BaseException) -> None:
+        with self._lock:
+            entry = self._entries.get(object_id)
+            if entry is None:
+                entry = self.create(object_id)
+            entry.error = error
+            entry.state = ObjectState.ERROR
+            callbacks = list(entry.callbacks)
+            entry.callbacks.clear()
+        entry.event.set()
+        for cb in callbacks:
+            cb(entry)
+
+    # ------------------------------------------------------------------- read
+
+    def contains(self, object_id: ObjectID) -> bool:
+        with self._lock:
+            return object_id in self._entries
+
+    def entry(self, object_id: ObjectID) -> Optional[ObjectEntry]:
+        with self._lock:
+            return self._entries.get(object_id)
+
+    def is_ready(self, object_id: ObjectID) -> bool:
+        entry = self.entry(object_id)
+        return entry is not None and entry.event.is_set()
+
+    def add_ready_callback(self, object_id: ObjectID, cb: Callable[[ObjectEntry], None]) -> None:
+        """Invoke cb(entry) once the object is sealed (or errored).
+
+        Runs immediately (in the calling thread) if already sealed. This is
+        the dependency-resolution hook — the scheduler's equivalent of the
+        reference LocalDependencyResolver (core_worker/transport/
+        dependency_resolver.h:32).
+        """
+        run_now = False
+        with self._lock:
+            entry = self._entries.get(object_id)
+            if entry is None:
+                entry = self.create(object_id)
+            if entry.event.is_set():
+                run_now = True
+            else:
+                entry.callbacks.append(cb)
+        if run_now:
+            cb(entry)
+
+    def remove_ready_callback(self, object_id: ObjectID, cb: Callable[[ObjectEntry], None]) -> None:
+        with self._lock:
+            entry = self._entries.get(object_id)
+            if entry is not None and cb in entry.callbacks:
+                entry.callbacks.remove(cb)
+
+    def get(self, object_id: ObjectID, timeout: Optional[float] = None) -> Any:
+        with self._lock:
+            entry = self._entries.get(object_id)
+            if entry is None:
+                entry = self.create(object_id)
+        if not entry.event.wait(timeout):
+            raise GetTimeoutError(
+                f"Get timed out after {timeout}s waiting for {object_id}"
+            )
+        self.stats["gets"] += 1
+        if entry.state == ObjectState.ERROR:
+            raise entry.error
+        if entry.state == ObjectState.LOST:
+            raise ObjectLostError(object_id)
+        restored = False
+        with entry.lock:
+            entry.last_access = time.monotonic()
+            if entry.tier == Tier.SPILLED:
+                value = self._restore(entry)
+                restored = True
+            else:
+                value = entry.value
+        if restored:
+            # Outside entry.lock: spilling victims takes *their* entry locks,
+            # and holding one entry lock while waiting on another is an ABBA
+            # deadlock between two concurrent restores.
+            self._maybe_spill()
+        return value
+
+    # ------------------------------------------------------------ ref counting
+
+    def pin(self, object_id: ObjectID) -> None:
+        entry = self.entry(object_id)
+        if entry is not None:
+            with entry.lock:
+                entry.pin_count += 1
+
+    def unpin(self, object_id: ObjectID) -> None:
+        entry = self.entry(object_id)
+        if entry is not None:
+            with entry.lock:
+                entry.pin_count = max(0, entry.pin_count - 1)
+
+    def free(self, object_id: ObjectID) -> None:
+        with self._lock:
+            entry = self._entries.pop(object_id, None)
+            if entry is not None and entry.state == ObjectState.READY:
+                if entry.tier == Tier.DEVICE:
+                    self._device_bytes -= entry.nbytes
+                elif entry.tier in (Tier.INLINE, Tier.HOST):
+                    self._host_bytes -= entry.nbytes
+                if entry.spill_path and os.path.exists(entry.spill_path):
+                    os.unlink(entry.spill_path)
+
+    # -------------------------------------------------------------- spill/LRU
+
+    def _maybe_spill(self) -> None:
+        with self._lock:
+            if self._host_bytes <= self._capacity:
+                return
+            # LRU over unpinned host-tier entries (victim selection only;
+            # the I/O happens per-entry outside the store lock).
+            candidates = sorted(
+                (e for e in self._entries.values()
+                 if e.state == ObjectState.READY and e.tier == Tier.HOST
+                 and e.pin_count == 0),
+                key=lambda e: e.last_access,
+            )
+        for entry in candidates:
+            with self._lock:
+                if self._host_bytes <= self._capacity:
+                    break
+            with entry.lock:
+                if entry.tier != Tier.HOST or entry.pin_count > 0:
+                    continue
+                if self._spill_dir is not None:
+                    self._spill(entry)
+                else:
+                    entry.value = None
+                    entry.state = ObjectState.LOST
+                    with self._lock:
+                        self._host_bytes -= entry.nbytes
+                    self.stats["evictions"] += 1
+
+    def _spill(self, entry: ObjectEntry) -> None:
+        """Write one entry to disk. Caller holds entry.lock (NOT the store
+        lock) — only access to this object blocks on the disk write."""
+        os.makedirs(self._spill_dir, exist_ok=True)
+        path = os.path.join(self._spill_dir, entry.object_id.hex())
+        with open(path, "wb") as f:
+            pickle.dump(entry.value, f, protocol=pickle.HIGHEST_PROTOCOL)
+        entry.spill_path = path
+        entry.value = None
+        entry.tier = Tier.SPILLED
+        with self._lock:
+            self._host_bytes -= entry.nbytes
+        self.stats["spills"] += 1
+
+    def _restore(self, entry: ObjectEntry) -> Any:
+        with open(entry.spill_path, "rb") as f:
+            value = pickle.load(f)
+        entry.value = value
+        entry.tier = Tier.HOST
+        with self._lock:
+            self._host_bytes += entry.nbytes
+        self.stats["restores"] += 1
+        return value
+
+    # ------------------------------------------------------------------ intro
+
+    def usage(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "host_bytes": self._host_bytes,
+                "device_bytes": self._device_bytes,
+                "capacity_bytes": self._capacity,
+                "num_objects": len(self._entries),
+            }

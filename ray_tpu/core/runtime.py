@@ -1,0 +1,431 @@
+"""The per-process runtime: object refs, task submission, actor management.
+
+This is the equivalent of the reference's CoreWorker + driver singleton
+(/root/reference/src/ray/core_worker/core_worker.h:166 and
+python/ray/_private/worker.py:426): it owns the object store, the scheduler,
+the control store, and the actor registry, and implements put/get/wait/
+submit_task/create_actor on top of them.
+"""
+
+from __future__ import annotations
+
+import atexit
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+from .actors import ActorMethodCall, ActorRuntime, ActorState
+from .exceptions import GetTimeoutError, RuntimeNotInitializedError
+from .gcs import GlobalControlStore
+from .ids import ActorID, JobID, NodeID, ObjectID, TaskID
+from .object_store import ObjectStore
+from .resources import ResourceDict, default_node_resources
+from .scheduler import ClusterScheduler, Node, PlacementGroup, TaskSpec
+
+
+class ObjectRef:
+    """A future handle to an object in the store (reference: ObjectRef in
+    python/ray/_raylet.pyx; ownership semantics reference_count.h:72)."""
+
+    __slots__ = ("object_id", "_runtime")
+
+    def __init__(self, object_id: ObjectID, runtime: "Runtime"):
+        self.object_id = object_id
+        self._runtime = runtime
+
+    def hex(self) -> str:
+        return self.object_id.hex()
+
+    def is_ready(self) -> bool:
+        return self._runtime.object_store.is_ready(self.object_id)
+
+    def task_id(self) -> TaskID:
+        return self.object_id.task_id()
+
+    def __hash__(self):
+        return hash(self.object_id)
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other.object_id == self.object_id
+
+    def __repr__(self):
+        return f"ObjectRef({self.object_id.hex()})"
+
+    def __reduce__(self):
+        # Refs may be passed through pickled task args between processes of
+        # the same runtime; they rebind to the active runtime on unpickle.
+        return (_rebind_object_ref, (self.object_id.hex(),))
+
+
+def _rebind_object_ref(hex_id: str) -> "ObjectRef":
+    rt = get_runtime()
+    return ObjectRef(ObjectID(hex_id), rt)
+
+
+class Runtime:
+    """A single in-process 'cluster': nodes, scheduler, store, control plane."""
+
+    def __init__(
+        self,
+        num_cpus: Optional[int] = None,
+        num_tpus: Optional[int] = None,
+        resources: Optional[ResourceDict] = None,
+        num_nodes: int = 1,
+        object_store_capacity: int = 8 << 30,
+        spill_dir: Optional[str] = None,
+        detect_accelerators: bool = True,
+    ):
+        self.job_id = JobID.next()
+        self.gcs = GlobalControlStore()
+        self.object_store = ObjectStore(object_store_capacity, spill_dir=spill_dir)
+        self.scheduler = ClusterScheduler(self.object_store, self._on_task_done)
+        self._actors: Dict[ActorID, ActorRuntime] = {}
+        self._lock = threading.Lock()
+        self._task_events: List[Dict[str, Any]] = []
+        node_res = default_node_resources(
+            num_cpus=num_cpus, num_tpus=num_tpus, resources=resources,
+            detect_accelerators=detect_accelerators,
+        )
+        for i in range(num_nodes):
+            self.scheduler.add_node(
+                Node(NodeID.from_random(), dict(node_res), is_head=(i == 0))
+            )
+
+    # ------------------------------------------------------------------ store
+
+    def put(self, value: Any) -> ObjectRef:
+        oid = ObjectID.for_put(self.job_id)
+        self.object_store.put(oid, value)
+        return ObjectRef(oid, self)
+
+    def get(
+        self,
+        refs: Union[ObjectRef, Sequence[ObjectRef]],
+        timeout: Optional[float] = None,
+    ) -> Any:
+        if isinstance(refs, ObjectRef):
+            return self.object_store.get(refs.object_id, timeout)
+        deadline = None if timeout is None else time.monotonic() + timeout
+        out = []
+        for ref in refs:
+            remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
+            out.append(self.object_store.get(ref.object_id, remaining))
+        return out
+
+    def wait(
+        self,
+        refs: Sequence[ObjectRef],
+        num_returns: int = 1,
+        timeout: Optional[float] = None,
+    ) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+        if num_returns > len(refs):
+            raise ValueError("num_returns exceeds number of refs")
+        done_event = threading.Event()
+        ready_count = [0]
+        lock = threading.Lock()
+
+        def _cb(_entry):
+            with lock:
+                ready_count[0] += 1
+                if ready_count[0] >= num_returns:
+                    done_event.set()
+
+        for ref in refs:
+            self.object_store.add_ready_callback(ref.object_id, _cb)
+        done_event.wait(timeout)
+        for ref in refs:
+            self.object_store.remove_ready_callback(ref.object_id, _cb)
+        ready = [r for r in refs if self.object_store.is_ready(r.object_id)]
+        not_ready = [r for r in refs if not self.object_store.is_ready(r.object_id)]
+        # ray.wait contract: at most num_returns refs in the ready list;
+        # surplus ready refs stay in the second list, order preserved.
+        surplus = ready[num_returns:]
+        return ready[:num_returns], [r for r in refs if r in surplus or r in not_ready]
+
+    # ------------------------------------------------------------------ tasks
+
+    def submit_task(
+        self,
+        func,
+        args: Tuple[Any, ...],
+        kwargs: Dict[str, Any],
+        name: str = "",
+        num_returns: int = 1,
+        resources: Optional[ResourceDict] = None,
+        max_retries: int = 0,
+        retry_exceptions: Any = False,
+        scheduling_strategy: Any = "DEFAULT",
+    ) -> Union[ObjectRef, List[ObjectRef]]:
+        task_id = TaskID.of(self.job_id)
+        return_ids = [ObjectID.for_task_return(task_id, i) for i in range(num_returns)]
+        spec = TaskSpec(
+            task_id=task_id,
+            name=name or getattr(func, "__name__", "task"),
+            func=func,
+            args=args,
+            kwargs=kwargs,
+            num_returns=num_returns,
+            resources=dict(resources or {"CPU": 1.0}),
+            max_retries=max_retries,
+            retry_exceptions=retry_exceptions,
+            scheduling_strategy=scheduling_strategy,
+            return_ids=return_ids,
+        )
+        for oid in return_ids:
+            self.object_store.create(oid, owner_task=spec)
+        self.scheduler.submit(spec)
+        refs = [ObjectRef(oid, self) for oid in return_ids]
+        return refs[0] if num_returns == 1 else refs
+
+    def cancel(self, ref: ObjectRef) -> bool:
+        return self.scheduler.cancel(ref.object_id.task_id())
+
+    def _on_task_done(self, spec: TaskSpec, error: Optional[BaseException]) -> None:
+        self._task_events.append(
+            {
+                "task_id": spec.task_id.hex(),
+                "name": spec.name,
+                "ok": error is None,
+                "attempt": spec.attempt,
+                "ts": time.time(),
+            }
+        )
+        if len(self._task_events) > 100_000:
+            del self._task_events[:50_000]
+
+    # ----------------------------------------------------------------- actors
+
+    def create_actor(
+        self,
+        cls: type,
+        args: Tuple[Any, ...],
+        kwargs: Dict[str, Any],
+        resources: Optional[ResourceDict] = None,
+        max_restarts: int = 0,
+        max_concurrency: int = 1,
+        name: Optional[str] = None,
+        namespace: str = "default",
+        scheduling_strategy: Any = "DEFAULT",
+        lifetime: Optional[str] = None,
+    ) -> "ActorHandle":
+        actor_id = ActorID.of(self.job_id)
+        handle = ActorHandle(actor_id, self)
+        # Reserve the name BEFORE spawning the actor so a duplicate name
+        # raises without leaking a live, resource-holding actor.
+        if name:
+            self.gcs.register_named_actor(name, handle, namespace=namespace)
+        def _on_death(rt: ActorRuntime) -> None:
+            # Release the name when the actor dies on its own (init failure,
+            # unschedulable, restarts exhausted) — not just on explicit kill.
+            if rt.registered_name:
+                self.gcs.unregister_named_actor(rt.registered_name, rt.registered_namespace)
+
+        try:
+            runtime = ActorRuntime(
+                actor_id=actor_id,
+                cls=cls,
+                init_args=args,
+                init_kwargs=kwargs,
+                resources=dict(resources or {"CPU": 1.0}),
+                scheduler=self.scheduler,
+                object_store=self.object_store,
+                scheduling_strategy=scheduling_strategy,
+                max_restarts=max_restarts,
+                max_concurrency=max_concurrency,
+                name=name or cls.__name__,
+                on_death=_on_death,
+                registered_name=name,
+                registered_namespace=namespace,
+            )
+        except BaseException:
+            if name:
+                self.gcs.unregister_named_actor(name, namespace=namespace)
+            raise
+        with self._lock:
+            self._actors[actor_id] = runtime
+        return handle
+
+    def actor_runtime(self, actor_id: ActorID) -> ActorRuntime:
+        with self._lock:
+            return self._actors[actor_id]
+
+    def submit_actor_task(
+        self,
+        actor_id: ActorID,
+        method_name: str,
+        args: Tuple[Any, ...],
+        kwargs: Dict[str, Any],
+        num_returns: int = 1,
+    ) -> Union[ObjectRef, List[ObjectRef]]:
+        task_id = TaskID.of(self.job_id)
+        return_ids = [ObjectID.for_task_return(task_id, i) for i in range(num_returns)]
+        for oid in return_ids:
+            self.object_store.create(oid)
+        call = ActorMethodCall(
+            task_id=task_id,
+            method_name=method_name,
+            args=self._materialize_args(args),
+            kwargs=self._materialize_kwargs(kwargs),
+            return_ids=return_ids,
+            num_returns=num_returns,
+        )
+        self.actor_runtime(actor_id).submit(call)
+        refs = [ObjectRef(oid, self) for oid in return_ids]
+        return refs[0] if num_returns == 1 else refs
+
+    def _materialize_args(self, args):
+        # Actor calls resolve ObjectRef args lazily inside the actor thread to
+        # preserve submission ordering; we wrap them so the executor resolves.
+        return tuple(_LazyRef(a.object_id, self) if isinstance(a, ObjectRef) else a for a in args)
+
+    def _materialize_kwargs(self, kwargs):
+        return {
+            k: _LazyRef(v.object_id, self) if isinstance(v, ObjectRef) else v
+            for k, v in kwargs.items()
+        }
+
+    def kill_actor(self, handle: "ActorHandle", no_restart: bool = True) -> None:
+        rt = self.actor_runtime(handle._actor_id)
+        rt.kill(no_restart=no_restart)
+        if no_restart and getattr(rt, "registered_name", None):
+            self.gcs.unregister_named_actor(rt.registered_name, rt.registered_namespace)
+
+    def get_actor(self, name: str, namespace: str = "default") -> "ActorHandle":
+        handle = self.gcs.get_named_actor(name, namespace)
+        if handle is None:
+            raise ValueError(f"No actor named {name!r} in namespace {namespace!r}")
+        return handle
+
+    def list_actors(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [
+                {
+                    "actor_id": aid.hex(),
+                    "name": rt.name,
+                    "state": rt.state.value,
+                    "restarts": rt.num_restarts,
+                }
+                for aid, rt in self._actors.items()
+            ]
+
+    # ------------------------------------------------------------- placement
+
+    def create_placement_group(self, bundles, strategy="PACK", name="") -> PlacementGroup:
+        return self.scheduler.create_placement_group(bundles, strategy, name)
+
+    def remove_placement_group(self, pg: PlacementGroup) -> None:
+        self.scheduler.remove_placement_group(pg)
+
+    # ---------------------------------------------------------------- cluster
+
+    def cluster_resources(self) -> ResourceDict:
+        return self.scheduler.cluster_resources()
+
+    def available_resources(self) -> ResourceDict:
+        return self.scheduler.available_resources()
+
+    def task_events(self) -> List[Dict[str, Any]]:
+        return list(self._task_events)
+
+    def shutdown(self) -> None:
+        with self._lock:
+            actors = list(self._actors.values())
+        for rt in actors:
+            rt.kill(no_restart=True, reason="runtime shutdown")
+        self.scheduler.shutdown()
+
+
+class _LazyRef:
+    """Marker for an ObjectRef arg of an actor call, resolved at execution."""
+
+    __slots__ = ("object_id", "_runtime")
+    __ray_tpu_lazy__ = True
+
+    def __init__(self, object_id: ObjectID, runtime: Runtime):
+        self.object_id = object_id
+        self._runtime = runtime
+
+    def resolve(self):
+        return self._runtime.object_store.get(self.object_id)
+
+
+class ActorHandle:
+    """Client-side handle; `handle.method.remote(...)` submits a mailbox call
+    (reference: python/ray/actor.py ActorHandle/ActorMethod)."""
+
+    def __init__(self, actor_id: ActorID, runtime: Runtime):
+        self._actor_id = actor_id
+        self._runtime = runtime
+
+    def __getattr__(self, item: str) -> "ActorMethod":
+        if item.startswith("_"):
+            raise AttributeError(item)
+        return ActorMethod(self, item)
+
+    @property
+    def __ray_ready__(self) -> "ActorMethod":
+        return ActorMethod(self, "__ray_ready__")
+
+    def state(self) -> ActorState:
+        return self._runtime.actor_runtime(self._actor_id).state
+
+    def __repr__(self):
+        return f"ActorHandle({self._actor_id.hex()[:12]})"
+
+
+class ActorMethod:
+    def __init__(self, handle: ActorHandle, name: str, num_returns: int = 1):
+        self._handle = handle
+        self._name = name
+        self._num_returns = num_returns
+
+    def options(self, num_returns: int = 1) -> "ActorMethod":
+        return ActorMethod(self._handle, self._name, num_returns)
+
+    def remote(self, *args, **kwargs):
+        return self._handle._runtime.submit_actor_task(
+            self._handle._actor_id, self._name, args, kwargs, self._num_returns
+        )
+
+
+# --------------------------------------------------------------------- globals
+
+_global_runtime: Optional[Runtime] = None
+_global_lock = threading.Lock()
+
+
+def init_runtime(**kwargs) -> Runtime:
+    global _global_runtime
+    with _global_lock:
+        if _global_runtime is None:
+            _global_runtime = Runtime(**kwargs)
+        return _global_runtime
+
+
+def get_runtime() -> Runtime:
+    if _global_runtime is None:
+        raise RuntimeNotInitializedError(
+            "ray_tpu.init() has not been called (and auto-init is disabled here)"
+        )
+    return _global_runtime
+
+
+def get_or_init_runtime() -> Runtime:
+    if _global_runtime is None:
+        return init_runtime()
+    return _global_runtime
+
+
+def is_initialized() -> bool:
+    return _global_runtime is not None
+
+
+def shutdown_runtime() -> None:
+    global _global_runtime
+    with _global_lock:
+        if _global_runtime is not None:
+            _global_runtime.shutdown()
+            _global_runtime = None
+
+
+atexit.register(shutdown_runtime)

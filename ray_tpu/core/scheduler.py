@@ -1,0 +1,586 @@
+"""Task specs, logical nodes, placement groups and the cluster scheduler.
+
+Parity map into the reference (/root/reference):
+- TaskSpec                  ~ src/ray/common/task/task_spec.h:257
+- Node                      ~ one raylet's resource view (raylet/node_manager.h:122)
+- ClusterScheduler          ~ ClusterTaskManager + LocalTaskManager
+                              (raylet/scheduling/cluster_task_manager.h:44,
+                               raylet/local_task_manager.h:65)
+- hybrid policy             ~ scheduling/policy/hybrid_scheduling_policy.h:50
+- PlacementGroup            ~ common/bundle_spec.h + gcs_placement_group_mgr.h:232
+
+Design inversion for TPU: the reference runs one scheduler *per node* plus a
+cluster view, because tasks are microsecond-scale and must dispatch without a
+round-trip. Our unit of work is either (a) a long-running SPMD program on a
+slice — gang-scheduled via `TPU-*-head` resources and placement groups — or
+(b) CPU-side data/control tasks where millisecond dispatch is fine. So a
+single in-process cluster scheduler with per-node resource accounting is the
+honest design; "nodes" are logical (same pattern the reference uses for
+multi-node tests: python/ray/cluster_utils.py:135 starts N raylets on one
+machine).
+
+Workers are threads by default. A task occupying resources gets a dedicated
+thread (the reference similarly dedicates a leased worker *process* per
+running task, worker_pool.h:228); blocking `get` inside a task therefore
+cannot deadlock the pool.
+"""
+
+from __future__ import annotations
+
+import enum
+import itertools
+import logging
+import threading
+import time
+import traceback
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from .exceptions import (
+    OutOfResourcesError,
+    PlacementGroupUnschedulableError,
+    TaskCancelledError,
+    TaskError,
+)
+from .ids import NodeID, ObjectID, PlacementGroupID, TaskID
+from .resources import ResourceDict, ResourceSet
+
+logger = logging.getLogger("ray_tpu")
+
+
+# --------------------------------------------------------------------------- spec
+
+
+class SchedulingStrategy:
+    """Base marker. String forms: "DEFAULT" (hybrid pack/spread), "SPREAD"."""
+
+
+@dataclass
+class NodeAffinitySchedulingStrategy(SchedulingStrategy):
+    """Pin to a node (reference util/scheduling_strategies.py:41)."""
+
+    node_id: NodeID
+    soft: bool = False
+
+
+@dataclass
+class PlacementGroupSchedulingStrategy(SchedulingStrategy):
+    """Schedule into a reserved bundle (reference util/scheduling_strategies.py:15)."""
+
+    placement_group: "PlacementGroup"
+    placement_group_bundle_index: int = -1
+
+
+@dataclass
+class TaskSpec:
+    task_id: TaskID
+    name: str
+    func: Callable[..., Any]
+    args: Tuple[Any, ...]
+    kwargs: Dict[str, Any]
+    num_returns: int = 1
+    resources: ResourceDict = field(default_factory=dict)
+    max_retries: int = 0
+    retry_exceptions: bool = False
+    scheduling_strategy: Any = "DEFAULT"
+    actor: Any = None  # set for actor method tasks; bypasses node selection
+    return_ids: List[ObjectID] = field(default_factory=list)
+    # internal
+    attempt: int = 0
+    cancelled: bool = False
+    submitted_at: float = field(default_factory=time.monotonic)
+
+
+# --------------------------------------------------------------------------- node
+
+
+class Node:
+    """A logical host with its own resource pool."""
+
+    def __init__(self, node_id: NodeID, resources: ResourceDict, is_head: bool = False,
+                 labels: Optional[Dict[str, str]] = None):
+        self.node_id = node_id
+        self.resources = ResourceSet(resources)
+        self.is_head = is_head
+        self.alive = True
+        self.labels = labels or {}
+        self.running_tasks: Dict[TaskID, TaskSpec] = {}
+        self._lock = threading.Lock()
+
+    def utilization(self) -> float:
+        total = self.resources.total
+        avail = self.resources.available()
+        fracs = [
+            1.0 - avail.get(k, 0.0) / v for k, v in total.items() if v > 0
+        ]
+        return max(fracs) if fracs else 0.0
+
+    def __repr__(self):
+        return f"Node({self.node_id.hex()[:8]}, head={self.is_head})"
+
+
+# ------------------------------------------------------------------ placement grp
+
+
+class PlacementStrategy(enum.Enum):
+    PACK = "PACK"
+    SPREAD = "SPREAD"
+    STRICT_PACK = "STRICT_PACK"
+    STRICT_SPREAD = "STRICT_SPREAD"
+
+
+@dataclass
+class Bundle:
+    index: int
+    resources: ResourceDict
+    node: Optional[Node] = None
+    reserved: ResourceSet = None  # type: ignore[assignment]
+
+
+class PlacementGroup:
+    """A gang reservation of resource bundles across nodes.
+
+    The reference reserves bundles through a 2-phase commit from the GCS
+    (gcs_placement_group_scheduler.h:288). In-process we reserve atomically
+    under the scheduler lock; the observable semantics (all-or-nothing,
+    strategy-constrained spread) match.
+    """
+
+    def __init__(self, pg_id: PlacementGroupID, bundles: List[Bundle],
+                 strategy: PlacementStrategy, name: str = ""):
+        self.id = pg_id
+        self.bundles = bundles
+        self.strategy = strategy
+        self.name = name
+        self.created = threading.Event()
+        self.removed = False
+
+    def ready(self, timeout: Optional[float] = None) -> bool:
+        return self.created.wait(timeout)
+
+    @property
+    def bundle_specs(self) -> List[ResourceDict]:
+        return [dict(b.resources) for b in self.bundles]
+
+
+# ---------------------------------------------------------------------- scheduler
+
+
+class ClusterScheduler:
+    """Resource-aware dispatcher over logical nodes.
+
+    Policy (reference hybrid_scheduling_policy.h:50): prefer packing onto
+    already-utilized feasible nodes until a utilization threshold, then
+    spread to the least-utilized feasible node. "SPREAD" always picks the
+    least-utilized feasible node.
+    """
+
+    HYBRID_THRESHOLD = 0.5
+
+    def __init__(self, object_store, on_task_done: Callable[[TaskSpec, Optional[BaseException]], None]):
+        self._store = object_store
+        self._nodes: Dict[NodeID, Node] = {}
+        self._pending: deque[TaskSpec] = deque()
+        self._blocked: Dict[TaskID, Tuple[TaskSpec, set]] = {}
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._shutdown = False
+        self._on_task_done = on_task_done
+        self._placement_groups: Dict[PlacementGroupID, PlacementGroup] = {}
+        self._dispatch_thread = threading.Thread(
+            target=self._dispatch_loop, name="ray_tpu-scheduler", daemon=True
+        )
+        self._dispatch_thread.start()
+        self.stats = {"dispatched": 0, "retries": 0, "spillbacks": 0}
+
+    # -------------------------------------------------------------- membership
+
+    def add_node(self, node: Node) -> None:
+        with self._lock:
+            self._nodes[node.node_id] = node
+        self._wake.set()
+
+    def remove_node(self, node_id: NodeID) -> Optional[Node]:
+        with self._lock:
+            node = self._nodes.pop(node_id, None)
+            if node is not None:
+                node.alive = False
+        self._wake.set()
+        return node
+
+    def nodes(self) -> List[Node]:
+        with self._lock:
+            return list(self._nodes.values())
+
+    def head_node(self) -> Node:
+        with self._lock:
+            for n in self._nodes.values():
+                if n.is_head:
+                    return n
+            return next(iter(self._nodes.values()))
+
+    def cluster_resources(self) -> ResourceDict:
+        out: ResourceDict = {}
+        for n in self.nodes():
+            for k, v in n.resources.total.items():
+                out[k] = out.get(k, 0.0) + v
+        return out
+
+    def available_resources(self) -> ResourceDict:
+        out: ResourceDict = {}
+        for n in self.nodes():
+            for k, v in n.resources.available().items():
+                out[k] = out.get(k, 0.0) + v
+        return out
+
+    # -------------------------------------------------------------- submission
+
+    def submit(self, spec: TaskSpec) -> None:
+        """Queue a task; it dispatches once its ObjectID args are ready."""
+        deps = _collect_dependencies(spec.args, spec.kwargs)
+        unresolved = {d for d in deps if not self._store.is_ready(d)}
+        if unresolved:
+            with self._lock:
+                self._blocked[spec.task_id] = (spec, unresolved)
+            for dep in list(unresolved):
+                self._store.add_ready_callback(dep, self._make_dep_callback(spec.task_id, dep))
+        else:
+            with self._lock:
+                self._pending.append(spec)
+            self._wake.set()
+
+    def _make_dep_callback(self, task_id: TaskID, dep: ObjectID):
+        def _cb(_entry):
+            with self._lock:
+                item = self._blocked.get(task_id)
+                if item is None:
+                    return
+                spec, unresolved = item
+                unresolved.discard(dep)
+                if not unresolved:
+                    del self._blocked[task_id]
+                    self._pending.append(spec)
+                    self._wake.set()
+        return _cb
+
+    def cancel(self, task_id: TaskID) -> bool:
+        """Cancel a queued task. Running tasks cannot be preempted (threads);
+        the reference interrupts worker processes (CancelTask
+        core_worker.h:956) — with thread workers we mark-and-check instead."""
+        to_fail = None
+        with self._lock:
+            item = self._blocked.pop(task_id, None)
+            if item is not None:
+                item[0].cancelled = True
+                to_fail = item[0]
+            else:
+                for spec in self._pending:
+                    if spec.task_id == task_id:
+                        spec.cancelled = True
+                        return True
+        if to_fail is not None:
+            # Outside the lock: seal_error runs dependency callbacks inline,
+            # which re-enter the scheduler.
+            self._fail_returns(to_fail, TaskCancelledError(f"task {task_id} cancelled"))
+            return True
+        return False
+
+    # ---------------------------------------------------------- placement grps
+
+    def create_placement_group(
+        self, bundles: Sequence[ResourceDict], strategy: str = "PACK", name: str = ""
+    ) -> PlacementGroup:
+        strat = PlacementStrategy(strategy)
+        pg = PlacementGroup(
+            PlacementGroupID.from_random(),
+            [Bundle(i, dict(r)) for i, r in enumerate(bundles)],
+            strat,
+            name,
+        )
+        with self._lock:
+            placement = self._plan_placement_locked(pg)
+            if placement is None:
+                raise PlacementGroupUnschedulableError(
+                    f"Cannot fit bundles {list(bundles)} with strategy {strategy} "
+                    f"on nodes {[n.resources.total for n in self._nodes.values()]}"
+                )
+            acquired: List[Tuple[Node, ResourceDict]] = []
+            for bundle, node in zip(pg.bundles, placement):
+                if not node.resources.try_acquire(bundle.resources):
+                    # Roll back earlier bundles: reservation is all-or-nothing
+                    # (the reference's 2-phase commit guarantees the same,
+                    # gcs_placement_group_scheduler.h:288).
+                    for prev_node, prev_res in acquired:
+                        prev_node.resources.release(prev_res)
+                    raise PlacementGroupUnschedulableError("concurrent reservation lost")
+                acquired.append((node, bundle.resources))
+                bundle.node = node
+                bundle.reserved = ResourceSet(bundle.resources)
+            self._placement_groups[pg.id] = pg
+        pg.created.set()
+        return pg
+
+    def _plan_placement_locked(self, pg: PlacementGroup) -> Optional[List[Node]]:
+        nodes = [n for n in self._nodes.values() if n.alive]
+        if not nodes:
+            return None
+        strat = pg.strategy
+
+        def fits(node: Node, req: ResourceDict, committed: Dict[NodeID, ResourceDict]) -> bool:
+            avail = node.resources.available()
+            extra = committed.get(node.node_id, {})
+            return all(avail.get(k, 0.0) - extra.get(k, 0.0) >= v - 1e-9 for k, v in req.items())
+
+        def commit(committed, node, req):
+            slot = committed.setdefault(node.node_id, {})
+            for k, v in req.items():
+                slot[k] = slot.get(k, 0.0) + v
+
+        committed: Dict[NodeID, ResourceDict] = {}
+        placement: List[Node] = []
+        if strat in (PlacementStrategy.PACK, PlacementStrategy.STRICT_PACK):
+            order = sorted(nodes, key=lambda n: -n.utilization())
+            for bundle in pg.bundles:
+                chosen = None
+                candidates = placement[:1] if (strat == PlacementStrategy.STRICT_PACK and placement) else order
+                for node in candidates:
+                    if fits(node, bundle.resources, committed):
+                        chosen = node
+                        break
+                if chosen is None and strat == PlacementStrategy.PACK:
+                    for node in order:
+                        if fits(node, bundle.resources, committed):
+                            chosen = node
+                            break
+                if chosen is None:
+                    return None
+                commit(committed, chosen, bundle.resources)
+                placement.append(chosen)
+        else:  # SPREAD / STRICT_SPREAD
+            used: set = set()
+            for bundle in pg.bundles:
+                candidates = sorted(nodes, key=lambda n: (n.node_id in used, n.utilization()))
+                chosen = None
+                for node in candidates:
+                    if strat == PlacementStrategy.STRICT_SPREAD and node.node_id in used:
+                        continue
+                    if fits(node, bundle.resources, committed):
+                        chosen = node
+                        break
+                if chosen is None:
+                    return None
+                used.add(chosen.node_id)
+                commit(committed, chosen, bundle.resources)
+                placement.append(chosen)
+        return placement
+
+    def remove_placement_group(self, pg: PlacementGroup) -> None:
+        with self._lock:
+            self._placement_groups.pop(pg.id, None)
+            pg.removed = True
+            for bundle in pg.bundles:
+                if bundle.node is not None:
+                    bundle.node.resources.release(bundle.resources)
+
+    # ----------------------------------------------------------- dispatch loop
+
+    def _dispatch_loop(self) -> None:
+        while not self._shutdown:
+            self._wake.wait(timeout=0.05)
+            self._wake.clear()
+            self._drain_pending()
+
+    def _drain_pending(self) -> None:
+        deferred: List[TaskSpec] = []
+        while True:
+            with self._lock:
+                if not self._pending:
+                    break
+                spec = self._pending.popleft()
+            if spec.cancelled:
+                self._fail_returns(spec, TaskCancelledError(f"task {spec.task_id} cancelled"))
+                continue
+            try:
+                placed = self._try_dispatch(spec)
+            except BaseException as exc:  # noqa: BLE001 - the dispatch loop must survive
+                logger.exception("dispatch of %s failed", spec.name)
+                self._fail_returns(spec, TaskError(spec.name, exc))
+                continue
+            if not placed:
+                deferred.append(spec)
+        if deferred:
+            with self._lock:
+                self._pending.extendleft(reversed(deferred))
+
+    def _try_dispatch(self, spec: TaskSpec) -> bool:
+        target: Optional[Node] = None
+        pool: Optional[ResourceSet] = None
+
+        strategy = spec.scheduling_strategy
+        if isinstance(strategy, PlacementGroupSchedulingStrategy):
+            pg = strategy.placement_group
+            idx = strategy.placement_group_bundle_index
+            bundles = pg.bundles if idx < 0 else [pg.bundles[idx]]
+            for bundle in bundles:
+                if bundle.reserved is not None and bundle.reserved.try_acquire(spec.resources):
+                    target, pool = bundle.node, bundle.reserved
+                    break
+            if target is None:
+                return False
+        elif isinstance(strategy, NodeAffinitySchedulingStrategy):
+            with self._lock:
+                node = self._nodes.get(strategy.node_id)
+            if node is None or not node.alive:
+                if not strategy.soft:
+                    self._fail_returns(
+                        spec, OutOfResourcesError(f"node {strategy.node_id} not available")
+                    )
+                    return True
+            elif not strategy.soft and not node.resources.can_ever_fit(spec.resources):
+                self._fail_returns(
+                    spec,
+                    OutOfResourcesError(
+                        f"Task {spec.name} pinned to a node that can never satisfy "
+                        f"{spec.resources} (node total: {node.resources.total})"
+                    ),
+                )
+                return True
+            elif node.resources.try_acquire(spec.resources):
+                target, pool = node, node.resources
+            if target is None and not strategy.soft:
+                return False
+            if target is None:
+                target = self._pick_node(spec)
+                if target is None:
+                    return False
+                if not target.resources.try_acquire(spec.resources):
+                    return False
+                pool = target.resources
+        else:
+            node = self._pick_node(spec)
+            if node is None:
+                feasible = any(
+                    n.resources.can_ever_fit(spec.resources) for n in self.nodes()
+                )
+                if not feasible and self.nodes():
+                    self._fail_returns(
+                        spec,
+                        OutOfResourcesError(
+                            f"Task {spec.name} requires {spec.resources} which no node "
+                            f"can ever satisfy (cluster: {self.cluster_resources()})"
+                        ),
+                    )
+                    return True
+                return False
+            if not node.resources.try_acquire(spec.resources):
+                return False
+            target, pool = node, node.resources
+
+        self.stats["dispatched"] += 1
+        with target._lock:
+            target.running_tasks[spec.task_id] = spec
+        thread = threading.Thread(
+            target=self._run_task,
+            args=(spec, target, pool),
+            name=f"ray_tpu-worker-{spec.name}-{spec.task_id.hex()[:6]}",
+            daemon=True,
+        )
+        thread.start()
+        return True
+
+    def _pick_node(self, spec: TaskSpec) -> Optional[Node]:
+        nodes = [n for n in self.nodes() if n.alive]
+        feasible = [
+            n for n in nodes
+            if all(n.resources.available().get(k, 0.0) >= v - 1e-9 for k, v in spec.resources.items())
+        ]
+        if not feasible:
+            return None
+        if spec.scheduling_strategy == "SPREAD":
+            return min(feasible, key=lambda n: n.utilization())
+        # Hybrid: pack onto the busiest node below threshold, else spread.
+        below = [n for n in feasible if n.utilization() < self.HYBRID_THRESHOLD]
+        if below:
+            return max(below, key=lambda n: n.utilization())
+        return min(feasible, key=lambda n: n.utilization())
+
+    # ------------------------------------------------------------- task runner
+
+    def _run_task(self, spec: TaskSpec, node: Node, pool: ResourceSet) -> None:
+        error: Optional[BaseException] = None
+        error_tb = ""
+        try:
+            args = _resolve(spec.args, self._store)
+            kwargs = _resolve(spec.kwargs, self._store)
+            result = spec.func(*args, **kwargs)
+            self._seal_returns(spec, result)
+        except BaseException as exc:  # noqa: BLE001 - boundary: remote error capture
+            error = exc
+            error_tb = traceback.format_exc()
+        finally:
+            pool.release(spec.resources)
+            with node._lock:
+                node.running_tasks.pop(spec.task_id, None)
+
+        if error is not None:
+            retriable = spec.attempt < spec.max_retries and (
+                spec.retry_exceptions is True
+                or (isinstance(spec.retry_exceptions, (list, tuple))
+                    and isinstance(error, tuple(spec.retry_exceptions)))
+            )
+            if retriable and not spec.cancelled:
+                spec.attempt += 1
+                self.stats["retries"] += 1
+                logger.warning("retrying task %s (attempt %d): %s", spec.name, spec.attempt, error)
+                self.submit(spec)
+                return
+            self._fail_returns(spec, TaskError(spec.name, error, error_tb))
+        self._on_task_done(spec, error)
+        self._wake.set()
+
+    def _seal_returns(self, spec: TaskSpec, result: Any) -> None:
+        if spec.num_returns == 1:
+            self._store.seal(spec.return_ids[0], result)
+        else:
+            values = list(result) if result is not None else []
+            if len(values) != spec.num_returns:
+                raise ValueError(
+                    f"Task {spec.name} declared num_returns={spec.num_returns} "
+                    f"but returned {len(values)} values"
+                )
+            for oid, value in zip(spec.return_ids, values):
+                self._store.seal(oid, value)
+
+    def _fail_returns(self, spec: TaskSpec, error: BaseException) -> None:
+        for oid in spec.return_ids:
+            self._store.seal_error(oid, error)
+
+    def shutdown(self) -> None:
+        self._shutdown = True
+        self._wake.set()
+        self._dispatch_thread.join(timeout=2.0)
+
+
+# ----------------------------------------------------------------------- helpers
+
+
+def _collect_dependencies(args, kwargs) -> List[ObjectID]:
+    from .runtime import ObjectRef  # cycle-free at call time
+
+    deps = []
+    for value in itertools.chain(args, kwargs.values()):
+        if isinstance(value, ObjectRef):
+            deps.append(value.object_id)
+    return deps
+
+
+def _resolve(container, store):
+    from .runtime import ObjectRef
+
+    if isinstance(container, tuple):
+        return tuple(store.get(v.object_id) if isinstance(v, ObjectRef) else v for v in container)
+    return {
+        k: (store.get(v.object_id) if isinstance(v, ObjectRef) else v)
+        for k, v in container.items()
+    }

@@ -1,0 +1,198 @@
+"""Actor tests: lifecycle, ordering, named actors, failure, restart.
+
+Coverage modeled on the reference python/ray/tests/test_actor.py and
+test_actor_failures.py.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+
+
+@ray_tpu.remote
+class Counter:
+    def __init__(self, start=0):
+        self.n = start
+
+    def inc(self, by=1):
+        self.n += by
+        return self.n
+
+    def value(self):
+        return self.n
+
+    def explode(self):
+        raise RuntimeError("actor method error")
+
+
+def test_actor_basic(runtime):
+    c = Counter.remote()
+    assert ray_tpu.get(c.inc.remote()) == 1
+    assert ray_tpu.get(c.inc.remote(5)) == 6
+    assert ray_tpu.get(c.value.remote()) == 6
+
+
+def test_actor_init_args(runtime):
+    c = Counter.remote(100)
+    assert ray_tpu.get(c.value.remote()) == 100
+
+
+def test_actor_method_ordering(runtime):
+    c = Counter.remote()
+    refs = [c.inc.remote() for _ in range(50)]
+    assert ray_tpu.get(refs) == list(range(1, 51))
+
+
+def test_actor_method_error_does_not_kill_actor(runtime):
+    c = Counter.remote()
+    with pytest.raises(ray_tpu.TaskError):
+        ray_tpu.get(c.explode.remote())
+    assert ray_tpu.get(c.inc.remote()) == 1
+
+
+def test_actor_objectref_args(runtime):
+    c = Counter.remote()
+    ref = ray_tpu.put(7)
+    assert ray_tpu.get(c.inc.remote(ref)) == 7
+
+
+def test_named_actor(runtime):
+    Counter.options(name="global_counter").remote(5)
+    handle = ray_tpu.get_actor("global_counter")
+    assert ray_tpu.get(handle.value.remote()) == 5
+    assert "global_counter" in [a["name"] for a in ray_tpu.list_actors()]
+
+
+def test_kill_actor(runtime):
+    c = Counter.remote()
+    assert ray_tpu.get(c.inc.remote()) == 1
+    ray_tpu.kill(c)
+    time.sleep(0.05)
+    with pytest.raises(ray_tpu.ActorDiedError):
+        ray_tpu.get(c.inc.remote(), timeout=5)
+
+
+def test_actor_restart(runtime):
+    c = Counter.options(max_restarts=1).remote(10)
+    assert ray_tpu.get(c.inc.remote()) == 11
+    # Simulated process failure -> restart with fresh state.
+    ray_tpu.kill(c, no_restart=False)
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        try:
+            if ray_tpu.get(c.value.remote(), timeout=5) == 10:
+                break
+        except ray_tpu.RayTpuError:
+            time.sleep(0.02)
+    assert ray_tpu.get(c.value.remote(), timeout=5) == 10
+
+
+def test_actor_init_failure(runtime):
+    @ray_tpu.remote
+    class Bad:
+        def __init__(self):
+            raise ValueError("cannot construct")
+
+        def m(self):
+            return 1
+
+    b = Bad.remote()
+    with pytest.raises(ray_tpu.ActorDiedError):
+        ray_tpu.get(b.m.remote(), timeout=5)
+
+
+def test_max_concurrency(runtime):
+    @ray_tpu.remote(max_concurrency=4)
+    class Parallel:
+        def block(self, t):
+            time.sleep(t)
+            return True
+
+    p = Parallel.remote()
+    start = time.monotonic()
+    refs = [p.block.remote(0.2) for _ in range(4)]
+    assert all(ray_tpu.get(refs))
+    # Sequential would be >= 0.8s; concurrent should be well under.
+    assert time.monotonic() - start < 0.6
+
+
+def test_actor_resources_held(runtime):
+    @ray_tpu.remote(num_cpus=8)
+    class Hog:
+        def ping(self):
+            return "pong"
+
+    h = Hog.remote()
+    assert ray_tpu.get(h.ping.remote()) == "pong"
+    avail = ray_tpu.available_resources()
+    assert avail["CPU"] == 0.0
+    ray_tpu.kill(h)
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        if ray_tpu.available_resources()["CPU"] == 8.0:
+            break
+        time.sleep(0.02)
+    assert ray_tpu.available_resources()["CPU"] == 8.0
+
+
+def test_named_actor_name_released_on_init_failure(runtime):
+    """Regression: self-death (init failure) must release the name."""
+
+    @ray_tpu.remote
+    class Bad2:
+        def __init__(self):
+            raise ValueError("nope")
+
+        def m(self):
+            return 1
+
+    b = Bad2.options(name="doomed").remote()
+    with pytest.raises(ray_tpu.ActorDiedError):
+        ray_tpu.get(b.m.remote(), timeout=5)
+    # Name must become reusable.
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        try:
+            Counter.options(name="doomed").remote(1)
+            break
+        except ValueError:
+            time.sleep(0.02)
+    assert ray_tpu.get(ray_tpu.get_actor("doomed").value.remote()) == 1
+
+
+def test_duplicate_name_raises_without_leak(runtime):
+    Counter.options(name="unique").remote()
+    before = ray_tpu.available_resources()["CPU"]
+    with pytest.raises(ValueError):
+        Counter.options(name="unique").remote()
+    time.sleep(0.1)
+    assert ray_tpu.available_resources()["CPU"] == before
+
+
+def test_actor_infeasible_placement_dies(runtime):
+    @ray_tpu.remote(num_cpus=999)
+    class Huge:
+        def m(self):
+            return 1
+
+    pg = ray_tpu.placement_group([{"CPU": 1}])
+    h = Huge.options(
+        scheduling_strategy=ray_tpu.PlacementGroupSchedulingStrategy(pg)
+    ).remote()
+    with pytest.raises(ray_tpu.ActorDiedError):
+        ray_tpu.get(h.m.remote(), timeout=5)
+    ray_tpu.remove_placement_group(pg)
+
+
+def test_actor_method_wrong_num_returns_errors(runtime):
+    @ray_tpu.remote
+    class OneVal:
+        def one(self):
+            return (1,)
+
+    a = OneVal.remote()
+    r1, r2 = a.one.options(num_returns=2).remote()
+    with pytest.raises(ray_tpu.TaskError):
+        ray_tpu.get(r2, timeout=5)

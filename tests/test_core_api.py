@@ -1,0 +1,216 @@
+"""Core task/object API tests.
+
+Modeled on the reference's python/ray/tests/test_basic*.py coverage: tasks,
+object passing, nested tasks, multiple returns, errors, retries, wait,
+cancellation, resource limits.
+"""
+
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+
+
+@ray_tpu.remote
+def add(a, b):
+    return a + b
+
+
+@ray_tpu.remote
+def fail(msg="boom"):
+    raise ValueError(msg)
+
+
+def test_put_get(runtime):
+    ref = ray_tpu.put({"x": [1, 2, 3]})
+    assert ray_tpu.get(ref) == {"x": [1, 2, 3]}
+
+
+def test_task_roundtrip(runtime):
+    assert ray_tpu.get(add.remote(1, 2)) == 3
+
+
+def test_object_ref_args(runtime):
+    a = ray_tpu.put(10)
+    b = add.remote(a, 5)
+    c = add.remote(b, ray_tpu.put(1))
+    assert ray_tpu.get(c) == 16
+
+
+def test_nested_tasks(runtime):
+    @ray_tpu.remote
+    def outer(n):
+        refs = [add.remote(i, i) for i in range(n)]
+        return sum(ray_tpu.get(refs))
+
+    assert ray_tpu.get(outer.remote(5)) == 2 * sum(range(5))
+
+
+def test_many_tasks(runtime):
+    refs = [add.remote(i, 1) for i in range(200)]
+    assert ray_tpu.get(refs) == [i + 1 for i in range(200)]
+
+
+def test_num_returns(runtime):
+    @ray_tpu.remote(num_returns=3)
+    def three():
+        return 1, 2, 3
+
+    r1, r2, r3 = three.remote()
+    assert ray_tpu.get([r1, r2, r3]) == [1, 2, 3]
+
+
+def test_task_error_propagates(runtime):
+    with pytest.raises(ray_tpu.TaskError) as ei:
+        ray_tpu.get(fail.remote("kapow"))
+    assert "kapow" in str(ei.value)
+    assert isinstance(ei.value.cause, ValueError)
+
+
+def test_error_propagates_through_dependency(runtime):
+    bad = fail.remote()
+    downstream = add.remote(bad, 1)
+    with pytest.raises(ray_tpu.TaskError):
+        ray_tpu.get(downstream)
+
+
+def test_retries(runtime):
+    counter = {"n": 0}
+    lock = threading.Lock()
+
+    @ray_tpu.remote(max_retries=3, retry_exceptions=True)
+    def flaky():
+        with lock:
+            counter["n"] += 1
+            if counter["n"] < 3:
+                raise RuntimeError("transient")
+        return "ok"
+
+    assert ray_tpu.get(flaky.remote()) == "ok"
+    assert counter["n"] == 3
+
+
+def test_wait(runtime):
+    @ray_tpu.remote
+    def slow(t):
+        time.sleep(t)
+        return t
+
+    fast_ref = slow.remote(0.01)
+    slow_ref = slow.remote(5.0)
+    ready, not_ready = ray_tpu.wait([fast_ref, slow_ref], num_returns=1, timeout=2.0)
+    assert ready == [fast_ref]
+    assert not_ready == [slow_ref]
+
+
+def test_get_timeout(runtime):
+    @ray_tpu.remote
+    def hang():
+        time.sleep(60)
+
+    with pytest.raises(ray_tpu.GetTimeoutError):
+        ray_tpu.get(hang.remote(), timeout=0.1)
+
+
+def test_resources_limit_concurrency(runtime):
+    # 8 CPUs, each task takes 4 => at most 2 run concurrently.
+    running = []
+    peak = []
+    lock = threading.Lock()
+
+    @ray_tpu.remote(num_cpus=4)
+    def busy():
+        with lock:
+            running.append(1)
+            peak.append(len(running))
+        time.sleep(0.1)
+        with lock:
+            running.pop()
+        return True
+
+    refs = [busy.remote() for _ in range(6)]
+    assert all(ray_tpu.get(refs))
+    assert max(peak) <= 2
+
+
+def test_infeasible_task_errors(runtime):
+    @ray_tpu.remote(num_cpus=10_000)
+    def impossible():
+        return 1
+
+    with pytest.raises(Exception):
+        ray_tpu.get(impossible.remote(), timeout=5)
+
+
+def test_cancel_pending(runtime):
+    @ray_tpu.remote(num_cpus=8)
+    def blocker():
+        time.sleep(1.0)
+
+    @ray_tpu.remote(num_cpus=8)
+    def queued():
+        return 1
+
+    b = blocker.remote()
+    q = queued.remote()
+    assert ray_tpu.cancel(q)
+    with pytest.raises((ray_tpu.TaskCancelledError, ray_tpu.TaskError)):
+        ray_tpu.get(q, timeout=5)
+    ray_tpu.get(b)
+
+
+def test_custom_resources(runtime):
+    runtime.scheduler.head_node().resources.add_capacity({"widget": 2.0})
+
+    @ray_tpu.remote(resources={"widget": 1.0})
+    def uses_widget():
+        return "w"
+
+    assert ray_tpu.get(uses_widget.remote()) == "w"
+    assert ray_tpu.cluster_resources().get("widget") == 2.0
+
+
+def test_cluster_resources(runtime):
+    res = ray_tpu.cluster_resources()
+    assert res["CPU"] == 8.0
+
+
+def test_cancel_blocked_task_no_deadlock(runtime):
+    """Regression: cancel of a dependency-blocked task must not deadlock the
+    scheduler (seal_error runs dependency callbacks inline)."""
+
+    @ray_tpu.remote
+    def slow():
+        time.sleep(0.5)
+        return 1
+
+    upstream = slow.remote()
+    downstream = add.remote(upstream, 1)
+    chained = add.remote(downstream, 1)  # blocked on downstream
+    ray_tpu.cancel(downstream)
+    with pytest.raises((ray_tpu.TaskCancelledError, ray_tpu.TaskError)):
+        ray_tpu.get(chained, timeout=5)
+    # Scheduler must still be live:
+    assert ray_tpu.get(add.remote(1, 1), timeout=5) == 2
+
+
+def test_bad_bundle_index_fails_task_not_scheduler(runtime):
+    """Regression: a dispatch-time error must fail the task, not kill the
+    dispatch loop."""
+    pg = ray_tpu.placement_group([{"CPU": 1}])
+    strat = ray_tpu.PlacementGroupSchedulingStrategy(pg, placement_group_bundle_index=7)
+    ref = add.options(scheduling_strategy=strat).remote(1, 2)
+    with pytest.raises(ray_tpu.TaskError):
+        ray_tpu.get(ref, timeout=5)
+    assert ray_tpu.get(add.remote(1, 1), timeout=5) == 2
+    ray_tpu.remove_placement_group(pg)
+
+
+def test_wait_returns_at_most_num_returns(runtime):
+    refs = [ray_tpu.put(i) for i in range(5)]
+    ready, not_ready = ray_tpu.wait(refs, num_returns=2, timeout=5)
+    assert len(ready) == 2
+    assert len(not_ready) == 3
+    assert set(ready + not_ready) == set(refs)

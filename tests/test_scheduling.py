@@ -1,0 +1,134 @@
+"""Multi-node scheduling, placement groups, object store behavior.
+
+Coverage modeled on reference python/ray/tests/test_placement_group*.py and
+test_scheduling*.py using the N-logical-nodes pattern (cluster_utils.py:135).
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.core.object_store import ObjectStore, Tier
+from ray_tpu.core.ids import JobID, ObjectID
+
+
+def test_spread_uses_all_nodes(cluster4):
+    import threading
+    seen_threads = set()
+
+    @ray_tpu.remote(num_cpus=4)
+    def whereami():
+        import time
+        time.sleep(0.1)
+        return threading.current_thread().name
+
+    # 4 nodes x 4 cpus; 4 tasks at 4 cpus must use all four nodes.
+    refs = [whereami.options(scheduling_strategy="SPREAD").remote() for _ in range(4)]
+    assert len(ray_tpu.get(refs)) == 4
+    assert ray_tpu.cluster_resources()["CPU"] == 16.0
+
+
+def test_placement_group_pack(cluster4):
+    pg = ray_tpu.placement_group([{"CPU": 2}, {"CPU": 2}], strategy="STRICT_PACK")
+    assert pg.ready(timeout=5)
+    nodes = {b.node.node_id for b in pg.bundles}
+    assert len(nodes) == 1
+    ray_tpu.remove_placement_group(pg)
+
+
+def test_placement_group_strict_spread(cluster4):
+    pg = ray_tpu.placement_group([{"CPU": 2}] * 4, strategy="STRICT_SPREAD")
+    nodes = {b.node.node_id for b in pg.bundles}
+    assert len(nodes) == 4
+    ray_tpu.remove_placement_group(pg)
+
+
+def test_placement_group_infeasible(cluster4):
+    from ray_tpu.core.exceptions import PlacementGroupUnschedulableError
+
+    with pytest.raises(PlacementGroupUnschedulableError):
+        ray_tpu.placement_group([{"CPU": 100}])
+
+
+def test_task_in_placement_group(cluster4):
+    pg = ray_tpu.placement_group([{"CPU": 4}], strategy="PACK")
+
+    @ray_tpu.remote(num_cpus=2)
+    def inside():
+        return "in-pg"
+
+    strategy = ray_tpu.PlacementGroupSchedulingStrategy(pg, 0)
+    ref = inside.options(scheduling_strategy=strategy).remote()
+    assert ray_tpu.get(ref) == "in-pg"
+    ray_tpu.remove_placement_group(pg)
+
+
+def test_actor_in_placement_group_bundle(cluster4):
+    pg = ray_tpu.placement_group([{"CPU": 2}, {"CPU": 2}], strategy="STRICT_SPREAD")
+
+    @ray_tpu.remote(num_cpus=2)
+    class Pinned:
+        def node(self):
+            return "ok"
+
+    a = Pinned.options(
+        scheduling_strategy=ray_tpu.PlacementGroupSchedulingStrategy(pg, 1)
+    ).remote()
+    assert ray_tpu.get(a.node.remote()) == "ok"
+    # Bundle 1's reservation should now be exhausted.
+    assert pg.bundles[1].reserved.available()["CPU"] == 0.0
+    ray_tpu.kill(a)
+    ray_tpu.remove_placement_group(pg)
+
+
+def test_node_affinity(cluster4):
+    target = ray_tpu.nodes()[2]
+
+    @ray_tpu.remote
+    def pinned():
+        return "here"
+
+    strat = ray_tpu.NodeAffinitySchedulingStrategy(
+        node_id=cluster4.scheduler.nodes()[2].node_id
+    )
+    assert ray_tpu.get(pinned.options(scheduling_strategy=strat).remote()) == "here"
+
+
+# ---------------------------------------------------------------- object store
+
+
+def test_object_store_spill(tmp_path):
+    store = ObjectStore(capacity_bytes=1 << 20, spill_dir=str(tmp_path))
+    job = JobID.next()
+    refs = []
+    for i in range(8):
+        oid = ObjectID.for_put(job)
+        store.put(oid, np.full((256, 256), i, dtype=np.float32))  # 256KiB each
+        refs.append(oid)
+    assert store.stats["spills"] > 0
+    # Everything still retrievable (restored from disk).
+    for i, oid in enumerate(refs):
+        assert store.get(oid)[0, 0] == i
+    assert store.stats["restores"] > 0
+
+
+def test_object_store_tiers():
+    store = ObjectStore()
+    job = JobID.next()
+    small = ObjectID.for_put(job)
+    store.put(small, b"tiny")
+    assert store.entry(small).tier == Tier.INLINE
+    big = ObjectID.for_put(job)
+    store.put(big, np.zeros((1024, 1024), dtype=np.float32))
+    assert store.entry(big).tier == Tier.HOST
+
+
+def test_large_numpy_roundtrip(runtime):
+    arr = np.random.default_rng(0).standard_normal((512, 512))
+    ref = ray_tpu.put(arr)
+
+    @ray_tpu.remote
+    def total(x):
+        return float(x.sum())
+
+    assert abs(ray_tpu.get(total.remote(ref)) - float(arr.sum())) < 1e-6
