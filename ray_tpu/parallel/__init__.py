@@ -1,0 +1,35 @@
+"""Parallelism layer: meshes, sharding rules, collectives."""
+
+from .collectives import (  # noqa: F401
+    CollectiveGroup,
+    allgather,
+    allreduce,
+    barrier,
+    broadcast,
+    destroy_collective_group,
+    get_group,
+    init_collective_group,
+    reducescatter,
+)
+from .mesh import (  # noqa: F401
+    AXIS_ORDER,
+    DATA_AXES,
+    MeshRegistry,
+    MeshSpec,
+    build_mesh,
+    data_sharding,
+    mesh_registry,
+    replicated,
+    single_device_mesh,
+)
+from .sharding import (  # noqa: F401
+    P,
+    default_rules,
+    logical_to_spec,
+    override_rules,
+    path_specs,
+    shard_tree,
+    tree_shardings,
+    tree_specs,
+    validate_divisibility,
+)

@@ -1,0 +1,206 @@
+"""Collectives: XLA-compiled groups over mesh axes.
+
+Parity surface: /root/reference/python/ray/util/collective/collective.py
+(init_collective_group :123, allreduce :268, allgather, reducescatter,
+broadcast, barrier, send/recv :541/604) with NCCL/Gloo backends.
+
+TPU-native inversion: a collective is not a runtime service call — it is a
+compiled XLA op over a mesh axis, scheduled by the compiler onto ICI. Two
+usage modes:
+
+1. **In-graph** (the fast path): inside shard_map'd/jitted code use the
+   `psum/pmean/all_gather/ppermute/...` aliases below; XLA fuses and
+   schedules them. This is where NCCL's entire role goes.
+2. **Eager groups** (parity with the reference's out-of-band API): a
+   `CollectiveGroup` wraps a mesh axis and exposes eager allreduce/
+   broadcast/etc. on device arrays — each call is a tiny jitted program.
+   Useful for control-plane math (metric reduction, elastic re-meshing
+   checks), NOT for the training hot loop.
+"""
+
+from __future__ import annotations
+
+import threading
+from functools import partial
+from typing import Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+P = PartitionSpec
+
+# In-graph aliases (use under shard_map; axis_name is the mesh axis).
+psum = lax.psum
+pmean = lax.pmean
+pmax = lax.pmax
+pmin = lax.pmin
+ppermute = lax.ppermute
+all_gather = lax.all_gather
+psum_scatter = lax.psum_scatter
+all_to_all = lax.all_to_all
+axis_index = lax.axis_index
+
+
+class CollectiveGroup:
+    """Eager collectives over one or more axes of a registered mesh.
+
+    Reference parity: one CollectiveGroup ≈ one NCCL communicator
+    (nccl_collective_group.py), but membership is a mesh axis, creation is
+    free (no rendezvous), and the transport is whatever XLA picked (ICI
+    within a slice, DCN across).
+    """
+
+    def __init__(self, mesh: Mesh, axis: str = "dp", name: str = "default"):
+        if axis not in mesh.axis_names:
+            raise ValueError(f"axis {axis!r} not in mesh axes {mesh.axis_names}")
+        self.mesh = mesh
+        self.axis = axis
+        self.name = name
+
+    @property
+    def size(self) -> int:
+        return self.mesh.shape[self.axis]
+
+    def _spec_for(self, x: jax.Array) -> PartitionSpec:
+        # Eager arrays may carry any sharding; we operate on whatever spec
+        # they have and reduce over self.axis.
+        sharding = x.sharding
+        if isinstance(sharding, NamedSharding) and sharding.mesh.shape == self.mesh.shape:
+            return sharding.spec
+        return PartitionSpec()
+
+    def allreduce(self, x: jax.Array, op: str = "sum") -> jax.Array:
+        spec = self._spec_for(x)
+        fn = {"sum": psum, "mean": pmean, "max": pmax, "min": pmin}[op]
+
+        @partial(
+            jax.shard_map, mesh=self.mesh, in_specs=spec, out_specs=spec,
+            check_vma=False,
+        )
+        def _reduce(v):
+            return fn(v, self.axis)
+
+        return jax.jit(_reduce)(x)
+
+    def broadcast(self, x: jax.Array, root: int = 0) -> jax.Array:
+        spec = self._spec_for(x)
+
+        @partial(
+            jax.shard_map, mesh=self.mesh, in_specs=spec, out_specs=spec,
+            check_vma=False,
+        )
+        def _bcast(v):
+            idx = lax.axis_index(self.axis)
+            n = lax.psum(1, self.axis)
+            mask = (idx == root).astype(v.dtype)
+            # sum(v * one_hot(root)) == v@root everywhere: a broadcast as a
+            # reduction, which XLA lowers to an ICI broadcast.
+            return lax.psum(v * mask, self.axis)
+
+        return jax.jit(_bcast)(x)
+
+    def allgather(self, x: jax.Array) -> jax.Array:
+        """Gather shards along a new leading axis of size `group size`."""
+        spec = self._spec_for(x)
+        out_spec = PartitionSpec(None, *spec)
+
+        @partial(
+            jax.shard_map, mesh=self.mesh, in_specs=spec, out_specs=out_spec,
+            check_vma=False,
+        )
+        def _gather(v):
+            return all_gather(v, self.axis, axis=0)
+
+        return jax.jit(_gather)(x)
+
+    def reducescatter(self, x: jax.Array) -> jax.Array:
+        """Sum over the group, scattering the leading dim across members."""
+        spec = self._spec_for(x)
+        out_spec = PartitionSpec(self.axis, *spec[1:]) if len(spec) else PartitionSpec(self.axis)
+
+        @partial(
+            jax.shard_map, mesh=self.mesh, in_specs=spec, out_specs=out_spec,
+            check_vma=False,
+        )
+        def _rs(v):
+            return psum_scatter(v, self.axis, scatter_dimension=0, tiled=True)
+
+        return jax.jit(_rs)(x)
+
+    def barrier(self) -> None:
+        """Complete when every member has entered: a 1-element psum."""
+        token = jnp.zeros((), jnp.int32)
+
+        @partial(
+            jax.shard_map, mesh=self.mesh, in_specs=P(), out_specs=P(),
+            check_vma=False,
+        )
+        def _bar(v):
+            return psum(v, self.axis)
+
+        jax.jit(_bar)(token).block_until_ready()
+
+
+# -------------------------------------------------------------- group manager
+
+
+class _GroupManager:
+    """Named collective groups (reference: GroupManager collective.py:40)."""
+
+    def __init__(self):
+        self._groups: Dict[str, CollectiveGroup] = {}
+        self._lock = threading.Lock()
+
+    def create(self, mesh: Mesh, axis: str, name: str) -> CollectiveGroup:
+        with self._lock:
+            if name in self._groups:
+                raise ValueError(f"collective group {name!r} exists")
+            group = CollectiveGroup(mesh, axis, name)
+            self._groups[name] = group
+            return group
+
+    def get(self, name: str) -> CollectiveGroup:
+        with self._lock:
+            return self._groups[name]
+
+    def destroy(self, name: str) -> None:
+        with self._lock:
+            self._groups.pop(name, None)
+
+
+_manager = _GroupManager()
+
+
+def init_collective_group(mesh: Mesh, axis: str = "dp", group_name: str = "default") -> CollectiveGroup:
+    """Parity with reference init_collective_group (collective.py:123)."""
+    return _manager.create(mesh, axis, group_name)
+
+
+def get_group(group_name: str = "default") -> CollectiveGroup:
+    return _manager.get(group_name)
+
+
+def destroy_collective_group(group_name: str = "default") -> None:
+    _manager.destroy(group_name)
+
+
+def allreduce(x: jax.Array, group_name: str = "default", op: str = "sum") -> jax.Array:
+    return _manager.get(group_name).allreduce(x, op)
+
+
+def broadcast(x: jax.Array, root: int = 0, group_name: str = "default") -> jax.Array:
+    return _manager.get(group_name).broadcast(x, root)
+
+
+def allgather(x: jax.Array, group_name: str = "default") -> jax.Array:
+    return _manager.get(group_name).allgather(x)
+
+
+def reducescatter(x: jax.Array, group_name: str = "default") -> jax.Array:
+    return _manager.get(group_name).reducescatter(x)
+
+
+def barrier(group_name: str = "default") -> None:
+    _manager.get(group_name).barrier()

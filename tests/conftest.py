@@ -8,13 +8,20 @@ how the reference tests multi-node behavior with N raylets on one machine
 
 import os
 
-# Must be set before jax is imported anywhere in the test process.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# The axon sitecustomize imports jax at interpreter startup (before pytest
+# loads this file), so plain env vars are too late for JAX_PLATFORMS. The
+# backends themselves initialize lazily, so config.update still lands as
+# long as it runs before the first jax.devices() call — which this does.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
