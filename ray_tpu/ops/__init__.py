@@ -1,0 +1,22 @@
+"""ray_tpu.ops — TPU compute kernels (Pallas) with XLA reference paths.
+
+The reference framework delegates attention/normalization kernels to vLLM /
+torch CUDA kernels (e.g. /root/reference/python/ray/llm/_internal/serve/
+deployments/llm/vllm/vllm_engine.py:254). Here the hot ops are implemented
+TPU-first: Pallas kernels tiled for the MXU/VPU, with pure-XLA reference
+implementations used for correctness testing and as the CPU fallback.
+
+Dispatch convention: every op takes `implementation=` ("pallas" | "xla" |
+None). None auto-selects pallas on TPU backends, xla elsewhere.
+"""
+
+from .attention import flash_attention, mha_reference  # noqa: F401
+from .layers import (  # noqa: F401
+    apply_rope,
+    gelu,
+    layernorm,
+    rmsnorm,
+    rope_frequencies,
+    swiglu,
+)
+from .losses import cross_entropy_loss, z_loss  # noqa: F401

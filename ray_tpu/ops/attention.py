@@ -1,0 +1,462 @@
+"""Flash attention for TPU: Pallas forward/backward kernels + XLA reference.
+
+The reference framework has no attention kernel of its own — it rides on
+vLLM/torch CUDA kernels (/root/reference/python/ray/llm/_internal/serve/
+deployments/llm/vllm/vllm_engine.py:254). This module is the TPU-native
+replacement: a blockwise online-softmax kernel (Dao et al.) tiled so the
+score/accumulate matmuls land on the MXU and the running max/sum stay in
+VMEM scratch across the kv-block grid dimension.
+
+Layout convention: q (B, Hq, Sq, D); k, v (B, Hkv, Skv, D) with
+Hq % Hkv == 0 (grouped-query attention — kv blocks are index-mapped onto
+query-head groups, no materialized repeat on the forward path).
+
+All shapes are static; padding to block multiples happens in the wrapper and
+is masked inside the kernel, so XLA never sees dynamic shapes.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pltpu imports fail on non-TPU builds only for exotic setups; guard anyway
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAS_PLTPU = True
+except ImportError:  # pragma: no cover
+    pltpu = None
+    _HAS_PLTPU = False
+
+_NEG_INF = -1e30  # finite "minus infinity": keeps exp() at exactly 0.0 without NaNs
+
+
+# ------------------------------------------------------------------ reference
+
+
+def mha_reference(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    sm_scale: Optional[float] = None,
+    kv_len: Optional[int] = None,
+) -> jax.Array:
+    """Pure-XLA multi-head attention. Ground truth for the Pallas kernels and
+    the CPU-backend fallback. Supports GQA and right-padding via `kv_len`."""
+    _, hq, sq, d = q.shape
+    _, hkv, skv, _ = k.shape
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(d)
+    if hq != hkv:
+        groups = hq // hkv
+        k = jnp.repeat(k, groups, axis=1)
+        v = jnp.repeat(v, groups, axis=1)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32)
+    logits = logits * sm_scale
+    mask = None
+    if kv_len is not None:
+        mask = jnp.arange(skv)[None, :] < kv_len
+    if causal:
+        causal_mask = jnp.arange(skv)[None, :] <= jnp.arange(sq)[:, None] + (skv - sq)
+        mask = causal_mask if mask is None else (mask & causal_mask)
+    if mask is not None:
+        logits = jnp.where(mask[None, None], logits, _NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v)
+    return out.astype(q.dtype)
+
+
+# -------------------------------------------------------------- pallas forward
+
+
+def _fwd_kernel(
+    q_ref,
+    k_ref,
+    v_ref,
+    o_ref,
+    lse_ref,
+    m_scr,
+    l_scr,
+    acc_scr,
+    *,
+    sm_scale: float,
+    causal: bool,
+    block_q: int,
+    block_kv: int,
+    kv_len: int,
+    num_kv_blocks: int,
+):
+    i = pl.program_id(2)
+    j = pl.program_id(3)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # Causal: kv blocks strictly above the diagonal band contribute nothing.
+    needed = True
+    if causal:
+        needed = j * block_kv <= i * block_q + (block_q - 1)
+
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[0, 0]  # (block_q, d)
+        k = k_ref[0, 0]  # (block_kv, d)
+        v = v_ref[0, 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        s = s * sm_scale
+
+        col = j * block_kv + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        mask = col < kv_len
+        if causal:
+            row = i * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            mask = mask & (col <= row)
+        s = jnp.where(mask, s, _NEG_INF)
+
+        m_prev = m_scr[:, :1]
+        l_prev = l_scr[:, :1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(j == num_kv_blocks - 1)
+    def _finalize():
+        l = l_scr[:, :1]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_scr[:] / safe_l).astype(o_ref.dtype)
+        # logsumexp residual for the backward pass; fully-masked rows get -inf.
+        # Stored as (..., S, 1) — a (block_q, 1) block satisfies the Mosaic
+        # last-two-dims tiling rule, a bare (block_q,) block does not.
+        lse_ref[0, 0] = jnp.where(l == 0.0, _NEG_INF, m_scr[:, :1] + jnp.log(safe_l))
+
+
+def _fwd_pallas(q, k, v, causal, sm_scale, block_q, block_kv, kv_len, interpret):
+    b, hq, sq, d = q.shape
+    _, hkv, skv, _ = k.shape
+    groups = hq // hkv
+    nq = sq // block_q
+    nk = skv // block_kv
+
+    kernel = functools.partial(
+        _fwd_kernel,
+        sm_scale=sm_scale,
+        causal=causal,
+        block_q=block_q,
+        block_kv=block_kv,
+        kv_len=kv_len,
+        num_kv_blocks=nk,
+    )
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(b, hq, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda b_, h, i, j: (b_, h, i, 0)),
+            pl.BlockSpec(
+                (1, 1, block_kv, d), lambda b_, h, i, j, g=groups: (b_, h // g, j, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, block_kv, d), lambda b_, h, i, j, g=groups: (b_, h // g, j, 0)
+            ),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda b_, h, i, j: (b_, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b_, h, i, j: (b_, h, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, hq, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b, hq, sq, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return out, lse
+
+
+# ------------------------------------------------------------- pallas backward
+#
+# Standard flash backward (Dao et al. alg. 2), two kernels:
+#   dkv kernel: grid kv-outer / q-inner, accumulates dK_j, dV_j across q blocks
+#   dq  kernel: grid q-outer / kv-inner, accumulates dQ_i across kv blocks
+# P is recomputed from (q, k, lse); delta = rowsum(dO * O) is cheap in XLA.
+# GQA is handled in the wrapper (repeat kv, then segment-sum dk/dv) — the
+# kernels always see Hq == Hkv.
+
+
+def _dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+    dk_ref, dv_ref, dk_scr, dv_scr,
+    *, sm_scale, causal, block_q, block_kv, kv_len, num_q_blocks,
+):
+    j = pl.program_id(2)  # kv block (outer)
+    i = pl.program_id(3)  # q block (inner)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    needed = True
+    if causal:
+        needed = j * block_kv <= i * block_q + (block_q - 1)
+
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
+        lse = lse_ref[0, 0]  # (block_q, 1)
+        delta = delta_ref[0, 0]
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * sm_scale
+        col = j * block_kv + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        mask = col < kv_len
+        if causal:
+            row = i * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            mask = mask & (col <= row)
+        s = jnp.where(mask, s, _NEG_INF)
+        p = jnp.exp(s - lse)  # (block_q, block_kv)
+
+        # dV_j += P^T dO
+        dv_scr[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        # dP = dO V^T ; dS = P * (dP - delta)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta) * sm_scale
+        # dK_j += dS^T Q
+        dk_scr[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(i == num_q_blocks - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+    dq_ref, dq_scr,
+    *, sm_scale, causal, block_q, block_kv, kv_len, num_kv_blocks,
+):
+    i = pl.program_id(2)  # q block (outer)
+    j = pl.program_id(3)  # kv block (inner)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    needed = True
+    if causal:
+        needed = j * block_kv <= i * block_q + (block_q - 1)
+
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
+        lse = lse_ref[0, 0]  # (block_q, 1)
+        delta = delta_ref[0, 0]
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * sm_scale
+        col = j * block_kv + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        mask = col < kv_len
+        if causal:
+            row = i * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            mask = mask & (col <= row)
+        s = jnp.where(mask, s, _NEG_INF)
+        p = jnp.exp(s - lse)
+
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta) * sm_scale
+        dq_scr[:] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(j == num_kv_blocks - 1)
+    def _finalize():
+        dq_ref[0, 0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _bwd_pallas(q, k, v, out, lse, do, causal, sm_scale, block_q, block_kv, kv_len, interpret):
+    b, h, sq, d = q.shape
+    skv = k.shape[2]
+    nq = sq // block_q
+    nk = skv // block_kv
+
+    # (b, h, sq, 1): the trailing singleton keeps row blocks 2D for Mosaic
+    delta = jnp.sum(
+        do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1, keepdims=True
+    )
+
+    q_spec = pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, j, i: (b_, h_, i, 0))
+    kv_spec = pl.BlockSpec((1, 1, block_kv, d), lambda b_, h_, j, i: (b_, h_, j, 0))
+    row_spec = pl.BlockSpec((1, 1, block_q, 1), lambda b_, h_, j, i: (b_, h_, i, 0))
+
+    dkv_kernel = functools.partial(
+        _dkv_kernel, sm_scale=sm_scale, causal=causal, block_q=block_q,
+        block_kv=block_kv, kv_len=kv_len, num_q_blocks=nq,
+    )
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(b, h, nk, nq),
+        in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
+        out_specs=[kv_spec, kv_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_kv, d), jnp.float32),
+            pltpu.VMEM((block_kv, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    q_spec2 = pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, i, j: (b_, h_, i, 0))
+    kv_spec2 = pl.BlockSpec((1, 1, block_kv, d), lambda b_, h_, i, j: (b_, h_, j, 0))
+    row_spec2 = pl.BlockSpec((1, 1, block_q, 1), lambda b_, h_, i, j: (b_, h_, i, 0))
+
+    dq_kernel = functools.partial(
+        _dq_kernel, sm_scale=sm_scale, causal=causal, block_q=block_q,
+        block_kv=block_kv, kv_len=kv_len, num_kv_blocks=nk,
+    )
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(b, h, nq, nk),
+        in_specs=[q_spec2, kv_spec2, kv_spec2, q_spec2, row_spec2, row_spec2],
+        out_specs=q_spec2,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ----------------------------------------------------------- custom_vjp plumbing
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash(q, k, v, causal, sm_scale, block_q, block_kv, kv_len, interpret):
+    out, _ = _fwd_pallas(q, k, v, causal, sm_scale, block_q, block_kv, kv_len, interpret)
+    return out
+
+
+def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_kv, kv_len, interpret):
+    out, lse = _fwd_pallas(q, k, v, causal, sm_scale, block_q, block_kv, kv_len, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(causal, sm_scale, block_q, block_kv, kv_len, interpret, res, do):
+    q, k, v, out, lse = res
+    hq, hkv = q.shape[1], k.shape[1]
+    if hq != hkv:
+        groups = hq // hkv
+        k_full = jnp.repeat(k, groups, axis=1)
+        v_full = jnp.repeat(v, groups, axis=1)
+    else:
+        groups = 1
+        k_full, v_full = k, v
+    dq, dk, dv = _bwd_pallas(
+        q, k_full, v_full, out, lse, do, causal, sm_scale, block_q, block_kv,
+        kv_len, interpret,
+    )
+    if groups > 1:
+        b, _, skv, d = dk.shape
+        dk = dk.reshape(b, hkv, groups, skv, d).sum(axis=2)
+        dv = dv.reshape(b, hkv, groups, skv, d).sum(axis=2)
+    return dq, dk, dv
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+# ------------------------------------------------------------------ public API
+
+
+def _pad_seq(x: jax.Array, axis: int, multiple: int) -> jax.Array:
+    length = x.shape[axis]
+    pad = (-length) % multiple
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    sm_scale: Optional[float] = None,
+    block_q: int = 128,
+    block_kv: int = 128,
+    implementation: Optional[str] = None,
+) -> jax.Array:
+    """Blockwise flash attention. q (B,Hq,Sq,D); k,v (B,Hkv,Skv,D).
+
+    implementation: "pallas" (TPU kernel; interpreted off-TPU), "xla"
+    (reference), or None = pallas on TPU backends, xla otherwise.
+    """
+    if implementation is None:
+        implementation = "pallas" if jax.default_backend() == "tpu" else "xla"
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    if implementation == "xla":
+        return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale)
+    if implementation != "pallas":
+        raise ValueError(f"unknown attention implementation: {implementation!r}")
+    if not _HAS_PLTPU:  # pragma: no cover
+        return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale)
+
+    sq, skv = q.shape[2], k.shape[2]
+    if causal and sq != skv:
+        raise NotImplementedError("causal flash kernel requires Sq == Skv")
+    block_q = min(block_q, max(sq, 1))
+    block_kv = min(block_kv, max(skv, 1))
+    qp = _pad_seq(q, 2, block_q)
+    kp = _pad_seq(k, 2, block_kv)
+    vp = _pad_seq(v, 2, block_kv)
+    interpret = jax.default_backend() != "tpu"
+    out = _flash(qp, kp, vp, causal, sm_scale, block_q, block_kv, skv, interpret)
+    if out.shape[2] != sq:
+        out = out[:, :, :sq]
+    return out

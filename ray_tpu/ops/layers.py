@@ -1,0 +1,80 @@
+"""Transformer layer primitives: norms, rotary embeddings, gated MLP acts.
+
+These are deliberately plain jnp: XLA fuses elementwise chains into the
+surrounding matmuls on TPU, so hand-written Pallas buys nothing here (the
+Pallas budget goes to attention and serving kernels instead). Computation is
+done in float32 and cast back, the standard mixed-precision discipline for
+bf16 training.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def rmsnorm(x: jax.Array, scale: jax.Array, eps: float = 1e-6) -> jax.Array:
+    """RMSNorm (Llama-family). scale has shape (d,)."""
+    dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+    normed = x32 * jax.lax.rsqrt(var + eps)
+    return (normed * scale.astype(jnp.float32)).astype(dtype)
+
+
+def layernorm(
+    x: jax.Array, scale: jax.Array, bias: jax.Array, eps: float = 1e-5
+) -> jax.Array:
+    """LayerNorm (GPT-2-family)."""
+    dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    normed = (x32 - mean) * jax.lax.rsqrt(var + eps)
+    return (normed * scale.astype(jnp.float32) + bias.astype(jnp.float32)).astype(dtype)
+
+
+def gelu(x: jax.Array) -> jax.Array:
+    """tanh-approximated GELU (GPT-2 uses the approximate form)."""
+    return jax.nn.gelu(x, approximate=True)
+
+
+def swiglu(gate: jax.Array, up: jax.Array) -> jax.Array:
+    """SwiGLU gate: silu(gate) * up (Llama/Mixtral MLP)."""
+    return jax.nn.silu(gate) * up
+
+
+def rope_frequencies(
+    head_dim: int, max_seq: int, theta: float = 10000.0, dtype=jnp.float32
+) -> Tuple[jax.Array, jax.Array]:
+    """Precompute (cos, sin) tables of shape (max_seq, head_dim // 2)."""
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    t = jnp.arange(max_seq, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv_freq)
+    return jnp.cos(freqs).astype(dtype), jnp.sin(freqs).astype(dtype)
+
+
+def apply_rope(
+    x: jax.Array, cos: jax.Array, sin: jax.Array, positions: jax.Array | None = None
+) -> jax.Array:
+    """Rotary position embedding over the last dim of x (B, H, S, D).
+
+    `positions` (B, S) selects rows of the (max_seq, D/2) tables; defaults to
+    arange(S). Uses the split-half convention (matches HF Llama).
+    """
+    b, _, s, d = x.shape
+    if positions is None:
+        cos_sel = cos[:s][None, None]  # (1, 1, S, D/2)
+        sin_sel = sin[:s][None, None]
+    else:
+        cos_sel = cos[positions][:, None]  # (B, 1, S, D/2)
+        sin_sel = sin[positions][:, None]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    cos_sel = cos_sel.astype(jnp.float32)
+    sin_sel = sin_sel.astype(jnp.float32)
+    out = jnp.concatenate(
+        [x1 * cos_sel - x2 * sin_sel, x2 * cos_sel + x1 * sin_sel], axis=-1
+    )
+    return out.astype(x.dtype)

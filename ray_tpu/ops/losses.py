@@ -1,0 +1,49 @@
+"""Training losses: cross entropy with optional z-loss, computed in float32.
+
+The einsum-free formulation (take_along_axis on log-softmax) avoids
+materializing one-hot targets — at 50k-128k vocab the one-hot would dominate
+HBM traffic in the loss.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def z_loss(logits: jax.Array) -> jax.Array:
+    """Auxiliary z-loss (mean logsumexp^2) — stabilizes logit scale at scale."""
+    lse = jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)
+    return jnp.mean(jnp.square(lse))
+
+
+def cross_entropy_loss(
+    logits: jax.Array,
+    targets: jax.Array,
+    *,
+    mask: Optional[jax.Array] = None,
+    z_loss_coeff: float = 0.0,
+) -> Tuple[jax.Array, jax.Array]:
+    """Token-level CE. logits (..., V), targets (...) int. Returns
+    (mean_loss, num_tokens). mask=0 drops a position (padding)."""
+    logits32 = logits.astype(jnp.float32)
+    logz = jax.nn.logsumexp(logits32, axis=-1, keepdims=True)
+    logprobs = logits32 - logz
+    nll = -jnp.take_along_axis(logprobs, targets[..., None], axis=-1)[..., 0]
+    if mask is not None:
+        mask_f = mask.astype(jnp.float32)
+        num = jnp.maximum(jnp.sum(mask_f), 1.0)
+        loss = jnp.sum(nll * mask_f) / num
+    else:
+        num = jnp.asarray(nll.size, jnp.float32)
+        loss = jnp.mean(nll)
+    if z_loss_coeff:
+        lse2 = jnp.square(logz[..., 0])
+        if mask is not None:
+            zl = jnp.sum(lse2 * mask.astype(jnp.float32)) / num
+        else:
+            zl = jnp.mean(lse2)
+        loss = loss + z_loss_coeff * zl
+    return loss, num

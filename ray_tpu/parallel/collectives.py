@@ -22,7 +22,7 @@ from __future__ import annotations
 
 import threading
 from functools import partial
-from typing import Dict, Optional, Sequence
+from typing import Dict
 
 import jax
 import jax.numpy as jnp
@@ -58,6 +58,10 @@ class CollectiveGroup:
         self.mesh = mesh
         self.axis = axis
         self.name = name
+        # jit cache keyed by (kind, spec, extras): eager collectives are
+        # called per-step for metric reduction — a fresh closure per call
+        # would retrace + recompile every time.
+        self._jitted: Dict[tuple, callable] = {}
 
     @property
     def size(self) -> int:
@@ -65,82 +69,138 @@ class CollectiveGroup:
 
     def _spec_for(self, x: jax.Array) -> PartitionSpec:
         # Eager arrays may carry any sharding; we operate on whatever spec
-        # they have and reduce over self.axis.
+        # they have and reduce over self.axis. The mesh must be the *same*
+        # mesh (device assignment included), not merely the same shape.
         sharding = x.sharding
-        if isinstance(sharding, NamedSharding) and sharding.mesh.shape == self.mesh.shape:
+        if isinstance(sharding, NamedSharding) and sharding.mesh == self.mesh:
             return sharding.spec
         return PartitionSpec()
+
+    def _mentions_axis(self, entry) -> bool:
+        if entry == self.axis:
+            return True
+        return isinstance(entry, tuple) and self.axis in entry
+
+    def _drop_axis(self, spec: PartitionSpec) -> PartitionSpec:
+        """Replace occurrences of the group axis with None (post-gather the
+        dimension is no longer sharded over it)."""
+        out = []
+        for entry in spec:
+            if entry == self.axis:
+                out.append(None)
+            elif isinstance(entry, tuple):
+                kept = tuple(a for a in entry if a != self.axis)
+                out.append(kept if kept else None)
+            else:
+                out.append(entry)
+        return PartitionSpec(*out)
+
+    def _get_jitted(self, key: tuple, build) -> callable:
+        fn = self._jitted.get(key)
+        if fn is None:
+            fn = jax.jit(build())
+            self._jitted[key] = fn
+        return fn
 
     def allreduce(self, x: jax.Array, op: str = "sum") -> jax.Array:
         spec = self._spec_for(x)
         fn = {"sum": psum, "mean": pmean, "max": pmax, "min": pmin}[op]
 
-        @partial(
-            jax.shard_map, mesh=self.mesh, in_specs=spec, out_specs=spec,
-            check_vma=False,
-        )
-        def _reduce(v):
-            return fn(v, self.axis)
+        def build():
+            @partial(
+                jax.shard_map, mesh=self.mesh, in_specs=spec, out_specs=spec,
+                check_vma=False,
+            )
+            def _reduce(v):
+                return fn(v, self.axis)
 
-        return jax.jit(_reduce)(x)
+            return _reduce
+
+        return self._get_jitted(("allreduce", op, spec), build)(x)
 
     def broadcast(self, x: jax.Array, root: int = 0) -> jax.Array:
         spec = self._spec_for(x)
+        out_spec = self._drop_axis(spec)
 
-        @partial(
-            jax.shard_map, mesh=self.mesh, in_specs=spec, out_specs=spec,
-            check_vma=False,
-        )
-        def _bcast(v):
-            idx = lax.axis_index(self.axis)
-            n = lax.psum(1, self.axis)
-            mask = (idx == root).astype(v.dtype)
-            # sum(v * one_hot(root)) == v@root everywhere: a broadcast as a
-            # reduction, which XLA lowers to an ICI broadcast.
-            return lax.psum(v * mask, self.axis)
+        def build():
+            @partial(
+                jax.shard_map, mesh=self.mesh, in_specs=spec,
+                out_specs=out_spec, check_vma=False,
+            )
+            def _bcast(v):
+                idx = lax.axis_index(self.axis)
+                mask = (idx == root).astype(v.dtype)
+                # sum(v * one_hot(root)) == v@root everywhere: a broadcast as
+                # a reduction, which XLA lowers to an ICI broadcast.
+                return lax.psum(v * mask, self.axis)
 
-        return jax.jit(_bcast)(x)
+            return _bcast
+
+        return self._get_jitted(("broadcast", root, spec), build)(x)
 
     def allgather(self, x: jax.Array) -> jax.Array:
         """Gather shards along a new leading axis of size `group size`."""
         spec = self._spec_for(x)
-        out_spec = PartitionSpec(None, *spec)
+        # Trailing dims lose their group-axis sharding: each member now holds
+        # the full gathered copy along that dim.
+        out_spec = PartitionSpec(None, *self._drop_axis(spec))
 
-        @partial(
-            jax.shard_map, mesh=self.mesh, in_specs=spec, out_specs=out_spec,
-            check_vma=False,
-        )
-        def _gather(v):
-            return all_gather(v, self.axis, axis=0)
+        def build():
+            @partial(
+                jax.shard_map, mesh=self.mesh, in_specs=spec,
+                out_specs=out_spec, check_vma=False,
+            )
+            def _gather(v):
+                return all_gather(v, self.axis, axis=0)
 
-        return jax.jit(_gather)(x)
+            return _gather
+
+        return self._get_jitted(("allgather", spec), build)(x)
 
     def reducescatter(self, x: jax.Array) -> jax.Array:
         """Sum over the group, scattering the leading dim across members."""
         spec = self._spec_for(x)
-        out_spec = PartitionSpec(self.axis, *spec[1:]) if len(spec) else PartitionSpec(self.axis)
+        if any(self._mentions_axis(e) for e in spec):
+            raise ValueError(
+                f"reducescatter input must not already be sharded over the "
+                f"group axis {self.axis!r}; got spec {spec}"
+            )
+        first = spec[0] if len(spec) else None
+        if first is None:
+            dim0 = self.axis
+        elif isinstance(first, tuple):
+            dim0 = (self.axis, *first)
+        else:
+            dim0 = (self.axis, first)
+        out_spec = PartitionSpec(dim0, *spec[1:])
 
-        @partial(
-            jax.shard_map, mesh=self.mesh, in_specs=spec, out_specs=out_spec,
-            check_vma=False,
-        )
-        def _rs(v):
-            return psum_scatter(v, self.axis, scatter_dimension=0, tiled=True)
+        def build():
+            @partial(
+                jax.shard_map, mesh=self.mesh, in_specs=spec,
+                out_specs=out_spec, check_vma=False,
+            )
+            def _rs(v):
+                return psum_scatter(v, self.axis, scatter_dimension=0, tiled=True)
 
-        return jax.jit(_rs)(x)
+            return _rs
+
+        return self._get_jitted(("reducescatter", spec), build)(x)
 
     def barrier(self) -> None:
         """Complete when every member has entered: a 1-element psum."""
         token = jnp.zeros((), jnp.int32)
 
-        @partial(
-            jax.shard_map, mesh=self.mesh, in_specs=P(), out_specs=P(),
-            check_vma=False,
-        )
-        def _bar(v):
-            return psum(v, self.axis)
+        def build():
+            @partial(
+                jax.shard_map, mesh=self.mesh, in_specs=P(), out_specs=P(),
+                check_vma=False,
+            )
+            def _bar(v):
+                return psum(v, self.axis)
 
-        jax.jit(_bar)(token).block_until_ready()
+            return _bar
+
+        self._get_jitted(("barrier",), build)(token).block_until_ready()
 
 
 # -------------------------------------------------------------- group manager

@@ -19,7 +19,8 @@ runtime service. This module owns:
 from __future__ import annotations
 
 import threading
-from dataclasses import dataclass, field
+import warnings
+from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -102,8 +103,16 @@ def build_mesh(
                 spec.shape, devices=devices,
                 allow_split_physical_axes=allow_split_physical_axes,
             )
-        except Exception:
-            # Topology-unaware fallback (CPU test meshes, odd shapes).
+        except (ValueError, NotImplementedError, AssertionError) as e:
+            # Topology-unaware fallback (CPU test meshes, odd shapes). On a
+            # real TPU slice this surrenders ICI locality, so say so loudly.
+            if devices and devices[0].platform == "tpu":
+                warnings.warn(
+                    f"create_device_mesh failed ({e}); falling back to a "
+                    f"topology-unaware device order — collectives may cross "
+                    f"multi-hop ICI paths",
+                    stacklevel=2,
+                )
             dev_array = np.array(devices).reshape(spec.shape)
     return Mesh(dev_array, AXIS_ORDER)
 

@@ -121,8 +121,10 @@ def path_specs(tree: Any, path_rules: Sequence[Tuple[str, PartitionSpec]]) -> An
     compiled = [(re.compile(pat), spec) for pat, spec in path_rules]
 
     def spec_for(path: str) -> PartitionSpec:
+        # regex *search* semantics (t5x-style): a rule matches anywhere in
+        # the '/'-joined path; anchor with ^...$ for an exact match.
         for pat, spec in compiled:
-            if pat.fullmatch(path) or pat.match(path):
+            if pat.search(path):
                 return spec
         return PartitionSpec()
 

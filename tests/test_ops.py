@@ -1,0 +1,155 @@
+"""Kernel correctness: Pallas flash attention (interpret mode) vs XLA reference."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.ops import (
+    apply_rope,
+    cross_entropy_loss,
+    flash_attention,
+    layernorm,
+    mha_reference,
+    rmsnorm,
+    rope_frequencies,
+)
+
+
+def _rand(key, shape, dtype=jnp.float32):
+    return jax.random.normal(key, shape, dtype=dtype)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("gqa", [False, True])
+def test_flash_forward_matches_reference(causal, gqa):
+    key = jax.random.PRNGKey(0)
+    kq, kk, kv = jax.random.split(key, 3)
+    b, hq, s, d = 2, 4, 256, 64
+    hkv = 2 if gqa else hq
+    q = _rand(kq, (b, hq, s, d))
+    k = _rand(kk, (b, hkv, s, d))
+    v = _rand(kv, (b, hkv, s, d))
+    ref = mha_reference(q, k, v, causal=causal)
+    out = flash_attention(q, k, v, causal=causal, implementation="pallas",
+                          block_q=128, block_kv=128)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_flash_forward_unpadded_vs_padded():
+    # seq not a multiple of the block: wrapper pads + masks
+    key = jax.random.PRNGKey(1)
+    kq, kk, kv = jax.random.split(key, 3)
+    b, h, s, d = 1, 2, 192, 64
+    q = _rand(kq, (b, h, s, d))
+    k = _rand(kk, (b, h, s, d))
+    v = _rand(kv, (b, h, s, d))
+    ref = mha_reference(q, k, v, causal=False)
+    out = flash_attention(q, k, v, causal=False, implementation="pallas",
+                          block_q=128, block_kv=128)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_backward_matches_reference(causal):
+    key = jax.random.PRNGKey(2)
+    kq, kk, kv = jax.random.split(key, 3)
+    b, h, s, d = 1, 2, 256, 64
+    q = _rand(kq, (b, h, s, d))
+    k = _rand(kk, (b, h, s, d))
+    v = _rand(kv, (b, h, s, d))
+
+    def loss_pallas(q, k, v):
+        o = flash_attention(q, k, v, causal=causal, implementation="pallas")
+        return jnp.sum(o * o)
+
+    def loss_ref(q, k, v):
+        o = mha_reference(q, k, v, causal=causal)
+        return jnp.sum(o * o)
+
+    gp = jax.grad(loss_pallas, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(gp, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_), atol=1e-3, rtol=1e-3)
+
+
+def test_flash_backward_gqa():
+    key = jax.random.PRNGKey(3)
+    kq, kk, kv = jax.random.split(key, 3)
+    b, hq, hkv, s, d = 1, 4, 2, 128, 32
+    q = _rand(kq, (b, hq, s, d))
+    k = _rand(kk, (b, hkv, s, d))
+    v = _rand(kv, (b, hkv, s, d))
+
+    def loss_pallas(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True, implementation="pallas") ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(mha_reference(q, k, v, causal=True) ** 2)
+
+    gp = jax.grad(loss_pallas, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(gp, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_), atol=1e-3, rtol=1e-3)
+
+
+def test_rmsnorm_and_layernorm():
+    x = _rand(jax.random.PRNGKey(4), (2, 8, 64))
+    scale = jnp.ones((64,))
+    bias = jnp.zeros((64,))
+    out = rmsnorm(x, scale)
+    expected = x / np.sqrt(np.mean(np.square(np.asarray(x)), -1, keepdims=True) + 1e-6)
+    np.testing.assert_allclose(np.asarray(out), expected, atol=1e-5)
+    ln = layernorm(x, scale, bias)
+    np.testing.assert_allclose(np.asarray(ln).mean(-1), 0.0, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(ln).std(-1), 1.0, atol=1e-3)
+
+
+def test_rope_rotation_preserves_norm():
+    cos, sin = rope_frequencies(64, 128)
+    x = _rand(jax.random.PRNGKey(5), (1, 2, 16, 64))
+    out = apply_rope(x, cos, sin)
+    np.testing.assert_allclose(
+        np.linalg.norm(np.asarray(out), axis=-1),
+        np.linalg.norm(np.asarray(x), axis=-1),
+        rtol=1e-5,
+    )
+    # position 0 is identity
+    np.testing.assert_allclose(np.asarray(out[:, :, 0]), np.asarray(x[:, :, 0]), atol=1e-6)
+
+
+def test_rope_with_positions():
+    cos, sin = rope_frequencies(32, 64)
+    x = _rand(jax.random.PRNGKey(6), (2, 1, 4, 32))
+    pos = jnp.array([[3, 4, 5, 6], [0, 1, 2, 3]])
+    out = apply_rope(x, cos, sin, positions=pos)
+    # batch 1 with offset positions == default arange
+    default = apply_rope(x[1:2], cos, sin)
+    np.testing.assert_allclose(np.asarray(out[1:2]), np.asarray(default), atol=1e-6)
+
+
+def test_cross_entropy_against_manual():
+    logits = _rand(jax.random.PRNGKey(7), (4, 16))
+    targets = jnp.array([1, 5, 2, 9])
+    loss, n = cross_entropy_loss(logits, targets)
+    logp = jax.nn.log_softmax(np.asarray(logits, dtype=np.float32), axis=-1)
+    expected = -np.mean([logp[i, t] for i, t in enumerate(np.asarray(targets))])
+    np.testing.assert_allclose(float(loss), expected, rtol=1e-6)
+    assert float(n) == 4.0
+
+
+def test_cross_entropy_masked():
+    logits = _rand(jax.random.PRNGKey(8), (2, 4, 16))
+    targets = jnp.zeros((2, 4), dtype=jnp.int32)
+    mask = jnp.array([[1, 1, 0, 0], [1, 0, 0, 0]])
+    loss, n = cross_entropy_loss(logits, targets, mask=mask)
+    assert float(n) == 3.0
+    assert np.isfinite(float(loss))
+
+
+def test_cross_entropy_z_loss_increases_loss():
+    logits = 5.0 * _rand(jax.random.PRNGKey(9), (4, 16))
+    targets = jnp.array([0, 1, 2, 3])
+    base, _ = cross_entropy_loss(logits, targets)
+    with_z, _ = cross_entropy_loss(logits, targets, z_loss_coeff=1e-2)
+    assert float(with_z) > float(base)

@@ -158,3 +158,74 @@ def test_sharded_matmul_end_to_end(mesh8):
         np.asarray(out), np.asarray(x) @ np.asarray(w), rtol=1e-4
     )
     assert out.sharding.spec in (P(("dp", "fsdp"), "tp"), P(("dp", "fsdp"), None))
+
+
+# --------------------------- regression tests for eager-collective semantics
+
+
+def test_allgather_of_group_sharded_input(mesh8):
+    """allgather over an input sharded on the group axis must return the
+    stacked shards, not per-member duplicated copies."""
+    from jax.sharding import NamedSharding
+
+    g = col.CollectiveGroup(mesh8, axis="dp", name="ag_sharded")
+    x = jax.device_put(
+        jnp.arange(8.0), NamedSharding(mesh8, PartitionSpec("dp"))
+    )
+    out = g.allgather(x)
+    # row i == shard i of the input (the stacked-shards contract)
+    assert out.shape == (2, 4)
+    np.testing.assert_allclose(np.asarray(out[0]), np.arange(4.0))
+    np.testing.assert_allclose(np.asarray(out[1]), np.arange(4.0) + 4)
+
+
+def test_reducescatter_rejects_group_axis_in_spec(mesh8):
+    from jax.sharding import NamedSharding
+
+    g = col.CollectiveGroup(mesh8, axis="tp", name="rs_bad")
+    y = jax.device_put(
+        jnp.ones((4, 8)), NamedSharding(mesh8, PartitionSpec(None, "tp"))
+    )
+    with pytest.raises(ValueError, match="must not already be sharded"):
+        g.reducescatter(y)
+
+
+def test_reducescatter_basic(mesh8):
+    g = col.CollectiveGroup(mesh8, axis="dp", name="rs_ok")
+    x = jnp.ones((4, 8))
+    out = g.reducescatter(x)
+    assert out.shape == (4, 8)
+    # every member contributed ones, summed over dp (size 2)
+    np.testing.assert_allclose(np.asarray(out), 2.0 * np.ones((4, 8)))
+
+
+def test_eager_collectives_hit_jit_cache(mesh8):
+    g = col.CollectiveGroup(mesh8, axis="dp", name="cachecheck")
+    x = jnp.ones((8,))
+    g.allreduce(x)
+    assert len(g._jitted) == 1
+    g.allreduce(x)
+    g.allreduce(2 * x)
+    assert len(g._jitted) == 1  # same (kind, op, spec) key -> one program
+    g.allreduce(x, op="max")
+    assert len(g._jitted) == 2
+
+
+def test_broadcast_from_root(mesh8):
+    from jax.sharding import NamedSharding
+
+    g = col.CollectiveGroup(mesh8, axis="dp", name="bcast2")
+    # replicated input: broadcast is identity-shaped
+    x = jnp.arange(4.0)
+    out = g.broadcast(x, root=0)
+    assert out.shape == (4,)
+    np.testing.assert_allclose(np.asarray(out), np.arange(4.0))
+
+
+def test_path_specs_search_semantics(mesh8):
+    from ray_tpu.parallel.sharding import path_specs
+
+    tree = {"decoder": {"wq": jnp.ones((4, 4)), "wq_norm": jnp.ones((4,))}}
+    specs = path_specs(tree, [(r"wq_norm", PartitionSpec()), (r"wq", PartitionSpec("tp"))])
+    assert specs["decoder"]["wq"] == PartitionSpec("tp")
+    assert specs["decoder"]["wq_norm"] == PartitionSpec()
