@@ -1,0 +1,17 @@
+"""ray_tpu.models — flagship model families, TPU-shaped.
+
+Decoder-only LMs (GPT-2, Llama) now; MoE (Mixtral) and ViT/CLIP follow the
+same pattern: pytree params + logical-axis tree + scan-stacked layers.
+"""
+
+from .configs import PRESETS, get_config  # noqa: F401
+from .transformer import (  # noqa: F401
+    TransformerConfig,
+    count_params,
+    decode_step,
+    forward,
+    init_cache,
+    init_params,
+    logical_axes,
+    prefill,
+)

@@ -1,0 +1,439 @@
+"""Decoder-only transformer covering the GPT-2 and Llama families.
+
+The reference serves these architectures through vLLM/torch model zoos
+(/root/reference/python/ray/llm/_internal/serve/deployments/llm/vllm/
+vllm_engine.py:254); training rides user torch code under Ray Train
+(/root/reference/python/ray/train/torch/config.py:153). Here the models are
+first-class and TPU-shaped:
+
+- parameters are a plain pytree with a parallel tree of *logical axis names*
+  (ray_tpu.parallel.sharding) — DP/FSDP/TP/SP/EP is a rule-table change,
+  never a model change;
+- layers are stacked on a leading axis and executed with `lax.scan`, so
+  compile time is O(1) in depth and remat is one `jax.checkpoint`;
+- attention dispatches to the Pallas flash kernel on TPU (ray_tpu.ops);
+- one config struct spans GPT-2 (learned pos, layernorm, gelu, tied head)
+  and Llama (rope, rmsnorm, swiglu, GQA, untied) — family presets live in
+  ray_tpu.models.configs.
+
+Shapes: tokens (B, S) int32 → logits (B, S, V). Decode path carries a dense
+KV cache (L, B, Hkv, max_seq, Dh) with per-example write positions, the
+substrate for continuous batching in ray_tpu.serve.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import (
+    apply_rope,
+    flash_attention,
+    gelu,
+    layernorm,
+    rmsnorm,
+    rope_frequencies,
+    swiglu,
+)
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 50257
+    d_model: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    n_kv_heads: Optional[int] = None  # None → n_heads (MHA); < n_heads → GQA
+    d_ff: int = 3072
+    max_seq: int = 1024
+    pos_emb: str = "learned"  # "learned" (GPT-2) | "rope" (Llama)
+    norm: str = "layernorm"  # "layernorm" | "rmsnorm"
+    act: str = "gelu"  # "gelu" | "swiglu"
+    use_bias: bool = True
+    tie_embeddings: bool = True
+    rope_theta: float = 10000.0
+    dtype: Any = jnp.bfloat16  # activation/compute dtype
+    param_dtype: Any = jnp.float32
+    remat: bool = False
+    attn_impl: Optional[str] = None  # None → pallas on TPU, xla elsewhere
+
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_heads or self.n_heads
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    def replace(self, **kw) -> "TransformerConfig":
+        return dataclasses.replace(self, **kw)
+
+
+# ---------------------------------------------------------------------- init
+
+
+def init_params(config: TransformerConfig, key: jax.Array) -> Params:
+    """GPT-2-style init: N(0, 0.02), residual-out projections scaled by
+    1/sqrt(2L). Block params are stacked on a leading layer axis for scan."""
+    c = config
+    pd = c.param_dtype
+    dh = c.head_dim
+    keys = jax.random.split(key, 16)
+    std = 0.02
+    res_std = std / math.sqrt(2 * c.n_layers)
+
+    def normal(k, shape, s=std):
+        return (s * jax.random.normal(k, shape)).astype(pd)
+
+    L = c.n_layers
+    blocks: Params = {
+        "ln1_scale": jnp.ones((L, c.d_model), pd),
+        "wq": normal(keys[0], (L, c.d_model, c.n_heads, dh)),
+        "wk": normal(keys[1], (L, c.d_model, c.kv_heads, dh)),
+        "wv": normal(keys[2], (L, c.d_model, c.kv_heads, dh)),
+        "wo": normal(keys[3], (L, c.n_heads, dh, c.d_model), res_std),
+        "ln2_scale": jnp.ones((L, c.d_model), pd),
+        "w_up": normal(keys[4], (L, c.d_model, c.d_ff)),
+        "w_down": normal(keys[5], (L, c.d_ff, c.d_model), res_std),
+    }
+    if c.act == "swiglu":
+        blocks["w_gate"] = normal(keys[6], (L, c.d_model, c.d_ff))
+    if c.norm == "layernorm":
+        blocks["ln1_bias"] = jnp.zeros((L, c.d_model), pd)
+        blocks["ln2_bias"] = jnp.zeros((L, c.d_model), pd)
+    if c.use_bias:
+        blocks["bq"] = jnp.zeros((L, c.n_heads, dh), pd)
+        blocks["bk"] = jnp.zeros((L, c.kv_heads, dh), pd)
+        blocks["bv"] = jnp.zeros((L, c.kv_heads, dh), pd)
+        blocks["bo"] = jnp.zeros((L, c.d_model), pd)
+        blocks["b_up"] = jnp.zeros((L, c.d_ff), pd)
+        blocks["b_down"] = jnp.zeros((L, c.d_model), pd)
+
+    params: Params = {
+        "wte": normal(keys[7], (c.vocab_size, c.d_model)),
+        "blocks": blocks,
+        "lnf_scale": jnp.ones((c.d_model,), pd),
+    }
+    if c.pos_emb == "learned":
+        params["wpe"] = normal(keys[8], (c.max_seq, c.d_model), 0.01)
+    if c.norm == "layernorm":
+        params["lnf_bias"] = jnp.zeros((c.d_model,), pd)
+    if not c.tie_embeddings:
+        params["lm_head"] = normal(keys[9], (c.d_model, c.vocab_size))
+    return params
+
+
+def logical_axes(config: TransformerConfig) -> Params:
+    """Logical-axis tree mirroring init_params output (sharding rule input)."""
+    c = config
+    blocks: Params = {
+        "ln1_scale": ("layers", None),
+        "wq": ("layers", "embed", "heads", "head_dim"),
+        "wk": ("layers", "embed", "kv_heads", "head_dim"),
+        "wv": ("layers", "embed", "kv_heads", "head_dim"),
+        "wo": ("layers", "heads", "head_dim", "embed"),
+        "ln2_scale": ("layers", None),
+        "w_up": ("layers", "embed", "mlp"),
+        "w_down": ("layers", "mlp", "embed"),
+    }
+    if c.act == "swiglu":
+        blocks["w_gate"] = ("layers", "embed", "mlp")
+    if c.norm == "layernorm":
+        blocks["ln1_bias"] = ("layers", None)
+        blocks["ln2_bias"] = ("layers", None)
+    if c.use_bias:
+        blocks["bq"] = ("layers", "heads", "head_dim")
+        blocks["bk"] = ("layers", "kv_heads", "head_dim")
+        blocks["bv"] = ("layers", "kv_heads", "head_dim")
+        blocks["bo"] = ("layers", None)
+        blocks["b_up"] = ("layers", "mlp")
+        blocks["b_down"] = ("layers", None)
+    axes: Params = {
+        "wte": ("vocab", "embed"),
+        "blocks": blocks,
+        "lnf_scale": (None,),
+    }
+    if c.pos_emb == "learned":
+        axes["wpe"] = (None, "embed")
+    if c.norm == "layernorm":
+        axes["lnf_bias"] = (None,)
+    if not c.tie_embeddings:
+        axes["lm_head"] = ("embed", "vocab")
+    return axes
+
+
+def count_params(params: Params) -> int:
+    return sum(x.size for x in jax.tree.leaves(params))
+
+
+# -------------------------------------------------------------------- forward
+
+
+def _norm(x, scale, bias, kind):
+    if kind == "rmsnorm":
+        return rmsnorm(x, scale)
+    return layernorm(x, scale, bias)
+
+
+def _block(
+    x: jax.Array,
+    lp: Params,
+    config: TransformerConfig,
+    rope_tables: Optional[Tuple[jax.Array, jax.Array]],
+    positions: Optional[jax.Array],
+) -> jax.Array:
+    """One transformer block on (B, S, E) activations (training/prefill)."""
+    c = config
+    dt = c.dtype
+
+    h = _norm(x, lp["ln1_scale"], lp.get("ln1_bias"), c.norm)
+    q = jnp.einsum("bse,ehd->bhsd", h, lp["wq"].astype(dt))
+    k = jnp.einsum("bse,ehd->bhsd", h, lp["wk"].astype(dt))
+    v = jnp.einsum("bse,ehd->bhsd", h, lp["wv"].astype(dt))
+    if c.use_bias:
+        q = q + lp["bq"].astype(dt)[None, :, None, :]
+        k = k + lp["bk"].astype(dt)[None, :, None, :]
+        v = v + lp["bv"].astype(dt)[None, :, None, :]
+    if rope_tables is not None:
+        cos, sin = rope_tables
+        q = apply_rope(q, cos, sin, positions)
+        k = apply_rope(k, cos, sin, positions)
+    attn = flash_attention(q, k, v, causal=True, implementation=c.attn_impl)
+    out = jnp.einsum("bhsd,hde->bse", attn, lp["wo"].astype(dt))
+    if c.use_bias:
+        out = out + lp["bo"].astype(dt)
+    x = x + out
+
+    h = _norm(x, lp["ln2_scale"], lp.get("ln2_bias"), c.norm)
+    up = jnp.einsum("bse,ef->bsf", h, lp["w_up"].astype(dt))
+    if c.use_bias:
+        up = up + lp["b_up"].astype(dt)
+    if c.act == "swiglu":
+        gate = jnp.einsum("bse,ef->bsf", h, lp["w_gate"].astype(dt))
+        act = swiglu(gate, up)
+    else:
+        act = gelu(up)
+    down = jnp.einsum("bsf,fe->bse", act, lp["w_down"].astype(dt))
+    if c.use_bias:
+        down = down + lp["b_down"].astype(dt)
+    return x + down
+
+
+def forward(
+    params: Params,
+    tokens: jax.Array,
+    config: TransformerConfig,
+    *,
+    positions: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Full-sequence forward (training / prefill): (B, S) → (B, S, V)."""
+    c = config
+    dt = c.dtype
+    _, s = tokens.shape
+    x = params["wte"].astype(dt)[tokens]
+    if c.pos_emb == "learned":
+        if positions is None:
+            x = x + params["wpe"].astype(dt)[None, :s]
+        else:
+            x = x + params["wpe"].astype(dt)[positions]
+        rope_tables = None
+    else:
+        rope_tables = rope_frequencies(c.head_dim, c.max_seq, c.rope_theta)
+
+    def block_fn(carry, lp):
+        return _block(carry, lp, c, rope_tables, positions), None
+
+    if c.remat:
+        block_fn = jax.checkpoint(block_fn)
+    x, _ = jax.lax.scan(block_fn, x, params["blocks"])
+
+    x = _norm(x, params["lnf_scale"], params.get("lnf_bias"), c.norm)
+    head = params.get("lm_head", None)
+    if head is None:
+        head = params["wte"].T
+    logits = jnp.einsum("bse,ev->bsv", x, head.astype(dt))
+    return logits
+
+
+# --------------------------------------------------------------------- decode
+
+
+def init_cache(
+    config: TransformerConfig, batch: int, max_seq: Optional[int] = None
+) -> Params:
+    """Dense KV cache: k/v of shape (L, B, Hkv, S, Dh) in the compute dtype."""
+    c = config
+    s = max_seq or c.max_seq
+    shape = (c.n_layers, batch, c.kv_heads, s, c.head_dim)
+    return {"k": jnp.zeros(shape, c.dtype), "v": jnp.zeros(shape, c.dtype)}
+
+
+def _decode_attention(q, k_cache, v_cache, lengths):
+    """Single-step attention against the cache. q (B, H, 1, Dh); cache
+    (B, Hkv, S, Dh); lengths (B,) = #valid cache slots per example."""
+    b, hq, _, dh = q.shape
+    hkv = k_cache.shape[1]
+    if hq != hkv:
+        k_cache = jnp.repeat(k_cache, hq // hkv, axis=1)
+        v_cache = jnp.repeat(v_cache, hq // hkv, axis=1)
+    logits = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, k_cache, preferred_element_type=jnp.float32
+    ) / math.sqrt(dh)
+    mask = jnp.arange(k_cache.shape[2])[None, None, None, :] < lengths[:, None, None, None]
+    logits = jnp.where(mask, logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v_cache.dtype), v_cache)
+
+
+def decode_step(
+    params: Params,
+    cache: Params,
+    tokens: jax.Array,
+    positions: jax.Array,
+    config: TransformerConfig,
+) -> Tuple[jax.Array, Params]:
+    """One autoregressive step for continuous batching.
+
+    tokens (B,) int32; positions (B,) int32 — per-example write slot (also
+    the rope position). Returns (logits (B, V), updated cache). Examples at
+    different sequence positions coexist in one batch: each writes its own
+    cache row at its own position.
+    """
+    c = config
+    dt = c.dtype
+    b = tokens.shape[0]
+    x = params["wte"].astype(dt)[tokens][:, None, :]  # (B, 1, E)
+    if c.pos_emb == "learned":
+        x = x + params["wpe"].astype(dt)[positions][:, None, :]
+        rope_tables = None
+    else:
+        rope_tables = rope_frequencies(c.head_dim, c.max_seq, c.rope_theta)
+    lengths = positions + 1
+
+    def write_at(cache_bhsd, new_bh1d):
+        # scatter each example's new row at its own position
+        def one(cache_hsd, new_h1d, pos):
+            return jax.lax.dynamic_update_slice(cache_hsd, new_h1d, (0, pos, 0))
+
+        return jax.vmap(one)(cache_bhsd, new_bh1d, positions)
+
+    def block_fn(x, scanned):
+        lp, k_cache, v_cache = scanned
+        h = _norm(x, lp["ln1_scale"], lp.get("ln1_bias"), c.norm)
+        q = jnp.einsum("bse,ehd->bhsd", h, lp["wq"].astype(dt))
+        k = jnp.einsum("bse,ehd->bhsd", h, lp["wk"].astype(dt))
+        v = jnp.einsum("bse,ehd->bhsd", h, lp["wv"].astype(dt))
+        if c.use_bias:
+            q = q + lp["bq"].astype(dt)[None, :, None, :]
+            k = k + lp["bk"].astype(dt)[None, :, None, :]
+            v = v + lp["bv"].astype(dt)[None, :, None, :]
+        if rope_tables is not None:
+            cos, sin = rope_tables
+            pos2d = positions[:, None]
+            q = apply_rope(q, cos, sin, pos2d)
+            k = apply_rope(k, cos, sin, pos2d)
+        k_cache = write_at(k_cache, k.astype(c.dtype))
+        v_cache = write_at(v_cache, v.astype(c.dtype))
+        attn = _decode_attention(q, k_cache, v_cache, lengths)
+        out = jnp.einsum("bhsd,hde->bse", attn.astype(dt), lp["wo"].astype(dt))
+        if c.use_bias:
+            out = out + lp["bo"].astype(dt)
+        x = x + out
+        h = _norm(x, lp["ln2_scale"], lp.get("ln2_bias"), c.norm)
+        up = jnp.einsum("bse,ef->bsf", h, lp["w_up"].astype(dt))
+        if c.use_bias:
+            up = up + lp["b_up"].astype(dt)
+        if c.act == "swiglu":
+            act = swiglu(jnp.einsum("bse,ef->bsf", h, lp["w_gate"].astype(dt)), up)
+        else:
+            act = gelu(up)
+        down = jnp.einsum("bsf,fe->bse", act, lp["w_down"].astype(dt))
+        if c.use_bias:
+            down = down + lp["b_down"].astype(dt)
+        return x + down, (k_cache, v_cache)
+
+    x, (new_k, new_v) = jax.lax.scan(block_fn, x, (params["blocks"], cache["k"], cache["v"]))
+    x = _norm(x, params["lnf_scale"], params.get("lnf_bias"), c.norm)
+    head = params.get("lm_head", None)
+    if head is None:
+        head = params["wte"].T
+    logits = jnp.einsum("bse,ev->bsv", x, head.astype(dt))[:, 0]
+    return logits, {"k": new_k, "v": new_v}
+
+
+def prefill(
+    params: Params,
+    tokens: jax.Array,
+    lengths: jax.Array,
+    cache: Params,
+    config: TransformerConfig,
+) -> Tuple[jax.Array, Params]:
+    """Prompt ingestion: run the full-sequence path once, stash K/V into the
+    cache, return last-valid-token logits. tokens (B, S) right-padded;
+    lengths (B,) true prompt lengths."""
+    c = config
+    dt = c.dtype
+    b, s = tokens.shape
+    x = params["wte"].astype(dt)[tokens]
+    if c.pos_emb == "learned":
+        x = x + params["wpe"].astype(dt)[None, :s]
+        rope_tables = None
+    else:
+        rope_tables = rope_frequencies(c.head_dim, c.max_seq, c.rope_theta)
+
+    def block_fn(x, scanned):
+        lp, k_cache, v_cache = scanned
+        h = _norm(x, lp["ln1_scale"], lp.get("ln1_bias"), c.norm)
+        q = jnp.einsum("bse,ehd->bhsd", h, lp["wq"].astype(dt))
+        k = jnp.einsum("bse,ehd->bhsd", h, lp["wk"].astype(dt))
+        v = jnp.einsum("bse,ehd->bhsd", h, lp["wv"].astype(dt))
+        if c.use_bias:
+            q = q + lp["bq"].astype(dt)[None, :, None, :]
+            k = k + lp["bk"].astype(dt)[None, :, None, :]
+            v = v + lp["bv"].astype(dt)[None, :, None, :]
+        if rope_tables is not None:
+            cos, sin = rope_tables
+            q = apply_rope(q, cos, sin, None)
+            k = apply_rope(k, cos, sin, None)
+        # write the first S slots of the cache; padded tail is masked by
+        # `lengths` at decode time
+        k_cache = jax.lax.dynamic_update_slice(
+            k_cache, k.astype(c.dtype), (0, 0, 0, 0)
+        )
+        v_cache = jax.lax.dynamic_update_slice(
+            v_cache, v.astype(c.dtype), (0, 0, 0, 0)
+        )
+        attn = flash_attention(q, k, v, causal=True, implementation=c.attn_impl)
+        out = jnp.einsum("bhsd,hde->bse", attn, lp["wo"].astype(dt))
+        if c.use_bias:
+            out = out + lp["bo"].astype(dt)
+        x = x + out
+        h = _norm(x, lp["ln2_scale"], lp.get("ln2_bias"), c.norm)
+        up = jnp.einsum("bse,ef->bsf", h, lp["w_up"].astype(dt))
+        if c.use_bias:
+            up = up + lp["b_up"].astype(dt)
+        if c.act == "swiglu":
+            act = swiglu(jnp.einsum("bse,ef->bsf", h, lp["w_gate"].astype(dt)), up)
+        else:
+            act = gelu(up)
+        down = jnp.einsum("bsf,fe->bse", act, lp["w_down"].astype(dt))
+        if c.use_bias:
+            down = down + lp["b_down"].astype(dt)
+        return x + down, (k_cache, v_cache)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        block_fn, x, (params["blocks"], cache["k"], cache["v"])
+    )
+    x = _norm(x, params["lnf_scale"], params.get("lnf_bias"), c.norm)
+    head = params.get("lm_head", None)
+    if head is None:
+        head = params["wte"].T
+    logits = jnp.einsum("bse,ev->bsv", x, head.astype(dt))
+    last = jnp.take_along_axis(logits, (lengths - 1)[:, None, None], axis=1)[:, 0]
+    return last, {"k": new_k, "v": new_v}
