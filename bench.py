@@ -1,0 +1,83 @@
+"""Headline benchmark: GPT-2 124M training tokens/sec on one TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The reference publishes no absolute tokens/sec (BASELINE.md — scalability
+envelope only), so vs_baseline is measured MFU / 0.40: the ratio of this
+framework's model-flops utilization to a 40% MFU reference point, which is
+strong torch-GPU-stack territory for this model class. >1.0 beats it.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+BATCH = 8
+SEQ = 1024
+WARMUP_STEPS = 3
+MEASURE_STEPS = 20
+
+# peak bf16 FLOPs/s per chip for the platform we land on
+_PEAK_FLOPS = {
+    "TPU v5 lite": 197e12,  # v5e
+    "TPU v5e": 197e12,
+    "TPU v5p": 459e12,
+    "TPU v4": 275e12,
+    "TPU v6 lite": 918e12,  # v6e
+}
+
+
+def main() -> None:
+    from ray_tpu.models import count_params, get_config
+    from ray_tpu.parallel import MeshSpec, build_mesh
+    from ray_tpu.train import create_train_state, default_optimizer, make_train_step
+
+    config = get_config("gpt2-small")
+    devices = jax.devices()
+    mesh = build_mesh(MeshSpec(), devices=devices[:1])
+    opt = default_optimizer(3e-4, total_steps=1000)
+    state, shardings = create_train_state(config, opt, jax.random.PRNGKey(0), mesh)
+    step = make_train_step(config, opt, mesh, state_shardings=shardings)
+    n_params = count_params(state.params)
+
+    batch = {
+        "tokens": jax.random.randint(
+            jax.random.PRNGKey(1), (BATCH, SEQ + 1), 0, config.vocab_size
+        )
+    }
+
+    for _ in range(WARMUP_STEPS):
+        state, metrics = step(state, batch)
+    float(metrics["loss"])  # value fetch: block_until_ready is unreliable
+    # on tunneled-TPU platforms, so sync via an actual device read
+
+    t0 = time.perf_counter()
+    for _ in range(MEASURE_STEPS):
+        state, metrics = step(state, batch)
+    float(metrics["loss"])
+    elapsed = time.perf_counter() - t0
+
+    tokens_per_sec = MEASURE_STEPS * BATCH * SEQ / elapsed
+    # 6ND fwd+bwd matmul flops + attention term 12*L*H*S^2*Dh ~= small here
+    flops_per_token = 6 * n_params
+    device_kind = getattr(devices[0], "device_kind", "unknown")
+    peak = _PEAK_FLOPS.get(device_kind, 197e12)
+    mfu = tokens_per_sec * flops_per_token / peak
+    print(
+        json.dumps(
+            {
+                "metric": "gpt2_124m_train_tokens_per_sec_per_chip",
+                "value": round(tokens_per_sec, 1),
+                "unit": "tokens/s",
+                "vs_baseline": round(mfu / 0.40, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

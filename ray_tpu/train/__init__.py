@@ -1,0 +1,27 @@
+"""ray_tpu.train — multi-host SPMD training (Ray Train equivalent).
+
+Control plane: Trainer/TrainController/WorkerGroup actors with failure
+policies (reference train/v2). Compute plane: one jitted XLA program per
+step over a jax Mesh (lm.py) — FSDP/TP/DP are sharding annotations, the
+optimizer runs inside the program, checkpoints stream per-host via orbax.
+"""
+
+from .checkpoint import CheckpointManager  # noqa: F401
+from .config import (  # noqa: F401
+    CheckpointConfig,
+    FailureConfig,
+    RunConfig,
+    ScalingConfig,
+)
+from .controller import Result, RunStatus, TrainController  # noqa: F401
+from .lm import (  # noqa: F401
+    TrainState,
+    create_train_state,
+    default_optimizer,
+    infer_state_specs,
+    make_eval_step,
+    make_train_step,
+)
+from .session import get_context, get_session, report  # noqa: F401
+from .trainer import LMTrainer, Trainer  # noqa: F401
+from .worker_group import TrainWorker, WorkerGroup  # noqa: F401

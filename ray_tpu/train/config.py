@@ -1,0 +1,51 @@
+"""Train-layer configs (reference parity: air/config.py RunConfig/
+ScalingConfig/FailureConfig/CheckpointConfig; v2 scaling/failure policies
+train/v2/_internal/execution/scaling_policy/scaling_policy.py:29,
+failure_handling/failure_policy.py:14)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+from ..parallel.mesh import MeshSpec
+
+
+@dataclasses.dataclass
+class ScalingConfig:
+    """Gang shape. On TPU the unit is a host driving a slice of chips; the
+    mesh spec describes how those chips form dp/fsdp/tp/... axes."""
+
+    num_workers: int = 1
+    mesh: Optional[MeshSpec] = None
+    resources_per_worker: Optional[Dict[str, float]] = None
+    use_tpu: bool = False
+
+    def worker_resources(self) -> Dict[str, float]:
+        if self.resources_per_worker:
+            return dict(self.resources_per_worker)
+        return {"TPU": 1.0} if self.use_tpu else {"CPU": 1.0}
+
+
+@dataclasses.dataclass
+class FailureConfig:
+    """Retry budget (reference DefaultFailurePolicy default.py:13)."""
+
+    max_failures: int = 0  # 0 = fail fast; -1 = unlimited restarts
+
+
+@dataclasses.dataclass
+class CheckpointConfig:
+    checkpoint_dir: Optional[str] = None
+    max_to_keep: int = 3
+    checkpoint_every: int = 0  # steps; 0 = only on report(checkpoint=...)
+    async_save: bool = False
+
+
+@dataclasses.dataclass
+class RunConfig:
+    name: str = "train_run"
+    storage_path: Optional[str] = None
+    failure: FailureConfig = dataclasses.field(default_factory=FailureConfig)
+    checkpoint: CheckpointConfig = dataclasses.field(default_factory=CheckpointConfig)
+    extra: Dict[str, Any] = dataclasses.field(default_factory=dict)

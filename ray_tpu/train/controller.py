@@ -1,0 +1,147 @@
+"""TrainController: the run state machine (reference parity:
+train/v2/_internal/execution/controller/controller.py:91 — poll workers,
+aggregate reports, apply the failure policy, restart the gang from the last
+checkpoint)."""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from ..core.exceptions import ActorDiedError, RayTpuError, TaskError
+from .config import FailureConfig, RunConfig, ScalingConfig
+from .worker_group import WorkerGroup
+
+
+class RunStatus(enum.Enum):
+    PENDING = "PENDING"
+    RUNNING = "RUNNING"
+    RESTARTING = "RESTARTING"
+    FINISHED = "FINISHED"
+    ERRORED = "ERRORED"
+
+
+@dataclasses.dataclass
+class Result:
+    """What fit() returns (reference air Result)."""
+
+    metrics: Dict[str, Any]
+    metrics_history: List[Dict[str, Any]]
+    checkpoint_step: Optional[int]
+    status: RunStatus
+    error: Optional[str] = None
+    num_restarts: int = 0
+
+
+class FailurePolicy:
+    """Retry budget (reference DefaultFailurePolicy default.py:13)."""
+
+    def __init__(self, config: FailureConfig):
+        self.max_failures = config.max_failures
+        self.failures = 0
+
+    def should_restart(self) -> bool:
+        self.failures += 1
+        if self.max_failures < 0:
+            return True
+        return self.failures <= self.max_failures
+
+
+class TrainController:
+    """Drives one training run: start gang → poll → (maybe restart) → result."""
+
+    def __init__(
+        self,
+        train_fn: Callable,
+        scaling: ScalingConfig,
+        run_config: RunConfig,
+        train_config: Optional[Dict[str, Any]] = None,
+        poll_interval: float = 0.05,
+    ):
+        self.train_fn = train_fn
+        self.scaling = scaling
+        self.run_config = run_config
+        self.train_config = train_config
+        self.poll_interval = poll_interval
+        self.status = RunStatus.PENDING
+        self.metrics_history: List[Dict[str, Any]] = []
+        self.latest_checkpoint_step: Optional[int] = None
+        self.num_restarts = 0
+
+    def run(self) -> Result:
+        policy = FailurePolicy(self.run_config.failure)
+        error: Optional[str] = None
+        while True:
+            group = WorkerGroup(
+                self.scaling.num_workers,
+                self.scaling.worker_resources(),
+                run_name=self.run_config.name,
+            )
+            try:
+                group.start()
+                self.status = RunStatus.RUNNING
+                outcome = self._poll_until_done(group)
+                if outcome is None:  # clean finish
+                    self.status = RunStatus.FINISHED
+                    return self._result(None)
+                error = outcome
+            except (ActorDiedError, TaskError, RayTpuError) as e:
+                error = repr(e)
+            finally:
+                group.shutdown()
+
+            if policy.should_restart():
+                self.status = RunStatus.RESTARTING
+                self.num_restarts += 1
+                # the train_fn is responsible for resuming from
+                # latest_checkpoint_step (passed through train_config)
+                if self.train_config is not None:
+                    self.train_config["resume_from_step"] = self.latest_checkpoint_step
+                continue
+            self.status = RunStatus.ERRORED
+            return self._result(error)
+
+    def _poll_until_done(self, group: WorkerGroup) -> Optional[str]:
+        """Returns None on clean completion, error string on worker failure."""
+        result_refs = group.run_async(self.train_fn, self.train_config)
+        cursors = [0] * group.num_workers
+        while True:
+            try:
+                polls = group.poll(cursors)
+            except (ActorDiedError, TaskError) as e:
+                return repr(e)
+            for i, p in enumerate(polls):
+                for metrics, ckpt_step, rank, ts in p["reports"]:
+                    cursors[i] += 1
+                    if rank == 0:
+                        self.metrics_history.append(metrics)
+                    if ckpt_step is not None:
+                        self.latest_checkpoint_step = (
+                            ckpt_step
+                            if self.latest_checkpoint_step is None
+                            else max(self.latest_checkpoint_step, ckpt_step)
+                        )
+                if p["error"]:
+                    return p["error"]
+            if all(p["done"] for p in polls):
+                # surface any exception held by the run() refs
+                from .. import api
+
+                try:
+                    api.get(result_refs, timeout=10)
+                except (TaskError, ActorDiedError) as e:
+                    return repr(e)
+                return None
+            time.sleep(self.poll_interval)
+
+    def _result(self, error: Optional[str]) -> Result:
+        return Result(
+            metrics=self.metrics_history[-1] if self.metrics_history else {},
+            metrics_history=list(self.metrics_history),
+            checkpoint_step=self.latest_checkpoint_step,
+            status=self.status,
+            error=error,
+            num_restarts=self.num_restarts,
+        )

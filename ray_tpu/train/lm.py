@@ -1,0 +1,221 @@
+"""LM training compute core: sharded TrainState + jitted train/eval steps.
+
+Reference parity: the torch DDP/FSDP training loop that user code brings to
+Ray Train (/root/reference/python/ray/train/torch/config.py:153 sets up
+`dist.init_process_group`; the actual optimizer step is torch). TPU-native,
+the entire step — forward, backward, optimizer, grad clip — is ONE jitted
+XLA program over the mesh: FSDP/ZeRO-3 is the `fsdp` sharding on params and
+optimizer moments (XLA inserts the all-gathers/reduce-scatters), DP is the
+batch axis sharding, TP the head/mlp axes. No NCCL, no wrapper classes.
+
+`infer_state_specs` maps optimizer-state leaves to parameter PartitionSpecs
+by tree-path suffix matching, so any optax optimizer whose state mirrors the
+param tree (adam mu/nu, sgd momentum, ...) shards correctly without
+per-optimizer code.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..models.transformer import TransformerConfig, forward, init_params, logical_axes
+from ..ops import cross_entropy_loss
+from ..parallel.mesh import DATA_AXES
+from ..parallel.sharding import LogicalRules, default_rules, tree_specs
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class TrainState:
+    step: jax.Array
+    params: Any
+    opt_state: Any
+    rng: jax.Array
+
+
+# ------------------------------------------------------- state spec inference
+
+
+def _paths_and_leaves(tree):
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return [(tuple(str(k) for k in path), leaf) for path, leaf in flat]
+
+
+def infer_state_specs(abstract_state: Any, param_specs: Any) -> Any:
+    """PartitionSpec tree for a TrainState: params get their rule-derived
+    specs; optimizer-state leaves whose tree-path suffix matches a param
+    path (and whose shape matches) inherit that param's spec; everything
+    else (counts, scalars, rng) is replicated."""
+    param_flat = _paths_and_leaves(param_specs)
+    by_path: Dict[tuple, PartitionSpec] = {p: s for p, s in param_flat}
+
+    def spec_for(path: tuple, leaf) -> PartitionSpec:
+        for start in range(len(path)):
+            suffix = path[start:]
+            if suffix in by_path:
+                return by_path[suffix]
+        return PartitionSpec()
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(abstract_state)
+    specs = [
+        spec_for(tuple(str(k) for k in path), leaf) for path, leaf in flat
+    ]
+    return jax.tree_util.tree_unflatten(treedef, specs)
+
+
+def _sharding_tree(spec_tree: Any, mesh: Mesh) -> Any:
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, s),
+        spec_tree,
+        is_leaf=lambda x: isinstance(x, PartitionSpec),
+    )
+
+
+# --------------------------------------------------------------- constructors
+
+
+def default_optimizer(
+    learning_rate: float = 3e-4,
+    *,
+    warmup_steps: int = 100,
+    total_steps: int = 10_000,
+    weight_decay: float = 0.1,
+    b1: float = 0.9,
+    b2: float = 0.95,
+    grad_clip: float = 1.0,
+) -> optax.GradientTransformation:
+    """AdamW + cosine schedule + global-norm clip (the GPT/Llama recipe)."""
+    schedule = optax.warmup_cosine_decay_schedule(
+        init_value=0.0,
+        peak_value=learning_rate,
+        warmup_steps=warmup_steps,
+        decay_steps=max(total_steps, warmup_steps + 1),
+        end_value=learning_rate * 0.1,
+    )
+    return optax.chain(
+        optax.clip_by_global_norm(grad_clip),
+        optax.adamw(schedule, b1=b1, b2=b2, weight_decay=weight_decay),
+    )
+
+
+def create_train_state(
+    config: TransformerConfig,
+    optimizer: optax.GradientTransformation,
+    key: jax.Array,
+    mesh: Mesh,
+    rules: Optional[LogicalRules] = None,
+) -> Tuple[TrainState, Any]:
+    """Initialize a TrainState directly into its sharded layout: init runs
+    under jit with out_shardings, so each device materializes only its
+    shard — an 8B model initializes without ever forming a host copy.
+
+    Returns (state, state_shardings)."""
+    rules = rules or default_rules()
+    param_specs = tree_specs(logical_axes(config), rules)
+
+    def build(k):
+        params = init_params(config, k)
+        return TrainState(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            opt_state=optimizer.init(params),
+            rng=jax.random.fold_in(k, 1),
+        )
+
+    abstract = jax.eval_shape(build, key)
+    spec_tree = infer_state_specs(abstract, param_specs)
+    # the params subtree must carry the full rule-derived specs
+    spec_tree = dataclasses.replace(spec_tree, params=param_specs)
+    shardings = _sharding_tree(spec_tree, mesh)
+    state = jax.jit(build, out_shardings=shardings)(key)
+    return state, shardings
+
+
+def make_train_step(
+    config: TransformerConfig,
+    optimizer: optax.GradientTransformation,
+    mesh: Mesh,
+    *,
+    state_shardings: Any,
+    z_loss_coeff: float = 0.0,
+    grad_accum: int = 1,
+) -> Callable[[TrainState, Dict[str, jax.Array]], Tuple[TrainState, Dict[str, jax.Array]]]:
+    """One jitted SPMD training step. batch = {"tokens": (B, S+1) int32,
+    optional "mask": (B, S)} sharded batch-over-data-axes. TrainState is
+    donated: params/moments update in place in HBM."""
+    batch_sharding = NamedSharding(mesh, PartitionSpec(DATA_AXES, None))
+    metric_sharding = NamedSharding(mesh, PartitionSpec())
+
+    def loss_fn(params, tokens):
+        logits = forward(params, tokens[:, :-1], config)
+        targets = tokens[:, 1:]
+        loss, ntok = cross_entropy_loss(logits, targets, z_loss_coeff=z_loss_coeff)
+        return loss, ntok
+
+    def microbatch_grads(params, tokens):
+        if grad_accum == 1:
+            (loss, ntok), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, tokens
+            )
+            return loss, ntok, grads
+
+        mb_tokens = tokens.reshape(
+            grad_accum, tokens.shape[0] // grad_accum, *tokens.shape[1:]
+        )
+
+        def body(carry, mb):
+            acc_loss, acc_ntok, acc_grads = carry
+            (loss, ntok), grads = jax.value_and_grad(loss_fn, has_aux=True)(params, mb)
+            acc_grads = jax.tree.map(jnp.add, acc_grads, grads)
+            return (acc_loss + loss, acc_ntok + ntok, acc_grads), None
+
+        zero_grads = jax.tree.map(jnp.zeros_like, params)
+        (total_loss, total_ntok, grads), _ = jax.lax.scan(
+            body, (jnp.zeros(()), jnp.zeros(()), zero_grads), mb_tokens
+        )
+        scale = 1.0 / grad_accum
+        return total_loss * scale, total_ntok, jax.tree.map(lambda g: g * scale, grads)
+
+    def step_fn(state: TrainState, batch: Dict[str, jax.Array]):
+        tokens = batch["tokens"]
+        loss, ntok, grads = microbatch_grads(state.params, tokens)
+        updates, new_opt = optimizer.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        gnorm = optax.global_norm(grads)
+        new_state = TrainState(
+            step=state.step + 1,
+            params=new_params,
+            opt_state=new_opt,
+            rng=jax.random.fold_in(state.rng, state.step),
+        )
+        metrics = {
+            "loss": loss.astype(jnp.float32),
+            "grad_norm": gnorm.astype(jnp.float32),
+            "num_tokens": ntok.astype(jnp.float32),
+        }
+        return new_state, metrics
+
+    return jax.jit(
+        step_fn,
+        in_shardings=(state_shardings, {"tokens": batch_sharding}),
+        out_shardings=(state_shardings, {k: metric_sharding for k in ("loss", "grad_norm", "num_tokens")}),
+        donate_argnums=(0,),
+    )
+
+
+def make_eval_step(config: TransformerConfig, mesh: Mesh, state_shardings: Any):
+    batch_sharding = NamedSharding(mesh, PartitionSpec(DATA_AXES, None))
+
+    def eval_fn(state: TrainState, batch):
+        tokens = batch["tokens"]
+        logits = forward(state.params, tokens[:, :-1], config)
+        loss, ntok = cross_entropy_loss(logits, tokens[:, 1:])
+        return {"eval_loss": loss.astype(jnp.float32), "num_tokens": ntok}
+
+    return jax.jit(eval_fn, in_shardings=(state_shardings, {"tokens": batch_sharding}))

@@ -1,0 +1,81 @@
+"""Per-worker train session: report()/get_context() (reference parity:
+ray.train.report + TrainContext, train/_internal/session.py)."""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+
+@dataclasses.dataclass
+class TrainContext:
+    world_rank: int
+    world_size: int
+    run_name: str
+    trial_dir: Optional[str] = None
+
+
+@dataclasses.dataclass
+class Report:
+    metrics: Dict[str, Any]
+    checkpoint_step: Optional[int]
+    world_rank: int
+    time: float
+
+
+class Session:
+    """Accumulates worker reports; the controller polls them off."""
+
+    def __init__(self, context: TrainContext):
+        self.context = context
+        self._reports: List[Report] = []
+        self._lock = threading.Lock()
+
+    def report(self, metrics: Dict[str, Any], checkpoint_step: Optional[int] = None) -> None:
+        with self._lock:
+            self._reports.append(
+                Report(
+                    metrics=dict(metrics),
+                    checkpoint_step=checkpoint_step,
+                    world_rank=self.context.world_rank,
+                    time=time.time(),
+                )
+            )
+
+    def drain(self, since: int) -> List[Report]:
+        with self._lock:
+            return self._reports[since:]
+
+    @property
+    def num_reports(self) -> int:
+        with self._lock:
+            return len(self._reports)
+
+
+_local = threading.local()
+
+
+def _set_session(session: Optional[Session]) -> None:
+    _local.session = session
+
+
+def get_session() -> Session:
+    session = getattr(_local, "session", None)
+    if session is None:
+        raise RuntimeError(
+            "no active train session — report()/get_context() are only valid "
+            "inside a train_loop_per_worker"
+        )
+    return session
+
+
+def report(metrics: Dict[str, Any], checkpoint_step: Optional[int] = None) -> None:
+    """ray.train.report equivalent: stream metrics (and optionally note a
+    completed checkpoint step) to the controller."""
+    get_session().report(metrics, checkpoint_step)
+
+
+def get_context() -> TrainContext:
+    return get_session().context

@@ -1,0 +1,165 @@
+"""User-facing trainers.
+
+- `Trainer`: generic gang trainer — run any train_loop_per_worker on N
+  actors with failure handling (reference parity: DataParallelTrainer,
+  train/data_parallel_trainer.py:26).
+- `LMTrainer`: the flagship TPU path — one SPMD pjit program per step over
+  a mesh, driven host-side; checkpoint/resume via orbax; metrics via
+  session.report. On multi-host TPU each host runs this same loop
+  (jax.distributed), with the controller gang providing per-host processes.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, Iterable, Optional
+
+import jax
+import numpy as np
+
+from ..models.transformer import TransformerConfig, count_params
+from ..parallel.mesh import MeshSpec, build_mesh
+from ..parallel.sharding import default_rules
+from .checkpoint import CheckpointManager
+from .config import CheckpointConfig, RunConfig, ScalingConfig
+from .controller import Result, TrainController
+from .lm import create_train_state, default_optimizer, make_train_step
+
+
+class Trainer:
+    """Generic gang trainer: `fit()` = start controller, return Result."""
+
+    def __init__(
+        self,
+        train_loop_per_worker: Callable,
+        *,
+        scaling_config: Optional[ScalingConfig] = None,
+        run_config: Optional[RunConfig] = None,
+        train_loop_config: Optional[Dict[str, Any]] = None,
+    ):
+        self.train_fn = train_loop_per_worker
+        self.scaling = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig()
+        self.train_config = train_loop_config
+
+    def fit(self) -> Result:
+        controller = TrainController(
+            self.train_fn, self.scaling, self.run_config, self.train_config
+        )
+        return controller.run()
+
+
+class LMTrainer:
+    """Language-model trainer: jitted sharded step + data iterator + ckpt.
+
+    This is deliberately a *host-side object*, not an actor: the hot loop is
+    the XLA program; Python only feeds batches and drains metrics.
+    """
+
+    def __init__(
+        self,
+        config: TransformerConfig,
+        *,
+        mesh_spec: Optional[MeshSpec] = None,
+        optimizer=None,
+        learning_rate: float = 3e-4,
+        total_steps: int = 1000,
+        grad_accum: int = 1,
+        z_loss_coeff: float = 0.0,
+        checkpoint_config: Optional[CheckpointConfig] = None,
+        rules=None,
+        seed: int = 0,
+    ):
+        self.config = config
+        n_dev = len(jax.devices())
+        self.mesh = build_mesh(mesh_spec or MeshSpec().with_devices(n_dev))
+        self.rules = rules or default_rules()
+        self.optimizer = optimizer or default_optimizer(
+            learning_rate, total_steps=total_steps
+        )
+        self.total_steps = total_steps
+        self.state, self.state_shardings = create_train_state(
+            self.config, self.optimizer, jax.random.PRNGKey(seed), self.mesh, self.rules
+        )
+        self.step_fn = make_train_step(
+            self.config,
+            self.optimizer,
+            self.mesh,
+            state_shardings=self.state_shardings,
+            z_loss_coeff=z_loss_coeff,
+            grad_accum=grad_accum,
+        )
+        self.ckpt_config = checkpoint_config
+        self.ckpt_mgr: Optional[CheckpointManager] = None
+        if checkpoint_config and checkpoint_config.checkpoint_dir:
+            self.ckpt_mgr = CheckpointManager(
+                checkpoint_config.checkpoint_dir,
+                max_to_keep=checkpoint_config.max_to_keep,
+                async_save=checkpoint_config.async_save,
+            )
+
+    @property
+    def num_params(self) -> int:
+        return count_params(self.state.params)
+
+    def restore(self, step: Optional[int] = None) -> int:
+        """Resume from a checkpoint; returns the restored step."""
+        if self.ckpt_mgr is None:
+            raise RuntimeError("no checkpoint_dir configured")
+        self.state = self.ckpt_mgr.restore(self.state, step)
+        return int(self.state.step)
+
+    def maybe_restore(self) -> Optional[int]:
+        if self.ckpt_mgr is not None and self.ckpt_mgr.latest_step() is not None:
+            return self.restore()
+        return None
+
+    def train(
+        self,
+        batches: Iterable[Dict[str, Any]],
+        *,
+        num_steps: Optional[int] = None,
+        report_every: int = 10,
+        report_fn: Optional[Callable[[Dict[str, Any]], None]] = None,
+    ) -> Dict[str, Any]:
+        """Drive the step over a batch iterator. Returns final metrics incl.
+        tokens/sec. `report_fn` defaults to session.report when inside a
+        worker, else a no-op."""
+        if report_fn is None:
+            from .session import _local
+
+            session = getattr(_local, "session", None)
+            report_fn = session.report if session is not None else (lambda m: None)
+
+        ckpt_every = self.ckpt_config.checkpoint_every if self.ckpt_config else 0
+        t0 = time.perf_counter()
+        tokens_done = 0.0
+        last_metrics: Dict[str, Any] = {}
+        steps = 0
+        for batch in batches:
+            if num_steps is not None and steps >= num_steps:
+                break
+            tokens = batch["tokens"]
+            if isinstance(tokens, np.ndarray):
+                batch = {"tokens": jax.numpy.asarray(tokens)}
+            self.state, metrics = self.step_fn(self.state, batch)
+            steps += 1
+            tokens_done += float(tokens.shape[0] * (tokens.shape[1] - 1))
+            if steps % report_every == 0 or (num_steps is not None and steps == num_steps):
+                metrics = {k: float(v) for k, v in metrics.items()}
+                elapsed = time.perf_counter() - t0
+                metrics["tokens_per_sec"] = tokens_done / max(elapsed, 1e-9)
+                metrics["step"] = int(self.state.step)
+                last_metrics = metrics
+                report_fn(metrics)
+            if ckpt_every and steps % ckpt_every == 0 and self.ckpt_mgr is not None:
+                self.save_checkpoint()
+        if self.ckpt_mgr is not None and self.ckpt_config.checkpoint_every:
+            self.save_checkpoint()
+            self.ckpt_mgr.wait_until_finished()
+        return last_metrics
+
+    def save_checkpoint(self) -> int:
+        step = int(jax.device_get(self.state.step))
+        self.ckpt_mgr.save(step, self.state)
+        return step

@@ -1,0 +1,119 @@
+"""Gang of train-worker actors (reference parity: WorkerGroup + RayTrainWorker,
+train/_internal/worker_group.py:19,102; gang scheduling via a PACK placement
+group, backend_executor.py:230).
+
+Each worker actor hosts the user's train loop in one thread and stays
+responsive to polls on a second (max_concurrency=2 — the same split the
+reference gets from its session thread)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+from .. import api
+from ..core.scheduler import PlacementGroup
+from .session import Session, TrainContext, _set_session
+
+
+class TrainWorker:
+    """Actor body. Created via api.remote inside WorkerGroup.start()."""
+
+    def __init__(self, rank: int, world_size: int, run_name: str):
+        self._context = TrainContext(
+            world_rank=rank, world_size=world_size, run_name=run_name
+        )
+        self._session = Session(self._context)
+        self._done = False
+        self._error: Optional[str] = None
+
+    def run(self, train_fn: Callable, config: Dict[str, Any]):
+        _set_session(self._session)
+        try:
+            result = train_fn(config) if config is not None else train_fn()
+            self._done = True
+            return result
+        except BaseException as e:
+            self._error = repr(e)
+            self._done = True
+            raise
+        finally:
+            _set_session(None)
+
+    def poll(self, since: int):
+        reports = self._session.drain(since)
+        return {
+            "reports": [
+                (r.metrics, r.checkpoint_step, r.world_rank, r.time) for r in reports
+            ],
+            "done": self._done,
+            "error": self._error,
+        }
+
+    def rank(self) -> int:
+        return self._context.world_rank
+
+    def ping(self) -> str:
+        return "ok"
+
+
+class WorkerGroup:
+    """N gang-scheduled TrainWorker actors + their placement group."""
+
+    def __init__(
+        self,
+        num_workers: int,
+        resources_per_worker: Dict[str, float],
+        run_name: str = "train_run",
+    ):
+        self.num_workers = num_workers
+        self.resources_per_worker = resources_per_worker
+        self.run_name = run_name
+        self.pg: Optional[PlacementGroup] = None
+        self.workers: List[Any] = []
+
+    def start(self) -> None:
+        bundles = [dict(self.resources_per_worker) for _ in range(self.num_workers)]
+        self.pg = api.placement_group(bundles, strategy="PACK")
+        if not self.pg.ready(timeout=30):
+            raise TimeoutError(
+                f"placement group for {self.run_name} not placed within 30s"
+            )
+        actor_cls = api.remote(TrainWorker)
+        from ..core.scheduler import PlacementGroupSchedulingStrategy
+
+        self.workers = [
+            actor_cls.options(
+                max_concurrency=2,
+                resources=dict(self.resources_per_worker),
+                num_cpus=0,
+                scheduling_strategy=PlacementGroupSchedulingStrategy(
+                    placement_group=self.pg, placement_group_bundle_index=i
+                ),
+                name=f"{self.run_name}-worker-{i}",
+            ).remote(i, self.num_workers, self.run_name)
+            for i in range(self.num_workers)
+        ]
+        api.get([w.ping.remote() for w in self.workers], timeout=30)
+
+    def run_async(self, train_fn: Callable, config: Optional[Dict[str, Any]]):
+        """Kick off the loop on every worker; returns the result refs."""
+        return [w.run.remote(train_fn, config) for w in self.workers]
+
+    def poll(self, since: List[int]):
+        return api.get(
+            [w.poll.remote(s) for w, s in zip(self.workers, since)], timeout=60
+        )
+
+    def shutdown(self) -> None:
+        for w in self.workers:
+            try:
+                api.kill(w)
+            except Exception:
+                pass
+        if self.pg is not None:
+            try:
+                api.remove_placement_group(self.pg)
+            except Exception:
+                pass
+        self.workers = []
+        self.pg = None

@@ -1,0 +1,26 @@
+"""The driver contract: entry() compiles; dryrun_multichip runs on 8 devices."""
+
+import sys
+
+sys.path.insert(0, "/root/repo")
+
+import jax
+import pytest
+
+
+def test_dryrun_multichip_8():
+    import __graft_entry__ as g
+
+    g.dryrun_multichip(8)
+
+
+def test_entry_compiles_tiny():
+    # entry() uses the 124M flagship — too slow for CPU CI, so check the
+    # factorization helper + that entry is importable and well-formed.
+    import __graft_entry__ as g
+
+    spec = g._mesh_spec_for(8)
+    assert spec.num_devices == 8
+    spec1 = g._mesh_spec_for(1)
+    assert spec1.num_devices == 1
+    assert callable(g.entry)

@@ -1,0 +1,184 @@
+"""Train layer: sharded state, train step convergence, checkpoint, controller."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec
+
+import ray_tpu
+from ray_tpu.models import get_config
+from ray_tpu.parallel import MeshSpec, build_mesh, default_rules
+from ray_tpu.train import (
+    CheckpointConfig,
+    FailureConfig,
+    LMTrainer,
+    Result,
+    RunConfig,
+    RunStatus,
+    ScalingConfig,
+    Trainer,
+    create_train_state,
+    default_optimizer,
+    make_train_step,
+)
+
+
+@pytest.fixture
+def mesh8():
+    return build_mesh(MeshSpec(dp=2, fsdp=2, tp=2))
+
+
+def _batches(key, n, batch, seq, vocab):
+    for i in range(n):
+        key, sub = jax.random.split(key)
+        yield {"tokens": jax.random.randint(sub, (batch, seq + 1), 0, vocab)}
+
+
+def test_state_shardings_cover_optimizer_moments(mesh8):
+    config = get_config("llama-tiny")
+    opt = default_optimizer(1e-3, total_steps=10)
+    state, shardings = create_train_state(
+        config, opt, jax.random.PRNGKey(0), mesh8, default_rules()
+    )
+    # adam mu/nu must inherit the param specs (fsdp/tp), not be replicated
+    mu = state.opt_state[1][0].mu
+    assert mu["blocks"]["w_up"].sharding.spec == PartitionSpec(None, "fsdp", "tp")
+    assert state.params["blocks"]["w_up"].sharding.spec == PartitionSpec(None, "fsdp", "tp")
+    # scalars replicated
+    assert state.step.sharding.spec == PartitionSpec()
+
+
+def test_train_step_reduces_loss(mesh8):
+    config = get_config("gpt2-tiny")
+    opt = default_optimizer(1e-2, warmup_steps=2, total_steps=40)
+    state, shardings = create_train_state(
+        config, opt, jax.random.PRNGKey(0), mesh8, default_rules()
+    )
+    step = make_train_step(config, opt, mesh8, state_shardings=shardings)
+    # one fixed batch: loss must drop when overfitting it
+    batch = {"tokens": jax.random.randint(jax.random.PRNGKey(1), (8, 33), 0, config.vocab_size)}
+    losses = []
+    for _ in range(30):
+        state, metrics = step(state, batch)
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0] * 0.7, losses[:3] + losses[-3:]
+    assert int(state.step) == 30
+
+
+def test_grad_accum_matches_big_batch(mesh8):
+    config = get_config("gpt2-tiny")
+    opt = default_optimizer(1e-3, total_steps=10)
+    tokens = jax.random.randint(jax.random.PRNGKey(2), (8, 17), 0, config.vocab_size)
+
+    state1, sh = create_train_state(config, opt, jax.random.PRNGKey(0), mesh8)
+    step1 = make_train_step(config, opt, mesh8, state_shardings=sh)
+    state1, m1 = step1(state1, {"tokens": tokens})
+
+    state2, sh2 = create_train_state(config, opt, jax.random.PRNGKey(0), mesh8)
+    step2 = make_train_step(config, opt, mesh8, state_shardings=sh2, grad_accum=2)
+    state2, m2 = step2(state2, {"tokens": tokens})
+
+    np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]), rtol=1e-5)
+    p1 = jax.tree.leaves(state1.params)[0]
+    p2 = jax.tree.leaves(state2.params)[0]
+    np.testing.assert_allclose(np.asarray(p1), np.asarray(p2), atol=1e-5)
+
+
+def test_lm_trainer_with_checkpoint_resume(tmp_path, mesh8):
+    config = get_config("gpt2-tiny")
+    ckpt = CheckpointConfig(checkpoint_dir=str(tmp_path / "ckpt"), checkpoint_every=5)
+    trainer = LMTrainer(
+        config,
+        mesh_spec=MeshSpec(dp=2, fsdp=2, tp=2),
+        learning_rate=1e-3,
+        total_steps=10,
+        checkpoint_config=ckpt,
+    )
+    metrics = trainer.train(
+        _batches(jax.random.PRNGKey(0), 10, 8, 16, config.vocab_size),
+        num_steps=10,
+        report_every=5,
+    )
+    assert metrics["step"] == 10
+    assert metrics["tokens_per_sec"] > 0
+    assert trainer.ckpt_mgr.latest_step() == 10
+
+    # new trainer resumes from step 10
+    trainer2 = LMTrainer(
+        config,
+        mesh_spec=MeshSpec(dp=2, fsdp=2, tp=2),
+        learning_rate=1e-3,
+        total_steps=10,
+        checkpoint_config=ckpt,
+    )
+    restored = trainer2.maybe_restore()
+    assert restored == 10
+    p1 = jax.tree.leaves(trainer.state.params)[0]
+    p2 = jax.tree.leaves(trainer2.state.params)[0]
+    np.testing.assert_allclose(np.asarray(p1), np.asarray(p2))
+
+
+def test_gang_trainer_reports_and_finishes(runtime):
+    def loop(config):
+        from ray_tpu import train
+
+        ctx = train.get_context()
+        for i in range(3):
+            train.report({"step": i, "rank": ctx.world_rank})
+        return "done"
+
+    trainer = Trainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="t1"),
+        train_loop_config={},
+    )
+    result = trainer.fit()
+    assert result.status == RunStatus.FINISHED
+    assert len(result.metrics_history) == 3  # rank-0 reports only
+    assert result.metrics["step"] == 2
+
+
+def test_gang_trainer_failure_fast(runtime):
+    def loop(config):
+        from ray_tpu import train
+
+        train.report({"step": 0})
+        raise RuntimeError("worker exploded")
+
+    trainer = Trainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="t2", failure=FailureConfig(max_failures=0)),
+        train_loop_config={},
+    )
+    result = trainer.fit()
+    assert result.status == RunStatus.ERRORED
+    assert "exploded" in result.error
+
+
+def test_gang_trainer_restarts_then_succeeds(runtime, tmp_path):
+    marker = tmp_path / "attempt"
+
+    def loop(config):
+        from ray_tpu import train
+
+        n = int(marker.read_text()) if marker.exists() else 0
+        marker.write_text(str(n + 1))
+        if n == 0:
+            raise RuntimeError("first attempt dies")
+        train.report({"attempt": n})
+        return "ok"
+
+    trainer = Trainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(name="t3", failure=FailureConfig(max_failures=2)),
+        train_loop_config={},
+    )
+    result = trainer.fit()
+    assert result.status == RunStatus.FINISHED
+    assert result.num_restarts == 1
+    assert result.metrics["attempt"] == 1
