@@ -1,0 +1,27 @@
+"""ray_tpu.data — streaming datasets (Ray Data equivalent).
+
+Lazy plans over columnar numpy blocks, executed as backpressured task
+streams on the runtime; device-prefetching batch iterators feed TPU HBM.
+"""
+
+from .block import (  # noqa: F401
+    Block,
+    batches_from_blocks,
+    block_concat,
+    block_from_items,
+    block_num_rows,
+    block_slice,
+    block_to_items,
+)
+from .dataset import (  # noqa: F401
+    DataContext,
+    DataIterator,
+    Dataset,
+    from_items,
+    from_numpy,
+    range,
+    read_npy,
+    read_parquet,
+    read_text,
+)
+from .lm import lm_batch_iterator, pack_tokens  # noqa: F401

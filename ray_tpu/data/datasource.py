@@ -1,0 +1,153 @@
+"""Datasources: lazy readers that yield read tasks (reference parity:
+python/ray/data/_internal/datasource/* — 35+ sources; here the core set,
+each a list of zero-arg callables so reads run as parallel runtime tasks).
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import os
+from typing import Any, Callable, List, Optional, Sequence
+
+import numpy as np
+
+from .block import Block, block_from_items
+
+ReadTask = Callable[[], Block]
+
+
+class Datasource:
+    def read_tasks(self) -> List[ReadTask]:
+        raise NotImplementedError
+
+    def estimated_num_rows(self) -> Optional[int]:
+        return None
+
+
+class RangeSource(Datasource):
+    def __init__(self, n: int, num_blocks: int = 8):
+        self.n = n
+        self.num_blocks = max(1, min(num_blocks, n)) if n else 1
+
+    def read_tasks(self) -> List[ReadTask]:
+        edges = np.linspace(0, self.n, self.num_blocks + 1, dtype=np.int64)
+
+        def make(lo: int, hi: int) -> ReadTask:
+            return lambda: {"item": np.arange(lo, hi)}
+
+        return [make(int(lo), int(hi)) for lo, hi in zip(edges[:-1], edges[1:]) if hi > lo]
+
+    def estimated_num_rows(self) -> Optional[int]:
+        return self.n
+
+
+class ItemsSource(Datasource):
+    def __init__(self, items: Sequence[Any], num_blocks: int = 8):
+        self.items = list(items)
+        self.num_blocks = max(1, min(num_blocks, len(self.items) or 1))
+
+    def read_tasks(self) -> List[ReadTask]:
+        chunks = np.array_split(np.arange(len(self.items)), self.num_blocks)
+
+        def make(idx: np.ndarray) -> ReadTask:
+            rows = [self.items[i] for i in idx]
+            return lambda: block_from_items(rows)
+
+        return [make(c) for c in chunks if len(c)]
+
+    def estimated_num_rows(self) -> Optional[int]:
+        return len(self.items)
+
+
+class NumpySource(Datasource):
+    def __init__(self, arrays: dict, num_blocks: int = 8):
+        self.arrays = {k: np.asarray(v) for k, v in arrays.items()}
+        n = len(next(iter(self.arrays.values())))
+        self.num_blocks = max(1, min(num_blocks, n or 1))
+
+    def read_tasks(self) -> List[ReadTask]:
+        n = len(next(iter(self.arrays.values())))
+        edges = np.linspace(0, n, self.num_blocks + 1, dtype=np.int64)
+
+        def make(lo: int, hi: int) -> ReadTask:
+            chunk = {k: v[lo:hi] for k, v in self.arrays.items()}
+            return lambda: chunk
+
+        return [make(int(lo), int(hi)) for lo, hi in zip(edges[:-1], edges[1:]) if hi > lo]
+
+
+class TextSource(Datasource):
+    """One block per file; column 'text' of lines."""
+
+    def __init__(self, paths: Sequence[str]):
+        self.paths = _expand(paths)
+
+    def read_tasks(self) -> List[ReadTask]:
+        def make(path: str) -> ReadTask:
+            def read() -> Block:
+                with open(path, "r") as f:
+                    lines = [ln.rstrip("\n") for ln in f]
+                return {"text": np.asarray(lines, dtype=object)}
+
+            return read
+
+        return [make(p) for p in self.paths]
+
+
+class NpyFileSource(Datasource):
+    """One block per .npy file; column name configurable (token shards)."""
+
+    def __init__(self, paths: Sequence[str], column: str = "tokens"):
+        self.paths = _expand(paths)
+        self.column = column
+
+    def read_tasks(self) -> List[ReadTask]:
+        def make(path: str) -> ReadTask:
+            return lambda: {self.column: np.load(path)}
+
+        return [make(p) for p in self.paths]
+
+
+class ParquetSource(Datasource):
+    """One block per row-group (pyarrow gated — see read_parquet)."""
+
+    def __init__(self, paths: Sequence[str], columns: Optional[List[str]] = None):
+        self.paths = _expand(paths)
+        self.columns = columns
+
+    def read_tasks(self) -> List[ReadTask]:
+        import pyarrow.parquet as pq  # gated import
+
+        tasks: List[ReadTask] = []
+        for path in self.paths:
+            num_rgs = pq.ParquetFile(path).metadata.num_row_groups
+
+            def make(path: str, rg: int) -> ReadTask:
+                def read() -> Block:
+                    table = pq.ParquetFile(path).read_row_group(rg, columns=self.columns)
+                    return {
+                        name: col.to_numpy(zero_copy_only=False)
+                        for name, col in zip(table.column_names, table.columns)
+                    }
+
+                return read
+
+            tasks.extend(make(path, rg) for rg in range(num_rgs))
+        return tasks
+
+
+def _expand(paths: Sequence[str]) -> List[str]:
+    if isinstance(paths, (str, os.PathLike)):
+        paths = [paths]
+    out: List[str] = []
+    for p in paths:
+        p = os.fspath(p)
+        if any(ch in p for ch in "*?["):
+            out.extend(sorted(_glob.glob(p)))
+        elif os.path.isdir(p):
+            out.extend(sorted(os.path.join(p, f) for f in os.listdir(p)))
+        else:
+            out.append(p)
+    if not out:
+        raise FileNotFoundError(f"no files matched {paths!r}")
+    return out

@@ -1,0 +1,72 @@
+"""LM ingest: token packing for next-token training.
+
+The glue between ray_tpu.data streams and ray_tpu.train's (B, S+1) token
+batches: documents → one flat token stream → fixed-length windows, the
+standard GPT pretraining packing (no padding, every position supervised).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, Optional
+
+import numpy as np
+
+from .block import Block, block_num_rows
+from .dataset import Dataset
+
+
+def pack_tokens(
+    blocks: Iterator[Block],
+    seq_len: int,
+    batch_size: int,
+    *,
+    column: str = "tokens",
+    drop_last: bool = True,
+) -> Iterator[Dict[str, np.ndarray]]:
+    """Pack a stream of token blocks into (batch_size, seq_len + 1) windows.
+
+    Accepts blocks whose `column` is either a 1-D token stream or a ragged
+    object array of per-document token lists; documents are concatenated
+    (add separators upstream via map_batches if wanted).
+    """
+    window = seq_len + 1
+    buf = np.empty(0, dtype=np.int32)
+    rows = []
+    for block in blocks:
+        col = block[column]
+        if col.dtype == object:
+            flat = np.concatenate([np.asarray(x, dtype=np.int32) for x in col]) if len(col) else np.empty(0, np.int32)
+        else:
+            flat = np.asarray(col, dtype=np.int32).reshape(-1)
+        buf = np.concatenate([buf, flat])
+        while len(buf) >= window:
+            n_rows = len(buf) // window
+            take = buf[: n_rows * window].reshape(n_rows, window)
+            buf = buf[n_rows * window:]
+            for r in take:
+                rows.append(r)
+                if len(rows) == batch_size:
+                    yield {"tokens": np.stack(rows)}
+                    rows = []
+    if rows and not drop_last:
+        yield {"tokens": np.stack(rows)}
+
+
+def lm_batch_iterator(
+    dataset_or_iterator: Any,
+    seq_len: int,
+    batch_size: int,
+    *,
+    column: str = "tokens",
+    sharding=None,
+) -> Iterator[Dict[str, Any]]:
+    """Device-ready LM batches from a Dataset or a streaming_split
+    DataIterator — feed straight into LMTrainer.train()."""
+    import jax
+
+    blocks = dataset_or_iterator.iter_blocks()
+    for batch in pack_tokens(blocks, seq_len, batch_size, column=column):
+        if sharding is not None:
+            yield {"tokens": jax.device_put(batch["tokens"], sharding)}
+        else:
+            yield {"tokens": jax.numpy.asarray(batch["tokens"])}

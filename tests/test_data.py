@@ -1,0 +1,156 @@
+"""Data layer: plans, streaming execution, splits, LM packing, train feed."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rd
+
+
+@pytest.fixture(autouse=True)
+def rt():
+    runtime = ray_tpu.init(num_cpus=8, detect_accelerators=False)
+    yield runtime
+    ray_tpu.shutdown()
+
+
+def test_range_count_take():
+    ds = rd.range(100, num_blocks=7)
+    assert ds.count() == 100
+    assert ds.take(5) == [0, 1, 2, 3, 4]
+
+
+def test_map_and_filter():
+    ds = rd.range(20).map(lambda x: x * 2).filter(lambda x: x % 8 == 0)
+    rows = sorted(ds.take(100))
+    assert rows == [0, 8, 16, 24, 32]
+
+
+def test_map_batches_columnar():
+    ds = rd.from_numpy({"x": np.arange(32)}, num_blocks=4)
+    out = ds.map_batches(lambda b: {"y": b["x"] + 1})
+    assert sorted(np.concatenate([b["y"] for b in out.iter_blocks()]).tolist()) == list(
+        range(1, 33)
+    )
+
+
+def test_iter_batches_across_block_boundaries():
+    ds = rd.range(25, num_blocks=4)
+    batches = list(ds.iter_batches(batch_size=10))
+    sizes = [rd.block_num_rows(b) for b in batches]
+    assert sizes == [10, 10, 5]
+    batches = list(ds.iter_batches(batch_size=10, drop_last=True))
+    assert [rd.block_num_rows(b) for b in batches] == [10, 10]
+
+
+def test_limit_short_circuits():
+    ds = rd.range(1000, num_blocks=100).limit(15)
+    assert ds.count() == 15
+
+
+def test_shuffle_preserves_multiset():
+    ds = rd.range(64, num_blocks=8).random_shuffle(seed=0)
+    rows = [r for r in ds.iter_rows()]
+    assert sorted(rows) == list(range(64))
+    assert rows != list(range(64))  # actually permuted
+
+
+def test_repartition():
+    ds = rd.range(30, num_blocks=3).repartition(5)
+    blocks = list(ds.iter_blocks())
+    assert len(blocks) == 5
+    assert sum(rd.block_num_rows(b) for b in blocks) == 30
+
+
+def test_from_items_dict_rows():
+    rows = [{"a": i, "b": i * i} for i in range(10)]
+    ds = rd.from_items(rows, num_blocks=3)
+    out = ds.take(10)
+    assert out[3] == {"a": 3, "b": 9}
+
+
+def test_read_text(tmp_path):
+    p1 = tmp_path / "a.txt"
+    p1.write_text("hello\nworld\n")
+    p2 = tmp_path / "b.txt"
+    p2.write_text("foo\n")
+    ds = rd.read_text(str(tmp_path / "*.txt"))
+    texts = sorted(row["text"] for row in ds.take(10))
+    assert texts == ["foo", "hello", "world"]
+
+
+def test_read_npy(tmp_path):
+    np.save(tmp_path / "s0.npy", np.arange(10, dtype=np.int32))
+    np.save(tmp_path / "s1.npy", np.arange(10, 20, dtype=np.int32))
+    ds = rd.read_npy(str(tmp_path / "*.npy"))
+    total = np.concatenate([b["tokens"] for b in ds.iter_blocks()])
+    assert sorted(total.tolist()) == list(range(20))
+
+
+def test_streaming_split_round_robin():
+    ds = rd.range(40, num_blocks=8)
+    it0, it1 = ds.streaming_split(2)
+    rows0 = [r for r in it0.iter_rows()]
+    rows1 = [r for r in it1.iter_rows()]
+    assert sorted(rows0 + rows1) == list(range(40))
+    assert rows0 and rows1
+
+
+def test_streaming_split_concurrent_consumers():
+    import threading
+
+    ds = rd.range(100, num_blocks=10)
+    its = ds.streaming_split(4)
+    results = [[] for _ in range(4)]
+
+    def consume(i):
+        results[i] = [r for r in its[i].iter_rows()]
+
+    threads = [threading.Thread(target=consume, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert sorted(sum(results, [])) == list(range(100))
+
+
+def test_pack_tokens_windows():
+    blocks = iter([{"tokens": np.arange(100, dtype=np.int32)}])
+    batches = list(rd.pack_tokens(blocks, seq_len=9, batch_size=2))
+    # 100 tokens → 10 windows of 10 → 5 batches of 2
+    assert len(batches) == 5
+    assert batches[0]["tokens"].shape == (2, 10)
+    np.testing.assert_array_equal(batches[0]["tokens"][0], np.arange(10))
+    np.testing.assert_array_equal(batches[0]["tokens"][1], np.arange(10, 20))
+
+
+def test_pack_tokens_ragged_docs():
+    col = np.empty(2, dtype=object)
+    col[0] = list(range(7))
+    col[1] = list(range(7, 12))
+    blocks = iter([{"tokens": col}])
+    batches = list(rd.pack_tokens(blocks, seq_len=3, batch_size=1))
+    assert len(batches) == 3  # 12 tokens → 3 windows of 4
+    np.testing.assert_array_equal(batches[0]["tokens"][0], [0, 1, 2, 3])
+
+
+def test_lm_pipeline_feeds_trainer():
+    """End-to-end: dataset → pack → LMTrainer step (tiny, CPU mesh)."""
+    import jax
+
+    from ray_tpu.models import get_config
+    from ray_tpu.parallel import MeshSpec
+    from ray_tpu.train import LMTrainer
+
+    config = get_config("gpt2-tiny")
+    stream = rd.from_numpy(
+        {"tokens": np.random.default_rng(0).integers(0, 255, 3000).astype(np.int32)},
+        num_blocks=4,
+    )
+    trainer = LMTrainer(
+        config, mesh_spec=MeshSpec(dp=2, fsdp=2, tp=2), learning_rate=1e-3, total_steps=5
+    )
+    batches = rd.lm_batch_iterator(stream, seq_len=16, batch_size=8)
+    metrics = trainer.train(batches, num_steps=5, report_every=5)
+    assert metrics["step"] == 5
+    assert np.isfinite(metrics["loss"])
