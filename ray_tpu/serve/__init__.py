@@ -1,0 +1,23 @@
+"""ray_tpu.serve — model serving (Ray Serve equivalent).
+
+Controller/replica FSM with restarts, pow-2-choices routing, ongoing-request
+autoscaling, stdlib HTTP ingress, and a TPU continuous-batching LLM engine
+(static slot grid over a dense KV cache — compiles once, batches forever).
+"""
+
+from .api import (  # noqa: F401
+    delete,
+    get_handle,
+    run,
+    shutdown,
+    start_http,
+    status,
+)
+from .deployment import (  # noqa: F401
+    Application,
+    AutoscalingConfig,
+    Deployment,
+    DeploymentConfig,
+    deployment,
+)
+from .router import DeploymentHandle  # noqa: F401

@@ -1,0 +1,132 @@
+"""serve public API: run/get_handle/status/shutdown + HTTP ingress.
+
+Reference parity: serve.run (serve/api.py:591), ProxyActor HTTP ingress
+(serve/_private/proxy.py:1137). The proxy here is a threaded HTTP server
+routing JSON POSTs to deployment handles — per-node uvicorn/ASGI machinery
+is intentionally replaced by stdlib (no external deps in this image).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional
+
+from .. import api as _core_api
+from .controller import ServeController
+from .deployment import Application
+from .router import DeploymentHandle
+
+_controller: Optional[ServeController] = None
+_proxy: Optional["_HttpProxy"] = None
+_lock = threading.Lock()
+
+
+def _get_controller() -> ServeController:
+    global _controller
+    with _lock:
+        if _controller is None:
+            _core_api.init()  # make sure the runtime exists
+            _controller = ServeController()
+        return _controller
+
+
+def run(app: Application, *, name: Optional[str] = None) -> DeploymentHandle:
+    """Deploy (or redeploy) an application; returns its handle."""
+    if name is not None:
+        app = Application(app.deployment.options(name=name), app.init_args, app.init_kwargs)
+    return _get_controller().deploy(app)
+
+
+def get_handle(name: str) -> DeploymentHandle:
+    return _get_controller().get_handle(name)
+
+
+def status() -> Dict[str, Dict[str, Any]]:
+    return _get_controller().status()
+
+
+def delete(name: str) -> None:
+    _get_controller().delete(name)
+
+
+def shutdown() -> None:
+    global _controller, _proxy
+    with _lock:
+        if _proxy is not None:
+            _proxy.stop()
+            _proxy = None
+        if _controller is not None:
+            _controller.shutdown()
+            _controller = None
+
+
+# ------------------------------------------------------------------ HTTP proxy
+
+
+class _HttpProxy:
+    def __init__(self, controller: ServeController, host: str, port: int):
+        proxy = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_POST(self):  # noqa: N802
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    payload = json.loads(self.rfile.read(length) or b"{}")
+                    # path = /<deployment>[/<method>]
+                    parts = [p for p in self.path.split("/") if p]
+                    if not parts:
+                        raise KeyError("missing deployment in path")
+                    handle = controller.get_handle(parts[0])
+                    method = parts[1] if len(parts) > 1 else "__call__"
+                    ref = getattr(handle, method).remote(payload) if method != "__call__" else handle.remote(payload)
+                    result = _core_api.get(ref, timeout=120)
+                    body = json.dumps({"result": result}).encode()
+                    self.send_response(200)
+                except KeyError as e:
+                    body = json.dumps({"error": f"not found: {e}"}).encode()
+                    self.send_response(404)
+                except Exception as e:
+                    body = json.dumps({"error": repr(e)}).encode()
+                    self.send_response(500)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # silence request logs
+                pass
+
+        class Server(ThreadingHTTPServer):
+            daemon_threads = True
+
+            def server_bind(self):
+                # default server_bind calls socket.getfqdn() — a reverse-DNS
+                # lookup that hangs in egress-less environments
+                import socketserver
+
+                socketserver.TCPServer.server_bind(self)
+                self.server_name = self.server_address[0]
+                self.server_port = self.server_address[1]
+
+        self.server = Server((host, port), Handler)
+        self.port = self.server.server_address[1]
+        self.thread = threading.Thread(
+            target=self.server.serve_forever, daemon=True, name="serve-http"
+        )
+        self.thread.start()
+
+    def stop(self) -> None:
+        self.server.shutdown()
+        self.server.server_close()
+
+
+def start_http(host: str = "127.0.0.1", port: int = 0) -> int:
+    """Start the HTTP ingress; returns the bound port."""
+    global _proxy
+    controller = _get_controller()  # before taking _lock: it locks too
+    with _lock:
+        if _proxy is None:
+            _proxy = _HttpProxy(controller, host, port)
+        return _proxy.port

@@ -1,0 +1,180 @@
+"""ServeController: reconciles target deployment state against live actors.
+
+Reference parity: serve/_private/controller.py:86 ServeController +
+deployment_state.py (DeploymentStateManager :2343, DeploymentState FSM
+:1248) + autoscaling_state.py. One reconcile thread owns: replica start/
+stop, health checks with restarts, and ongoing-request autoscaling.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from .. import api
+from ..core.exceptions import ActorDiedError, RayTpuError
+from .deployment import Application, Deployment
+from .router import DeploymentHandle, ReplicaSet
+
+logger = logging.getLogger("ray_tpu.serve")
+
+
+class _ReplicaWrapper:
+    """Actor body: hosts the user's deployment instance."""
+
+    def __init__(self, cls, args, kwargs):
+        self._instance = cls(*args, **kwargs)
+
+    def call(self, method: str, *args, **kwargs):
+        return getattr(self._instance, method)(*args, **kwargs)
+
+    def health(self) -> str:
+        check = getattr(self._instance, "check_health", None)
+        if check is not None:
+            check()
+        return "ok"
+
+
+class _DeploymentState:
+    """Per-deployment record in the controller."""
+
+    def __init__(self, deployment: Deployment, app: Application):
+        self.deployment = deployment
+        self.app = app
+        self.target_replicas = deployment.config.num_replicas
+        if deployment.config.autoscaling:
+            self.target_replicas = deployment.config.autoscaling.min_replicas
+        self.replicas: List[Any] = []
+        self.replica_set = ReplicaSet(deployment.name)
+        self.last_scale_down = time.time()
+
+
+class ServeController:
+    """In-process controller; reconcile loop runs on a daemon thread."""
+
+    def __init__(self, reconcile_interval_s: float = 0.2):
+        self._states: Dict[str, _DeploymentState] = {}
+        self._lock = threading.Lock()
+        self._interval = reconcile_interval_s
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------- lifecycle
+
+    def deploy(self, app: Application) -> DeploymentHandle:
+        dep = app.deployment
+        with self._lock:
+            state = _DeploymentState(dep, app)
+            self._states[dep.name] = state
+        self._reconcile_one(state)  # synchronous first bring-up
+        self._ensure_thread()
+        return DeploymentHandle(state.replica_set)
+
+    def get_handle(self, name: str) -> DeploymentHandle:
+        with self._lock:
+            if name not in self._states:
+                raise KeyError(f"no deployment {name!r}; have {list(self._states)}")
+            return DeploymentHandle(self._states[name].replica_set)
+
+    def delete(self, name: str) -> None:
+        with self._lock:
+            state = self._states.pop(name, None)
+        if state:
+            for r in state.replicas:
+                _kill_quietly(r)
+            state.replica_set.set_replicas([])
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+        with self._lock:
+            names = list(self._states)
+        for name in names:
+            self.delete(name)
+
+    def status(self) -> Dict[str, Dict[str, Any]]:
+        with self._lock:
+            return {
+                name: {
+                    "target_replicas": s.target_replicas,
+                    "live_replicas": len(s.replicas),
+                    "ongoing": s.replica_set.total_ongoing(),
+                }
+                for name, s in self._states.items()
+            }
+
+    # ------------------------------------------------------------- reconcile
+
+    def _ensure_thread(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True, name="serve-controller"
+            )
+            self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._interval):
+            with self._lock:
+                states = list(self._states.values())
+            for state in states:
+                try:
+                    self._autoscale(state)
+                    self._reconcile_one(state)
+                except Exception:
+                    logger.exception("reconcile failed for %s", state.deployment.name)
+
+    def _reconcile_one(self, state: _DeploymentState) -> None:
+        # prune dead replicas
+        live = []
+        for r in state.replicas:
+            try:
+                api.get(r.health.remote(), timeout=10)
+                live.append(r)
+            except (ActorDiedError, RayTpuError, Exception):
+                _kill_quietly(r)
+        state.replicas = live
+        # scale up
+        dep = state.deployment
+        while len(state.replicas) < state.target_replicas:
+            actor_cls = api.remote(_ReplicaWrapper).options(
+                max_concurrency=dep.config.max_ongoing_requests,
+                resources=dep.config.resources_per_replica or {"CPU": 1.0},
+                num_cpus=0,
+                name=f"serve:{dep.name}#{len(state.replicas)}-{time.monotonic_ns()}",
+            )
+            replica = actor_cls.remote(dep.cls, state.app.init_args, state.app.init_kwargs)
+            state.replicas.append(replica)
+        # scale down (newest first)
+        while len(state.replicas) > state.target_replicas:
+            _kill_quietly(state.replicas.pop())
+        state.replica_set.set_replicas(state.replicas)
+
+    def _autoscale(self, state: _DeploymentState) -> None:
+        auto = state.deployment.config.autoscaling
+        if auto is None:
+            return
+        ongoing = state.replica_set.total_ongoing()
+        n = max(1, state.replica_set.num_replicas())
+        desired = ongoing / auto.target_ongoing_requests
+        import math
+
+        target = max(auto.min_replicas, min(auto.max_replicas, math.ceil(desired)))
+        if target > state.target_replicas:
+            state.target_replicas = target
+            state.last_scale_down = time.time()
+        elif target < state.target_replicas:
+            # dampen scale-down
+            if time.time() - state.last_scale_down > auto.scale_down_delay_s:
+                state.target_replicas = target
+                state.last_scale_down = time.time()
+
+
+def _kill_quietly(replica: Any) -> None:
+    try:
+        api.kill(replica)
+    except Exception:
+        pass

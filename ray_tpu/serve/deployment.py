@@ -1,0 +1,87 @@
+"""Deployment API: @serve.deployment → Deployment → .bind() → Application.
+
+Reference parity: python/ray/serve/api.py (deployment decorator), serve/
+config.py (DeploymentConfig, AutoscalingConfig), deployment graph binding.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional, Tuple
+
+
+@dataclasses.dataclass
+class AutoscalingConfig:
+    """Scale on ongoing requests (reference serve/_private/autoscaling_state.py)."""
+
+    min_replicas: int = 1
+    max_replicas: int = 4
+    target_ongoing_requests: float = 2.0
+    interval_s: float = 0.5
+    scale_down_delay_s: float = 2.0
+
+
+@dataclasses.dataclass
+class DeploymentConfig:
+    num_replicas: int = 1
+    max_ongoing_requests: int = 8
+    autoscaling: Optional[AutoscalingConfig] = None
+    health_check_period_s: float = 1.0
+    resources_per_replica: Optional[Dict[str, float]] = None
+    max_restarts: int = 3
+
+
+class Deployment:
+    """A configured (but not yet deployed) class."""
+
+    def __init__(self, cls: type, name: str, config: DeploymentConfig):
+        self.cls = cls
+        self.name = name
+        self.config = config
+
+    def options(self, **overrides) -> "Deployment":
+        cfg = dataclasses.replace(self.config)
+        name = overrides.pop("name", self.name)
+        for k, v in overrides.items():
+            if not hasattr(cfg, k):
+                raise TypeError(f"unknown deployment option {k!r}")
+            setattr(cfg, k, v)
+        return Deployment(self.cls, name, cfg)
+
+    def bind(self, *args, **kwargs) -> "Application":
+        return Application(self, args, kwargs)
+
+
+@dataclasses.dataclass
+class Application:
+    """A deployment bound to its constructor args (a 1-node graph; handle
+    args may themselves be Applications → composition)."""
+
+    deployment: Deployment
+    init_args: Tuple[Any, ...]
+    init_kwargs: Dict[str, Any]
+
+
+def deployment(
+    cls: Optional[type] = None,
+    *,
+    name: Optional[str] = None,
+    num_replicas: int = 1,
+    max_ongoing_requests: int = 8,
+    autoscaling: Optional[AutoscalingConfig] = None,
+    resources_per_replica: Optional[Dict[str, float]] = None,
+    max_restarts: int = 3,
+) -> Any:
+    """@serve.deployment decorator (reference serve/api.py:deployment)."""
+
+    def wrap(c: type) -> Deployment:
+        config = DeploymentConfig(
+            num_replicas=num_replicas,
+            max_ongoing_requests=max_ongoing_requests,
+            autoscaling=autoscaling,
+            resources_per_replica=resources_per_replica,
+            max_restarts=max_restarts,
+        )
+        return Deployment(c, name or c.__name__, config)
+
+    return wrap(cls) if cls is not None else wrap

@@ -1,0 +1,4 @@
+"""ray_tpu.serve.llm — continuous-batched LLM inference on TPU."""
+
+from .engine import EngineConfig, LLMEngine, ResponseStream  # noqa: F401
+from .server import LLMServer, build_llm_app  # noqa: F401

@@ -1,0 +1,82 @@
+"""LLM deployment: the serve-facing wrapper around LLMEngine.
+
+Reference parity: LLMServer/VLLMEngine deployment (llm/_internal/serve/
+deployments/llm/vllm/vllm_engine.py:254) + build_openai_app
+(serve/llm/__init__.py). Token-id interface: this image has no tokenizer
+vocab files (zero egress), so text encode/decode is the caller's concern —
+the OpenAI-style payload carries `prompt_tokens` instead of `prompt`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import jax
+
+from ...models import get_config, init_params
+from ...models.transformer import TransformerConfig
+from ..deployment import Application, deployment
+from .engine import EngineConfig, LLMEngine
+
+
+class LLMServer:
+    """Deployment class hosting one engine (one model replica)."""
+
+    def __init__(
+        self,
+        model: str | TransformerConfig = "gpt2-tiny",
+        params: Any = None,
+        engine_config: Optional[EngineConfig] = None,
+        seed: int = 0,
+    ):
+        config = get_config(model) if isinstance(model, str) else model
+        if params is None:
+            params = init_params(config, jax.random.PRNGKey(seed))
+        self.model_config = config
+        self.engine = LLMEngine(config, params, engine_config)
+
+    def generate(self, payload: Dict[str, Any]) -> Dict[str, Any]:
+        """{"prompt_tokens": [...], "max_tokens": n, "temperature": t} →
+        {"tokens": [...], "usage": {...}} (OpenAI-completions shaped)."""
+        prompt = payload["prompt_tokens"]
+        max_tokens = int(payload.get("max_tokens", 64))
+        temperature = float(payload.get("temperature", 0.0))
+        stream = self.engine.submit(prompt, max_tokens, temperature)
+        tokens = stream.result()
+        return {
+            "tokens": tokens,
+            "usage": {
+                "prompt_tokens": len(prompt),
+                "completion_tokens": len(tokens),
+                "total_tokens": len(prompt) + len(tokens),
+            },
+            "ttft_s": stream.ttft_s,
+        }
+
+    def metrics(self, _payload: Optional[Dict[str, Any]] = None) -> Dict[str, float]:
+        return dict(self.engine.metrics)
+
+    def check_health(self) -> None:
+        if not self.engine._thread.is_alive():
+            raise RuntimeError("engine loop died")
+
+    def __del__(self):
+        try:
+            self.engine.shutdown()
+        except Exception:
+            pass
+
+
+def build_llm_app(
+    model: str | TransformerConfig = "gpt2-tiny",
+    *,
+    name: str = "llm",
+    num_replicas: int = 1,
+    max_slots: int = 8,
+    params: Any = None,
+) -> Application:
+    """OpenAI-compatible app builder (reference build_openai_app)."""
+    dep = deployment(
+        LLMServer, name=name, num_replicas=num_replicas, max_ongoing_requests=max_slots * 2
+    )
+    return dep.bind(model, params, EngineConfig(max_slots=max_slots))

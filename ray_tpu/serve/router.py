@@ -1,0 +1,152 @@
+"""Handle-side routing: power-of-two-choices over replicas.
+
+Reference parity: serve/_private/router.py:340 AsyncioRouter +
+replica_scheduler/pow_2_scheduler.py:52 PowerOfTwoChoicesReplicaScheduler —
+sample two replicas, pick the one with the smaller ongoing-request count.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from typing import Any, Dict, List, Optional
+
+from .. import api
+
+
+class ReplicaSet:
+    """Live replica handles + ongoing counts, shared router/controller."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._lock = threading.Lock()
+        self._replicas: List[Any] = []  # ActorHandles
+        self._ongoing: Dict[int, int] = {}  # id(handle) -> count
+
+    def set_replicas(self, replicas: List[Any]) -> None:
+        with self._lock:
+            self._replicas = list(replicas)
+            live = {id(r) for r in replicas}
+            self._ongoing = {k: v for k, v in self._ongoing.items() if k in live}
+            for r in replicas:
+                self._ongoing.setdefault(id(r), 0)
+
+    def replicas(self) -> List[Any]:
+        with self._lock:
+            return list(self._replicas)
+
+    def pick(self) -> Any:
+        """Pow-2 choice by ongoing count."""
+        with self._lock:
+            if not self._replicas:
+                raise RuntimeError(f"deployment {self.name!r} has no replicas")
+            if len(self._replicas) == 1:
+                chosen = self._replicas[0]
+            else:
+                a, b = random.sample(self._replicas, 2)
+                chosen = a if self._ongoing[id(a)] <= self._ongoing[id(b)] else b
+            self._ongoing[id(chosen)] += 1
+            return chosen
+
+    def release(self, replica: Any) -> None:
+        with self._lock:
+            if id(replica) in self._ongoing and self._ongoing[id(replica)] > 0:
+                self._ongoing[id(replica)] -= 1
+
+    def total_ongoing(self) -> int:
+        with self._lock:
+            return sum(self._ongoing.values())
+
+    def num_replicas(self) -> int:
+        with self._lock:
+            return len(self._replicas)
+
+
+class DeploymentHandle:
+    """What users call: handle.method.remote(args) → ObjectRef (reference
+    serve/handle.py DeploymentHandle)."""
+
+    def __init__(self, replica_set: ReplicaSet):
+        self._set = replica_set
+
+    def __getattr__(self, method: str) -> "_MethodCaller":
+        if method.startswith("_"):
+            raise AttributeError(method)
+        return _MethodCaller(self._set, method)
+
+    def remote(self, *args, **kwargs):
+        """Callable deployments: handle.remote(x) → instance.__call__(x)."""
+        return _MethodCaller(self._set, "__call__").remote(*args, **kwargs)
+
+    @property
+    def deployment_name(self) -> str:
+        return self._set.name
+
+
+class _MethodCaller:
+    def __init__(self, replica_set: ReplicaSet, method: str):
+        self._set = replica_set
+        self._method = method
+
+    def remote(self, *args, **kwargs):
+        replica = self._set.pick()
+        try:
+            # replicas are _ReplicaWrapper actors: dispatch by method name
+            ref = replica.call.remote(self._method, *args, **kwargs)
+        except BaseException:
+            self._set.release(replica)
+            raise
+        _Reaper.instance().track(ref, self._set, replica)
+        return ref
+
+
+class _Reaper:
+    """Decrements ongoing counts when request refs complete — one background
+    thread over api.wait, the in-process analogue of the reference's asyncio
+    done-callbacks."""
+
+    _inst: Optional["_Reaper"] = None
+    _inst_lock = threading.Lock()
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._tracked: List[Any] = []  # (ref, set, replica)
+        self._event = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True, name="serve-reaper")
+        self._thread.start()
+
+    @classmethod
+    def instance(cls) -> "_Reaper":
+        with cls._inst_lock:
+            if cls._inst is None:
+                cls._inst = cls()
+            return cls._inst
+
+    def track(self, ref, replica_set, replica) -> None:
+        with self._lock:
+            self._tracked.append((ref, replica_set, replica))
+        self._event.set()
+
+    def _loop(self) -> None:
+        while True:
+            self._event.wait()
+            with self._lock:
+                tracked = list(self._tracked)
+                if not tracked:
+                    self._event.clear()
+                    continue
+            refs = [t[0] for t in tracked]
+            try:
+                done, _ = api.wait(refs, num_returns=1, timeout=0.1)
+            except BaseException:
+                done = []
+            if done:
+                done_set = set(done)
+                with self._lock:
+                    remaining = []
+                    for ref, rset, replica in self._tracked:
+                        if ref in done_set:
+                            rset.release(replica)
+                        else:
+                            remaining.append((ref, rset, replica))
+                    self._tracked = remaining
