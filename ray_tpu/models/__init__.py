@@ -5,6 +5,12 @@ same pattern: pytree params + logical-axis tree + scan-stacked layers.
 """
 
 from .configs import PRESETS, get_config  # noqa: F401
+from .moe import (  # noqa: F401
+    MoEConfig,
+    mixtral_8x7b,
+    moe_loss,
+    moe_tiny,
+)
 from .transformer import (  # noqa: F401
     TransformerConfig,
     count_params,
@@ -14,4 +20,15 @@ from .transformer import (  # noqa: F401
     init_params,
     logical_axes,
     prefill,
+)
+from .vit import (  # noqa: F401
+    CLIPConfig,
+    ViTConfig,
+    clip_forward,
+    clip_loss,
+    clip_tiny,
+    init_clip_params,
+    vit_b16,
+    vit_l16,
+    vit_tiny,
 )

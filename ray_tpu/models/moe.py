@@ -1,0 +1,227 @@
+"""Mixture-of-Experts transformer (Mixtral family) with expert parallelism.
+
+The reference only reaches MoE through vLLM engine internals (SURVEY.md
+§2.4: expert parallel "absent as a framework feature"). Here experts are a
+first-class mesh axis: expert-stacked weights carry the "expert" logical
+axis → `ep` on the mesh, and the GShard-style dense dispatch/combine
+einsums give XLA the contraction structure it needs to insert the
+all-to-alls over ICI on its own. Routing is top-k with capacity: dropped
+tokens (over capacity) fall through on the residual path, the standard
+Switch/GShard behavior; a load-balancing aux loss keeps experts busy.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import rope_frequencies, swiglu
+from .transformer import (
+    Params,
+    TransformerConfig,
+    _norm,
+    attention_sublayer,
+    init_params as _dense_init,
+    logical_axes as _dense_axes,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig(TransformerConfig):
+    n_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 2.0
+    router_aux_coeff: float = 0.01
+
+
+def mixtral_8x7b() -> MoEConfig:
+    """Mixtral 8x7B — BASELINE config 3 (expert parallelism)."""
+    return MoEConfig(
+        vocab_size=32000,
+        d_model=4096,
+        n_layers=32,
+        n_heads=32,
+        n_kv_heads=8,
+        d_ff=14336,
+        max_seq=8192,
+        pos_emb="rope",
+        norm="rmsnorm",
+        act="swiglu",
+        use_bias=False,
+        tie_embeddings=False,
+        rope_theta=1e6,
+        remat=True,
+        n_experts=8,
+        top_k=2,
+    )
+
+
+def moe_tiny() -> MoEConfig:
+    """4-layer 4-expert toy for CI (divisible by ep=2/tp=2 test meshes)."""
+    return MoEConfig(
+        vocab_size=256,
+        d_model=64,
+        n_layers=4,
+        n_heads=4,
+        n_kv_heads=2,
+        d_ff=128,
+        max_seq=128,
+        pos_emb="rope",
+        norm="rmsnorm",
+        act="swiglu",
+        use_bias=False,
+        tie_embeddings=False,
+        dtype=jnp.float32,
+        n_experts=4,
+        top_k=2,
+    )
+
+
+# ----------------------------------------------------------------------- init
+
+
+def init_params(config: MoEConfig, key: jax.Array) -> Params:
+    """Dense skeleton + per-expert MLP stacks (L, E_exp, ...)."""
+    base = _dense_init(config, key)
+    blocks = base["blocks"]
+    for name in ("w_up", "w_down", "w_gate", "b_up", "b_down"):
+        blocks.pop(name, None)
+    c = config
+    pd = c.param_dtype
+    std = 0.02
+    res_std = std / math.sqrt(2 * c.n_layers)
+    keys = jax.random.split(jax.random.fold_in(key, 99), 4)
+    L, E = c.n_layers, c.n_experts
+    blocks["router"] = (std * jax.random.normal(keys[0], (L, c.d_model, E))).astype(pd)
+    blocks["we_gate"] = (std * jax.random.normal(keys[1], (L, E, c.d_model, c.d_ff))).astype(pd)
+    blocks["we_up"] = (std * jax.random.normal(keys[2], (L, E, c.d_model, c.d_ff))).astype(pd)
+    blocks["we_down"] = (res_std * jax.random.normal(keys[3], (L, E, c.d_ff, c.d_model))).astype(pd)
+    return base
+
+
+def logical_axes(config: MoEConfig) -> Params:
+    axes = _dense_axes(config)
+    blocks = axes["blocks"]
+    for name in ("w_up", "w_down", "w_gate", "b_up", "b_down"):
+        blocks.pop(name, None)
+    blocks["router"] = ("layers", "embed", None)
+    blocks["we_gate"] = ("layers", "expert", "embed", "mlp")
+    blocks["we_up"] = ("layers", "expert", "embed", "mlp")
+    blocks["we_down"] = ("layers", "expert", "mlp", "embed")
+    return axes
+
+
+# -------------------------------------------------------------------- routing
+
+
+def topk_dispatch(
+    probs: jax.Array, top_k: int, capacity: int
+) -> Tuple[jax.Array, jax.Array]:
+    """GShard dense dispatch. probs (B, S, E) → dispatch (B,S,E,C) {0,1},
+    combine (B,S,E,C) gate-weighted; tokens over capacity are dropped."""
+    num_experts = probs.shape[-1]
+    weights, idx = jax.lax.top_k(probs, top_k)  # (B,S,k)
+    weights = weights / (jnp.sum(weights, -1, keepdims=True) + 1e-9)
+    onehot = jax.nn.one_hot(idx, num_experts, dtype=probs.dtype)  # (B,S,k,E)
+    b, s, k, e = onehot.shape
+    # queue position of each (token, choice) within its expert, in (S·k) order
+    flat = onehot.transpose(0, 2, 1, 3).reshape(b, k * s, e)
+    pos_flat = jnp.cumsum(flat, axis=1) - flat
+    pos = pos_flat.reshape(b, k, s, e).transpose(0, 2, 1, 3)  # (B,S,k,E)
+    pos = pos.astype(jnp.int32)
+    keep = (pos < capacity).astype(probs.dtype) * onehot
+    pos_onehot = jax.nn.one_hot(
+        jnp.clip(pos, 0, capacity - 1), capacity, dtype=probs.dtype
+    )  # (B,S,k,E,C)
+    dispatch = jnp.einsum("bske,bskec->bsec", keep, pos_onehot)
+    combine = jnp.einsum("bsk,bske,bskec->bsec", weights, keep, pos_onehot)
+    return dispatch, combine
+
+
+def load_balancing_loss(probs: jax.Array, dispatch: jax.Array) -> jax.Array:
+    """Switch aux loss: E · Σ_e (token frac to e · mean router prob of e)."""
+    num_experts = probs.shape[-1]
+    token_frac = jnp.mean(jnp.sum(dispatch, axis=-1), axis=(0, 1))  # (E,)
+    prob_mean = jnp.mean(probs, axis=(0, 1))
+    return num_experts * jnp.sum(token_frac * prob_mean)
+
+
+def moe_mlp_sublayer(
+    x: jax.Array, lp: Params, config: MoEConfig
+) -> Tuple[jax.Array, jax.Array]:
+    """Pre-norm MoE FFN + residual; returns (out, aux_loss)."""
+    c = config
+    dt = c.dtype
+    h = _norm(x, lp["ln2_scale"], lp.get("ln2_bias"), c.norm)
+    b, s, _ = h.shape
+    capacity = max(1, int(c.capacity_factor * c.top_k * s / c.n_experts))
+
+    router_logits = jnp.einsum(
+        "bsm,me->bse", h.astype(jnp.float32), lp["router"].astype(jnp.float32)
+    )
+    probs = jax.nn.softmax(router_logits, axis=-1)
+    dispatch, combine = topk_dispatch(probs, c.top_k, capacity)
+    aux = load_balancing_loss(probs, dispatch)
+
+    # dispatch: (B,S,E,C) × (B,S,M) → (E,B,C,M); XLA turns the e-sharded
+    # contraction into the all-to-all over the ep axis
+    expert_in = jnp.einsum("bsec,bsm->ebcm", dispatch.astype(dt), h)
+    gate = jnp.einsum("ebcm,emf->ebcf", expert_in, lp["we_gate"].astype(dt))
+    up = jnp.einsum("ebcm,emf->ebcf", expert_in, lp["we_up"].astype(dt))
+    act = swiglu(gate, up)
+    expert_out = jnp.einsum("ebcf,efm->ebcm", act, lp["we_down"].astype(dt))
+    out = jnp.einsum("ebcm,bsec->bsm", expert_out, combine.astype(dt))
+    return x + out, aux
+
+
+# -------------------------------------------------------------------- forward
+
+
+def forward(
+    params: Params,
+    tokens: jax.Array,
+    config: MoEConfig,
+    *,
+    positions: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """(B, S) → (logits (B,S,V), total aux loss)."""
+    c = config
+    dt = c.dtype
+    _, s = tokens.shape
+    x = params["wte"].astype(dt)[tokens]
+    if c.pos_emb == "learned":
+        x = x + params["wpe"].astype(dt)[None, :s]
+        rope_tables = None
+    else:
+        rope_tables = rope_frequencies(c.head_dim, c.max_seq, c.rope_theta)
+
+    def block_fn(carry, lp):
+        x = attention_sublayer(carry, lp, c, rope_tables, positions)
+        x, aux = moe_mlp_sublayer(x, lp, c)
+        return x, aux
+
+    if c.remat:
+        block_fn = jax.checkpoint(block_fn)
+    x, aux_per_layer = jax.lax.scan(block_fn, x, params["blocks"])
+
+    x = _norm(x, params["lnf_scale"], params.get("lnf_bias"), c.norm)
+    head = params.get("lm_head", None)
+    if head is None:
+        head = params["wte"].T
+    logits = jnp.einsum("bse,ev->bsv", x, head.astype(dt))
+    return logits, jnp.sum(aux_per_layer)
+
+
+def moe_loss(
+    params: Params, tokens: jax.Array, config: MoEConfig
+) -> Tuple[jax.Array, Any]:
+    """Next-token CE + router aux (for make_train_step-style factories)."""
+    from ..ops import cross_entropy_loss
+
+    logits, aux = forward(params, tokens[:, :-1], config)
+    ce, ntok = cross_entropy_loss(logits, tokens[:, 1:])
+    return ce + config.router_aux_coeff * aux, (ce, aux, ntok)

@@ -62,6 +62,7 @@ class TransformerConfig:
     param_dtype: Any = jnp.float32
     remat: bool = False
     attn_impl: Optional[str] = None  # None → pallas on TPU, xla elsewhere
+    causal: bool = True  # False → bidirectional encoder (ViT, CLIP text off)
 
     @property
     def kv_heads(self) -> int:
@@ -181,17 +182,16 @@ def _norm(x, scale, bias, kind):
     return layernorm(x, scale, bias)
 
 
-def _block(
+def attention_sublayer(
     x: jax.Array,
     lp: Params,
     config: TransformerConfig,
     rope_tables: Optional[Tuple[jax.Array, jax.Array]],
     positions: Optional[jax.Array],
 ) -> jax.Array:
-    """One transformer block on (B, S, E) activations (training/prefill)."""
+    """Pre-norm causal self-attention + residual on (B, S, E)."""
     c = config
     dt = c.dtype
-
     h = _norm(x, lp["ln1_scale"], lp.get("ln1_bias"), c.norm)
     q = jnp.einsum("bse,ehd->bhsd", h, lp["wq"].astype(dt))
     k = jnp.einsum("bse,ehd->bhsd", h, lp["wk"].astype(dt))
@@ -204,12 +204,17 @@ def _block(
         cos, sin = rope_tables
         q = apply_rope(q, cos, sin, positions)
         k = apply_rope(k, cos, sin, positions)
-    attn = flash_attention(q, k, v, causal=True, implementation=c.attn_impl)
+    attn = flash_attention(q, k, v, causal=c.causal, implementation=c.attn_impl)
     out = jnp.einsum("bhsd,hde->bse", attn, lp["wo"].astype(dt))
     if c.use_bias:
         out = out + lp["bo"].astype(dt)
-    x = x + out
+    return x + out
 
+
+def mlp_sublayer(x: jax.Array, lp: Params, config: TransformerConfig) -> jax.Array:
+    """Pre-norm dense MLP + residual on (B, S, E)."""
+    c = config
+    dt = c.dtype
     h = _norm(x, lp["ln2_scale"], lp.get("ln2_bias"), c.norm)
     up = jnp.einsum("bse,ef->bsf", h, lp["w_up"].astype(dt))
     if c.use_bias:
@@ -223,6 +228,18 @@ def _block(
     if c.use_bias:
         down = down + lp["b_down"].astype(dt)
     return x + down
+
+
+def _block(
+    x: jax.Array,
+    lp: Params,
+    config: TransformerConfig,
+    rope_tables: Optional[Tuple[jax.Array, jax.Array]],
+    positions: Optional[jax.Array],
+) -> jax.Array:
+    """One transformer block on (B, S, E) activations (training/prefill)."""
+    x = attention_sublayer(x, lp, config, rope_tables, positions)
+    return mlp_sublayer(x, lp, config)
 
 
 def forward(

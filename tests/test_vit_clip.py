@@ -1,0 +1,119 @@
+"""ViT encoder + CLIP dual tower."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.models.vit import (
+    clip_forward,
+    clip_loss,
+    clip_tiny,
+    forward,
+    init_clip_params,
+    init_params,
+    logical_axes,
+    patchify,
+    vit_tiny,
+)
+
+
+def test_patchify_roundtrip_values():
+    images = jnp.arange(2 * 8 * 8 * 3, dtype=jnp.float32).reshape(2, 8, 8, 3)
+    patches = patchify(images, 4)
+    assert patches.shape == (2, 4, 48)
+    # first patch = top-left 4x4 block
+    np.testing.assert_array_equal(
+        np.asarray(patches[0, 0]), np.asarray(images[0, :4, :4, :]).reshape(-1)
+    )
+
+
+def test_vit_forward_and_not_order_invariant():
+    config = vit_tiny()
+    params = init_params(config, jax.random.PRNGKey(0))
+    images = jax.random.normal(jax.random.PRNGKey(1), (2, 32, 32, 3))
+    out = forward(params, images, config)
+    assert out.shape == (2, 10)
+    assert np.isfinite(np.asarray(out)).all()
+    # pos embeddings break permutation invariance: flipped image ≠ original
+    flipped = images[:, ::-1]
+    out2 = forward(params, flipped, config)
+    assert not np.allclose(np.asarray(out), np.asarray(out2), atol=1e-4)
+
+
+def test_vit_axes_tree_matches():
+    config = vit_tiny()
+    params = init_params(config, jax.random.PRNGKey(0))
+    axes = logical_axes(config)
+    p_paths = {
+        tuple(str(k) for k in path)
+        for path, _ in jax.tree_util.tree_flatten_with_path(params)[0]
+    }
+    a_paths = {
+        tuple(str(k) for k in path)
+        for path, _ in jax.tree_util.tree_flatten_with_path(
+            axes, is_leaf=lambda x: isinstance(x, tuple)
+        )[0]
+    }
+    assert p_paths == a_paths
+
+
+def test_vit_grad_flows():
+    config = vit_tiny()
+    params = init_params(config, jax.random.PRNGKey(0))
+    images = jax.random.normal(jax.random.PRNGKey(1), (2, 32, 32, 3))
+    labels = jnp.array([1, 7])
+
+    def loss(p):
+        from ray_tpu.ops import cross_entropy_loss
+
+        return cross_entropy_loss(forward(p, images, config), labels)[0]
+
+    g = jax.grad(loss)(params)
+    assert all(np.isfinite(np.asarray(x)).all() for x in jax.tree.leaves(g))
+    assert float(jnp.linalg.norm(g["patch_proj"])) > 0
+
+
+def test_clip_forward_shapes_and_norms():
+    config = clip_tiny()
+    params = init_clip_params(config, jax.random.PRNGKey(0))
+    images = jax.random.normal(jax.random.PRNGKey(1), (4, 32, 32, 3))
+    tokens = jax.random.randint(jax.random.PRNGKey(2), (4, 12), 0, 256)
+    lengths = jnp.array([12, 8, 5, 12])
+    img, txt, scale = clip_forward(params, images, tokens, lengths, config)
+    assert img.shape == (4, 32) and txt.shape == (4, 32)
+    np.testing.assert_allclose(np.linalg.norm(np.asarray(img), axis=-1), 1.0, rtol=1e-5)
+    np.testing.assert_allclose(np.linalg.norm(np.asarray(txt), axis=-1), 1.0, rtol=1e-5)
+    assert float(scale) > 0
+
+
+def test_clip_contrastive_training_aligns_pairs():
+    import optax
+
+    config = clip_tiny()
+    params = init_clip_params(config, jax.random.PRNGKey(0))
+    images = jax.random.normal(jax.random.PRNGKey(1), (4, 32, 32, 3))
+    tokens = jax.random.randint(jax.random.PRNGKey(2), (4, 12), 0, 256)
+    lengths = jnp.full((4,), 12)
+
+    opt = optax.adam(1e-2)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step(params, opt_state):
+        loss, grads = jax.value_and_grad(
+            lambda p: clip_loss(p, images, tokens, lengths, config)
+        )(params)
+        updates, opt_state = opt.update(grads, opt_state)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    losses = []
+    # plateaus at ln(B) until logit_scale warms up (~step 75), then collapses
+    for _ in range(150):
+        params, opt_state, loss = step(params, opt_state)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.35, (losses[0], losses[-1])
+    # after training, matching pairs dominate the similarity matrix
+    img, txt, _ = clip_forward(params, images, tokens, lengths, config)
+    sim = np.asarray(img @ txt.T)
+    assert (sim.argmax(axis=1) == np.arange(4)).all()
