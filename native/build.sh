@@ -1,0 +1,7 @@
+#!/bin/sh
+# Build the native components into ray_tpu/core/_native/.
+set -e
+cd "$(dirname "$0")"
+mkdir -p ../ray_tpu/core/_native
+g++ -O2 -shared -fPIC -std=c++17 -Wall -o ../ray_tpu/core/_native/libobjstore.so objstore.cc
+echo "built ray_tpu/core/_native/libobjstore.so"

@@ -1,0 +1,215 @@
+// Shared-memory arena object store — the plasma-equivalent mechanism layer.
+//
+// Reference parity: src/ray/object_manager/plasma/ (PlasmaStore store.h:55,
+// dlmalloc arena plasma/dlmalloc.cc, LRU EvictionPolicy eviction_policy.h:159).
+// This is the TPU-host rebuild of that component: one contiguous arena,
+// first-fit free-list allocation with coalescing, pin counts, and an LRU
+// list of evictable (sealed, unpinned) objects. Policy split: this library
+// owns placement + LRU ordering; the Python runtime drives spilling
+// (asks for the LRU candidate, persists it, then deletes) so storage
+// backends stay pluggable.
+//
+// Exposed as a plain C ABI for ctypes (no pybind11 in this image).
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <list>
+#include <map>
+#include <mutex>
+#include <unordered_map>
+
+namespace {
+
+struct Object {
+  uint64_t offset = 0;
+  uint64_t size = 0;
+  bool sealed = false;
+  uint32_t pins = 0;
+  bool in_lru = false;
+  std::list<uint64_t>::iterator lru_it{};
+};
+
+struct FreeBlock {
+  uint64_t size;
+};
+
+struct Arena {
+  char* base = nullptr;
+  uint64_t capacity = 0;
+  uint64_t used = 0;
+  // offset -> free block size, ordered for coalescing
+  std::map<uint64_t, uint64_t> free_blocks;
+  std::unordered_map<uint64_t, Object> objects;
+  std::list<uint64_t> lru;  // front = oldest evictable
+  std::mutex mu;
+};
+
+constexpr uint64_t kAlign = 64;  // cacheline alignment for numpy payloads
+
+uint64_t align_up(uint64_t n) { return (n + kAlign - 1) & ~(kAlign - 1); }
+
+void lru_remove(Arena* a, Object& obj) {
+  if (obj.in_lru) {
+    a->lru.erase(obj.lru_it);
+    obj.in_lru = false;
+  }
+}
+
+void lru_push(Arena* a, uint64_t id, Object& obj) {
+  if (!obj.in_lru && obj.sealed && obj.pins == 0) {
+    a->lru.push_back(id);
+    obj.lru_it = std::prev(a->lru.end());
+    obj.in_lru = true;
+  }
+}
+
+// merge [offset,size) into the free map, coalescing neighbors
+void free_insert(Arena* a, uint64_t offset, uint64_t size) {
+  auto next = a->free_blocks.lower_bound(offset);
+  if (next != a->free_blocks.begin()) {
+    auto prev = std::prev(next);
+    if (prev->first + prev->second == offset) {
+      offset = prev->first;
+      size += prev->second;
+      a->free_blocks.erase(prev);
+    }
+  }
+  if (next != a->free_blocks.end() && offset + size == next->first) {
+    size += next->second;
+    a->free_blocks.erase(next);
+  }
+  a->free_blocks[offset] = size;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* store_create_arena(uint64_t capacity) {
+  auto* a = new Arena();
+  a->base = static_cast<char*>(std::malloc(capacity));
+  if (a->base == nullptr) {
+    delete a;
+    return nullptr;
+  }
+  a->capacity = capacity;
+  a->free_blocks[0] = capacity;
+  return a;
+}
+
+void store_destroy_arena(void* handle) {
+  auto* a = static_cast<Arena*>(handle);
+  if (a == nullptr) return;
+  std::free(a->base);
+  delete a;
+}
+
+// Returns the offset of the new (unsealed) object, or -1 if no space /
+// duplicate id. The caller is expected to memcpy into base+offset and seal.
+int64_t store_create(void* handle, uint64_t id, uint64_t size) {
+  auto* a = static_cast<Arena*>(handle);
+  std::lock_guard<std::mutex> lock(a->mu);
+  if (a->objects.count(id)) return -1;
+  uint64_t need = align_up(size == 0 ? 1 : size);
+  // first fit
+  for (auto it = a->free_blocks.begin(); it != a->free_blocks.end(); ++it) {
+    if (it->second >= need) {
+      uint64_t offset = it->first;
+      uint64_t remaining = it->second - need;
+      a->free_blocks.erase(it);
+      if (remaining > 0) a->free_blocks[offset + need] = remaining;
+      Object obj;
+      obj.offset = offset;
+      obj.size = size;
+      a->objects.emplace(id, obj);
+      a->used += need;
+      return static_cast<int64_t>(offset);
+    }
+  }
+  return -1;
+}
+
+int store_seal(void* handle, uint64_t id) {
+  auto* a = static_cast<Arena*>(handle);
+  std::lock_guard<std::mutex> lock(a->mu);
+  auto it = a->objects.find(id);
+  if (it == a->objects.end() || it->second.sealed) return -1;
+  it->second.sealed = true;
+  lru_push(a, id, it->second);
+  return 0;
+}
+
+// Pins the object and returns its offset (-1 if absent/unsealed). Pinned
+// objects are never eviction candidates.
+int64_t store_get(void* handle, uint64_t id, uint64_t* size_out) {
+  auto* a = static_cast<Arena*>(handle);
+  std::lock_guard<std::mutex> lock(a->mu);
+  auto it = a->objects.find(id);
+  if (it == a->objects.end() || !it->second.sealed) return -1;
+  Object& obj = it->second;
+  lru_remove(a, obj);
+  obj.pins += 1;
+  if (size_out != nullptr) *size_out = obj.size;
+  return static_cast<int64_t>(obj.offset);
+}
+
+int store_unpin(void* handle, uint64_t id) {
+  auto* a = static_cast<Arena*>(handle);
+  std::lock_guard<std::mutex> lock(a->mu);
+  auto it = a->objects.find(id);
+  if (it == a->objects.end() || it->second.pins == 0) return -1;
+  it->second.pins -= 1;
+  lru_push(a, id, it->second);  // re-enters LRU at the fresh end
+  return 0;
+}
+
+int store_delete(void* handle, uint64_t id) {
+  auto* a = static_cast<Arena*>(handle);
+  std::lock_guard<std::mutex> lock(a->mu);
+  auto it = a->objects.find(id);
+  if (it == a->objects.end() || it->second.pins > 0) return -1;
+  Object& obj = it->second;
+  lru_remove(a, obj);
+  uint64_t need = align_up(obj.size == 0 ? 1 : obj.size);
+  free_insert(a, obj.offset, need);
+  a->used -= need;
+  a->objects.erase(it);
+  return 0;
+}
+
+// Oldest sealed+unpinned object, or -1 — the eviction/spill candidate.
+int64_t store_lru_candidate(void* handle) {
+  auto* a = static_cast<Arena*>(handle);
+  std::lock_guard<std::mutex> lock(a->mu);
+  if (a->lru.empty()) return -1;
+  return static_cast<int64_t>(a->lru.front());
+}
+
+uint64_t store_used(void* handle) {
+  auto* a = static_cast<Arena*>(handle);
+  std::lock_guard<std::mutex> lock(a->mu);
+  return a->used;
+}
+
+uint64_t store_capacity(void* handle) {
+  return static_cast<Arena*>(handle)->capacity;
+}
+
+uint64_t store_num_objects(void* handle) {
+  auto* a = static_cast<Arena*>(handle);
+  std::lock_guard<std::mutex> lock(a->mu);
+  return a->objects.size();
+}
+
+uint64_t store_num_free_blocks(void* handle) {
+  auto* a = static_cast<Arena*>(handle);
+  std::lock_guard<std::mutex> lock(a->mu);
+  return a->free_blocks.size();
+}
+
+void* store_base(void* handle) {
+  return static_cast<Arena*>(handle)->base;
+}
+
+}  // extern "C"

@@ -1,0 +1,108 @@
+"""Chaos injection: schedule perturbation for fault-tolerance testing.
+
+Reference parity: rpc/rpc_chaos.h:23 (RAY_testing_rpc_failure) and
+asio delay injection (common/ray_config_def.h:857-864) — env/config-driven
+probabilistic failures and delays at the execution boundary. Here the
+boundary is task execution in the scheduler: injected failures surface as
+ChaosInjectedError, which is an ordinary task error (retriable via
+max_retries), so recovery paths are exercised exactly like real faults.
+
+Also configurable via env: RAY_TPU_CHAOS="failure_prob=0.3,delay_s=0.01,
+max_injections=5,name_filter=flaky".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+
+class ChaosInjectedError(RuntimeError):
+    """Raised by the chaos layer in place of running the task body."""
+
+
+@dataclasses.dataclass
+class ChaosConfig:
+    failure_prob: float = 0.0
+    delay_s: float = 0.0
+    max_injections: int = -1  # -1 = unlimited
+    name_filter: Optional[str] = None  # substring match on task name
+    seed: int = 0
+
+
+class _ChaosState:
+    def __init__(self):
+        self.config: Optional[ChaosConfig] = None
+        self.injected = 0
+        self.rng = np.random.default_rng(0)
+        self.lock = threading.Lock()
+
+
+_state = _ChaosState()
+
+
+def set_chaos(
+    failure_prob: float = 0.0,
+    delay_s: float = 0.0,
+    max_injections: int = -1,
+    name_filter: Optional[str] = None,
+    seed: int = 0,
+) -> None:
+    with _state.lock:
+        _state.config = ChaosConfig(
+            failure_prob, delay_s, max_injections, name_filter, seed
+        )
+        _state.injected = 0
+        _state.rng = np.random.default_rng(seed)
+
+
+def clear_chaos() -> None:
+    with _state.lock:
+        _state.config = None
+        _state.injected = 0
+
+
+def num_injected() -> int:
+    return _state.injected
+
+
+def load_from_env() -> None:
+    raw = os.environ.get("RAY_TPU_CHAOS")
+    if not raw:
+        return
+    kwargs = {}
+    for part in raw.split(","):
+        k, _, v = part.partition("=")
+        k = k.strip()
+        if k in ("failure_prob", "delay_s"):
+            kwargs[k] = float(v)
+        elif k in ("max_injections", "seed"):
+            kwargs[k] = int(v)
+        elif k == "name_filter":
+            kwargs[k] = v
+    set_chaos(**kwargs)
+
+
+def maybe_inject(task_name: str) -> None:
+    """Called by the scheduler before running a task body."""
+    config = _state.config
+    if config is None:
+        return
+    if config.name_filter and config.name_filter not in task_name:
+        return
+    with _state.lock:
+        if 0 <= config.max_injections <= _state.injected:
+            return
+        if config.delay_s > 0:
+            time.sleep(config.delay_s)
+        if config.failure_prob > 0 and _state.rng.random() < config.failure_prob:
+            _state.injected += 1
+            raise ChaosInjectedError(
+                f"chaos: injected failure in task {task_name!r} "
+                f"(#{_state.injected})"
+            )

@@ -1,0 +1,169 @@
+"""ctypes binding for the native arena object store (native/objstore.cc).
+
+The C++ library owns placement (first-fit free list with coalescing), pin
+counts, and LRU ordering; this wrapper owns lifecycle and hands out
+zero-copy memoryviews into the arena (numpy `frombuffer` reads straight
+from shared memory — the plasma zero-copy-deserialize property,
+/root/reference/src/ray/object_manager/plasma/store.h:55).
+
+Build: `sh native/build.sh` (also attempted lazily on first use).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_LIB_PATH = os.path.join(os.path.dirname(__file__), "_native", "libobjstore.so")
+_BUILD_SCRIPT = os.path.join(
+    os.path.dirname(__file__), "..", "..", "native", "build.sh"
+)
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _load_lib() -> Optional[ctypes.CDLL]:
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_LIB_PATH) and os.path.exists(_BUILD_SCRIPT):
+            try:
+                subprocess.run(
+                    ["sh", _BUILD_SCRIPT], capture_output=True, check=True, timeout=120
+                )
+            except Exception:
+                return None
+        if not os.path.exists(_LIB_PATH):
+            return None
+        lib = ctypes.CDLL(_LIB_PATH)
+        lib.store_create_arena.restype = ctypes.c_void_p
+        lib.store_create_arena.argtypes = [ctypes.c_uint64]
+        lib.store_destroy_arena.argtypes = [ctypes.c_void_p]
+        lib.store_create.restype = ctypes.c_int64
+        lib.store_create.argtypes = [ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64]
+        lib.store_seal.restype = ctypes.c_int
+        lib.store_seal.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.store_get.restype = ctypes.c_int64
+        lib.store_get.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_uint64),
+        ]
+        lib.store_unpin.restype = ctypes.c_int
+        lib.store_unpin.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.store_delete.restype = ctypes.c_int
+        lib.store_delete.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.store_lru_candidate.restype = ctypes.c_int64
+        lib.store_lru_candidate.argtypes = [ctypes.c_void_p]
+        for name in ("store_used", "store_capacity", "store_num_objects",
+                     "store_num_free_blocks"):
+            fn = getattr(lib, name)
+            fn.restype = ctypes.c_uint64
+            fn.argtypes = [ctypes.c_void_p]
+        lib.store_base.restype = ctypes.c_void_p
+        lib.store_base.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    return _load_lib() is not None
+
+
+class NativeArena:
+    """One process-local arena. Not a singleton: the tiered ObjectStore owns
+    one as its shared-memory tier; tests create scratch arenas freely."""
+
+    def __init__(self, capacity: int):
+        lib = _load_lib()
+        if lib is None:
+            raise RuntimeError(
+                "native object store unavailable (build failed / no g++)"
+            )
+        self._lib = lib
+        self._arena = lib.store_create_arena(capacity)
+        if not self._arena:
+            raise MemoryError(f"cannot allocate {capacity}-byte arena")
+        self._base = lib.store_base(self._arena)
+        self._closed = False
+
+    def put(self, object_id: int, payload: bytes | memoryview) -> bool:
+        """Copy payload into the arena and seal. False if it cannot fit even
+        after the caller's spill loop should run (use lru_candidate)."""
+        view = memoryview(payload)
+        size = view.nbytes
+        offset = self._lib.store_create(self._arena, object_id, size)
+        if offset < 0:
+            return False
+        ctypes.memmove(self._base + offset, (ctypes.c_char * size).from_buffer_copy(view), size)
+        self._lib.store_seal(self._arena, object_id)
+        return True
+
+    def get(self, object_id: int) -> Optional[memoryview]:
+        """Zero-copy view, pinned until `unpin(object_id)`."""
+        size = ctypes.c_uint64()
+        offset = self._lib.store_get(self._arena, object_id, ctypes.byref(size))
+        if offset < 0:
+            return None
+        buf = (ctypes.c_char * size.value).from_address(self._base + offset)
+        return memoryview(buf)
+
+    def unpin(self, object_id: int) -> None:
+        self._lib.store_unpin(self._arena, object_id)
+
+    def delete(self, object_id: int) -> bool:
+        return self._lib.store_delete(self._arena, object_id) == 0
+
+    def lru_candidate(self) -> Optional[int]:
+        cand = self._lib.store_lru_candidate(self._arena)
+        return None if cand < 0 else int(cand)
+
+    def put_with_eviction(self, object_id: int, payload, on_evict=None) -> bool:
+        """put(), evicting LRU objects until it fits. on_evict(id, view) runs
+        before each eviction (the spill hook)."""
+        while True:
+            if self.put(object_id, payload):
+                return True
+            victim = self.lru_candidate()
+            if victim is None:
+                return False
+            if on_evict is not None:
+                view = self.get(victim)
+                try:
+                    on_evict(victim, view)
+                finally:
+                    self.unpin(victim)
+            if not self.delete(victim):
+                return False
+
+    @property
+    def used(self) -> int:
+        return self._lib.store_used(self._arena)
+
+    @property
+    def capacity(self) -> int:
+        return self._lib.store_capacity(self._arena)
+
+    @property
+    def num_objects(self) -> int:
+        return self._lib.store_num_objects(self._arena)
+
+    @property
+    def num_free_blocks(self) -> int:
+        return self._lib.store_num_free_blocks(self._arena)
+
+    def close(self) -> None:
+        if not self._closed:
+            self._lib.store_destroy_arena(self._arena)
+            self._closed = True
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

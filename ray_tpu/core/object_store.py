@@ -40,10 +40,13 @@ class Tier(enum.Enum):
     INLINE = "inline"      # small host objects, kept as-is in process
     HOST = "host"          # large host objects (numpy etc.), spillable
     DEVICE = "device"      # jax.Array handles (HBM); spill via host copy
+    SHM = "shm"            # native arena (ray_tpu/core/_native), numpy only
     SPILLED = "spilled"    # on disk
 
 
 _INLINE_MAX_BYTES = 100 * 1024  # mirrors reference task_transport inline cutoff
+_SHM_MIN_BYTES = 64 * 1024  # numpy arrays this large go to the native arena
+_NATIVE_STORE_ENV = "RAY_TPU_NATIVE_STORE"
 
 
 def _estimate_nbytes(value: Any) -> int:
@@ -116,7 +119,22 @@ class ObjectStore:
         self._spill_dir = spill_dir
         self.stats = {
             "puts": 0, "gets": 0, "spills": 0, "restores": 0, "evictions": 0,
+            "shm_puts": 0, "shm_evictions": 0,
         }
+        # Opt-in native shared-memory tier (plasma-equivalent arena) for
+        # large numpy payloads. In-process workers pass objects by reference
+        # already, so this buys bounded accounting + native LRU eviction and
+        # is the substrate for multi-process CPU workers.
+        self._arena = None
+        if os.environ.get(_NATIVE_STORE_ENV, "").lower() in ("1", "true"):
+            try:
+                from .native_store import NativeArena, native_available
+
+                if native_available():
+                    self._arena = NativeArena(capacity_bytes)
+            except Exception:
+                self._arena = None
+        self._shm_entries: Dict[int, ObjectID] = {}  # arena id -> object id
 
     # ------------------------------------------------------------------ write
 
@@ -136,11 +154,38 @@ class ObjectStore:
         self.seal(object_id, value)
         return entry
 
+    def _try_shm_seal(self, object_id: ObjectID, value: Any, nbytes: int):
+        """Place a large numpy array into the native arena; returns the
+        SHM metadata value, or None to fall through to the host tier."""
+        import numpy as np
+
+        if (
+            self._arena is None
+            or not isinstance(value, np.ndarray)
+            or value.dtype == object
+            or nbytes < _SHM_MIN_BYTES
+        ):
+            return None
+        aid = int(object_id.hex()[:16], 16)
+        contiguous = np.ascontiguousarray(value)
+        ok = self._arena.put_with_eviction(
+            aid, contiguous.reshape(-1).view(np.uint8).data, on_evict=self._on_arena_evict
+        )
+        if not ok:
+            return None
+        self._shm_entries[aid] = object_id
+        self.stats["shm_puts"] += 1
+        return ("__shm__", aid, str(value.dtype), value.shape)
+
     def seal(self, object_id: ObjectID, value: Any) -> None:
         with self._lock:
             entry = self._entries[object_id]
             nbytes = _estimate_nbytes(value)
-            if _is_device_array(value):
+            shm_meta = self._try_shm_seal(object_id, value, nbytes)
+            if shm_meta is not None:
+                tier = Tier.SHM
+                value = shm_meta
+            elif _is_device_array(value):
                 tier = Tier.DEVICE
                 self._device_bytes += nbytes
             elif nbytes <= _INLINE_MAX_BYTES:
@@ -238,6 +283,8 @@ class ObjectStore:
             if entry.tier == Tier.SPILLED:
                 value = self._restore(entry)
                 restored = True
+            elif entry.tier == Tier.SHM:
+                value = self._shm_get(entry)
             else:
                 value = entry.value
         if restored:
@@ -269,6 +316,10 @@ class ObjectStore:
                     self._device_bytes -= entry.nbytes
                 elif entry.tier in (Tier.INLINE, Tier.HOST):
                     self._host_bytes -= entry.nbytes
+                elif entry.tier == Tier.SHM and self._arena is not None:
+                    aid = entry.value[1]
+                    self._shm_entries.pop(aid, None)
+                    self._arena.delete(aid)
                 if entry.spill_path and os.path.exists(entry.spill_path):
                     os.unlink(entry.spill_path)
 
@@ -301,6 +352,49 @@ class ObjectStore:
                     with self._lock:
                         self._host_bytes -= entry.nbytes
                     self.stats["evictions"] += 1
+
+    def _shm_get(self, entry: ObjectEntry):
+        """Reconstruct a numpy array from the arena. Copy-out: in-process
+        consumers must not hold views into a block the allocator may
+        recycle (multi-process mmap consumers will get true zero-copy)."""
+        import numpy as np
+
+        _, aid, dtype_str, shape = entry.value
+        view = self._arena.get(aid)
+        if view is None:  # evicted to disk between seal and get
+            if entry.spill_path:
+                return self._restore(entry)
+            raise ObjectLostError(entry.object_id)
+        try:
+            return np.frombuffer(view, dtype=np.dtype(dtype_str)).reshape(shape).copy()
+        finally:
+            self._arena.unpin(aid)
+
+    def _on_arena_evict(self, aid: int, view) -> None:
+        """Native LRU chose a victim: spill it to disk first if we can."""
+        import numpy as np
+
+        object_id = self._shm_entries.pop(aid, None)
+        if object_id is None:
+            return
+        entry = self._entries.get(object_id)
+        if entry is None:
+            return
+        _, _, dtype_str, shape = entry.value
+        if self._spill_dir is not None:
+            os.makedirs(self._spill_dir, exist_ok=True)
+            path = os.path.join(self._spill_dir, entry.object_id.hex())
+            arr = np.frombuffer(view, dtype=np.dtype(dtype_str)).reshape(shape)
+            with open(path, "wb") as f:
+                pickle.dump(arr.copy(), f, protocol=pickle.HIGHEST_PROTOCOL)
+            entry.spill_path = path
+            entry.tier = Tier.SPILLED
+            self.stats["spills"] += 1
+        else:
+            entry.value = None
+            entry.state = ObjectState.LOST
+            self.stats["evictions"] += 1
+        self.stats["shm_evictions"] += 1
 
     def _spill(self, entry: ObjectEntry) -> None:
         """Write one entry to disk. Caller holds entry.lock (NOT the store

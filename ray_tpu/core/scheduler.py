@@ -511,6 +511,9 @@ class ClusterScheduler:
         error: Optional[BaseException] = None
         error_tb = ""
         try:
+            from . import chaos
+
+            chaos.maybe_inject(spec.name)
             args = _resolve(spec.args, self._store)
             kwargs = _resolve(spec.kwargs, self._store)
             result = spec.func(*args, **kwargs)

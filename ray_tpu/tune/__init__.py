@@ -1,0 +1,23 @@
+"""ray_tpu.tune — experiment sweeps (Ray Tune equivalent).
+
+Search spaces (grid/random domains), trial schedulers (ASHA, median
+stopping), and a Tuner running concurrent trial actors with early stop.
+Report from a trainable with ray_tpu.train.report(...).
+"""
+
+from ..train.session import report  # noqa: F401  (tune.report alias)
+from .schedulers import (  # noqa: F401
+    ASHAScheduler,
+    FIFOScheduler,
+    MedianStoppingRule,
+    TrialScheduler,
+)
+from .search import (  # noqa: F401
+    choice,
+    generate_variants,
+    grid_search,
+    loguniform,
+    randint,
+    uniform,
+)
+from .tuner import ResultGrid, Trial, TrialStatus, TuneConfig, Tuner  # noqa: F401

@@ -1,0 +1,108 @@
+"""Trial schedulers: early stopping on intermediate results.
+
+Reference parity: tune/schedulers/async_hyperband.py:19 ASHAScheduler,
+median_stopping_rule.py. Decisions run on every report: CONTINUE or STOP.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Dict, List
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+
+
+class TrialScheduler:
+    def on_result(self, trial_id: str, result: Dict) -> str:
+        return CONTINUE
+
+
+class FIFOScheduler(TrialScheduler):
+    """No early stopping."""
+
+
+class ASHAScheduler(TrialScheduler):
+    """Async Successive Halving: at each rung (grace_period · rf^k steps of
+    `time_attr`), stop a trial whose metric is outside the top 1/rf of
+    completed rung peers."""
+
+    def __init__(
+        self,
+        metric: str,
+        mode: str = "max",
+        time_attr: str = "training_iteration",
+        grace_period: int = 1,
+        reduction_factor: int = 4,
+        max_t: int = 100,
+    ):
+        assert mode in ("max", "min")
+        self.metric = metric
+        self.mode = mode
+        self.time_attr = time_attr
+        self.rf = reduction_factor
+        self.rungs: List[int] = []
+        t = grace_period
+        while t < max_t:
+            self.rungs.append(t)
+            t *= reduction_factor
+        # rung milestone -> recorded metric per trial
+        self._rung_records: Dict[int, Dict[str, float]] = collections.defaultdict(dict)
+        self._stopped: set = set()
+
+    def on_result(self, trial_id: str, result: Dict) -> str:
+        if trial_id in self._stopped:
+            return STOP
+        t = result.get(self.time_attr)
+        value = result.get(self.metric)
+        if t is None or value is None:
+            return CONTINUE
+        value = float(value)
+        decision = CONTINUE
+        for rung in self.rungs:
+            if t < rung:
+                break
+            records = self._rung_records[rung]
+            if trial_id not in records:
+                records[trial_id] = value
+                if not self._in_top_fraction(records, value):
+                    decision = STOP
+        if decision == STOP:
+            self._stopped.add(trial_id)
+        return decision
+
+    def _in_top_fraction(self, records: Dict[str, float], value: float) -> bool:
+        values = sorted(records.values(), reverse=(self.mode == "max"))
+        k = max(1, len(values) // self.rf)
+        cutoff = values[k - 1]
+        return value >= cutoff if self.mode == "max" else value <= cutoff
+
+
+class MedianStoppingRule(TrialScheduler):
+    """Stop a trial whose latest metric is worse than the median of peers'
+    running averages at the same step count."""
+
+    def __init__(self, metric: str, mode: str = "max", grace_period: int = 1,
+                 time_attr: str = "training_iteration"):
+        self.metric = metric
+        self.mode = mode
+        self.grace = grace_period
+        self.time_attr = time_attr
+        self._history: Dict[str, List[float]] = collections.defaultdict(list)
+
+    def on_result(self, trial_id: str, result: Dict) -> str:
+        value = result.get(self.metric)
+        if value is None:
+            return CONTINUE
+        self._history[trial_id].append(float(value))
+        t = result.get(self.time_attr, len(self._history[trial_id]))
+        if t < self.grace or len(self._history) < 3:
+            return CONTINUE
+        means = {
+            tid: sum(vs) / len(vs) for tid, vs in self._history.items() if vs
+        }
+        peer_means = sorted(means.values())
+        median = peer_means[len(peer_means) // 2]
+        mine = means[trial_id]
+        worse = mine < median if self.mode == "max" else mine > median
+        return STOP if worse else CONTINUE

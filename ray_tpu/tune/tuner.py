@@ -1,0 +1,155 @@
+"""Tuner: trial FSM + concurrent execution + scheduler-driven early stop.
+
+Reference parity: tune/tune.py Tuner → TuneController (tune/execution/
+tune_controller.py:68) event loop over the actor manager. Trials are
+TrainWorker actors (reused from ray_tpu.train) reporting through the
+session; the controller polls, feeds the scheduler, and kills trials the
+scheduler stops.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from .. import api
+from ..core.exceptions import ActorDiedError, TaskError
+from ..train.worker_group import TrainWorker
+from .schedulers import CONTINUE, STOP, FIFOScheduler, TrialScheduler
+from .search import generate_variants
+
+
+class TrialStatus(enum.Enum):
+    PENDING = "PENDING"
+    RUNNING = "RUNNING"
+    TERMINATED = "TERMINATED"  # finished normally
+    STOPPED = "STOPPED"  # early-stopped by the scheduler
+    ERRORED = "ERRORED"
+
+
+@dataclasses.dataclass
+class Trial:
+    trial_id: str
+    config: Dict[str, Any]
+    status: TrialStatus = TrialStatus.PENDING
+    last_result: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    history: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
+    error: Optional[str] = None
+    actor: Any = None
+    result_ref: Any = None
+    cursor: int = 0
+
+
+@dataclasses.dataclass
+class TuneConfig:
+    num_samples: int = 1
+    max_concurrent: int = 4
+    metric: Optional[str] = None
+    mode: str = "max"
+    scheduler: Optional[TrialScheduler] = None
+    seed: int = 0
+    resources_per_trial: Optional[Dict[str, float]] = None
+
+
+class ResultGrid:
+    def __init__(self, trials: List[Trial], metric: Optional[str], mode: str):
+        self.trials = trials
+        self._metric = metric
+        self._mode = mode
+
+    def get_best_result(
+        self, metric: Optional[str] = None, mode: Optional[str] = None
+    ) -> Trial:
+        metric = metric or self._metric
+        mode = mode or self._mode
+        if metric is None:
+            raise ValueError("no metric configured")
+        scored = [t for t in self.trials if metric in t.last_result]
+        if not scored:
+            raise ValueError(f"no trial reported metric {metric!r}")
+        key = lambda t: t.last_result[metric]  # noqa: E731
+        return max(scored, key=key) if mode == "max" else min(scored, key=key)
+
+    def __len__(self) -> int:
+        return len(self.trials)
+
+    def __iter__(self):
+        return iter(self.trials)
+
+
+class Tuner:
+    """Tuner(trainable, param_space=..., tune_config=...).fit()"""
+
+    def __init__(
+        self,
+        trainable: Callable[[Dict[str, Any]], Any],
+        *,
+        param_space: Dict[str, Any],
+        tune_config: Optional[TuneConfig] = None,
+    ):
+        self.trainable = trainable
+        self.param_space = param_space
+        self.config = tune_config or TuneConfig()
+
+    def fit(self, poll_interval: float = 0.05) -> ResultGrid:
+        cfg = self.config
+        scheduler = cfg.scheduler or FIFOScheduler()
+        trials = [
+            Trial(trial_id=f"trial_{i:05d}", config=variant)
+            for i, variant in enumerate(
+                generate_variants(self.param_space, cfg.num_samples, cfg.seed)
+            )
+        ]
+        pending = list(trials)
+        running: List[Trial] = []
+        actor_cls = api.remote(TrainWorker)
+
+        def launch(trial: Trial) -> None:
+            trial.actor = actor_cls.options(
+                max_concurrency=2,
+                resources=cfg.resources_per_trial or {"CPU": 1.0},
+                num_cpus=0,
+                name=f"tune-{trial.trial_id}",
+            ).remote(0, 1, trial.trial_id)
+            trial.result_ref = trial.actor.run.remote(self.trainable, trial.config)
+            trial.status = TrialStatus.RUNNING
+            running.append(trial)
+
+        while pending or running:
+            while pending and len(running) < cfg.max_concurrent:
+                launch(pending.pop(0))
+
+            for trial in list(running):
+                try:
+                    poll = api.get(trial.actor.poll.remote(trial.cursor), timeout=30)
+                except (ActorDiedError, TaskError) as e:
+                    trial.status = TrialStatus.ERRORED
+                    trial.error = repr(e)
+                    running.remove(trial)
+                    continue
+                decision = CONTINUE
+                for metrics, _ckpt, _rank, _ts in poll["reports"]:
+                    trial.cursor += 1
+                    metrics.setdefault("training_iteration", trial.cursor)
+                    trial.history.append(metrics)
+                    trial.last_result = metrics
+                    verdict = scheduler.on_result(trial.trial_id, metrics)
+                    if verdict == STOP:
+                        decision = STOP
+                if decision == STOP:
+                    trial.status = TrialStatus.STOPPED
+                    api.kill(trial.actor)
+                    running.remove(trial)
+                elif poll["done"]:
+                    if poll["error"]:
+                        trial.status = TrialStatus.ERRORED
+                        trial.error = poll["error"]
+                    else:
+                        trial.status = TrialStatus.TERMINATED
+                    api.kill(trial.actor)
+                    running.remove(trial)
+            if running:
+                time.sleep(poll_interval)
+        return ResultGrid(trials, cfg.metric, cfg.mode)

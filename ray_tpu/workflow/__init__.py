@@ -1,0 +1,146 @@
+"""ray_tpu.workflow — durable DAG execution (Ray Workflow equivalent).
+
+Reference parity: python/ray/workflow — workflow_executor.py + storage-
+backed step results (workflow_storage.py), resume-from-storage semantics.
+
+Steps form a DAG via .step(...) binding; run() executes steps as runtime
+tasks, persisting each result under storage/<workflow_id>/<step_id>.pkl.
+Step ids are content-addressed (function name + argument structure), so
+re-running the same driver code after a crash skips every step whose
+result is already on disk — exactly-once-ish without a database.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import os
+import pickle
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from .. import api
+
+
+@dataclasses.dataclass(frozen=True)
+class StepNode:
+    fn: Callable
+    args: Tuple[Any, ...]
+    kwargs: Tuple[Tuple[str, Any], ...]
+    name: str
+
+    @property
+    def step_id(self) -> str:
+        h = hashlib.sha1()
+        h.update(self.name.encode())
+        for a in self.args:
+            h.update(
+                a.step_id.encode() if isinstance(a, StepNode) else _digest(a)
+            )
+        for k, v in self.kwargs:
+            h.update(k.encode())
+            h.update(v.step_id.encode() if isinstance(v, StepNode) else _digest(v))
+        return f"{self.name}-{h.hexdigest()[:12]}"
+
+
+def _digest(value: Any) -> bytes:
+    try:
+        return hashlib.sha1(pickle.dumps(value)).digest()
+    except Exception:
+        return repr(value).encode()
+
+
+class _StepFunction:
+    def __init__(self, fn: Callable, name: Optional[str] = None):
+        self._fn = fn
+        self._name = name or fn.__name__
+
+    def step(self, *args, **kwargs) -> StepNode:
+        return StepNode(self._fn, args, tuple(sorted(kwargs.items())), self._name)
+
+    def __call__(self, *args, **kwargs):
+        return self._fn(*args, **kwargs)
+
+
+def step(fn: Optional[Callable] = None, *, name: Optional[str] = None):
+    """@workflow.step decorator; build nodes with fn.step(...)."""
+    if fn is None:
+        return lambda f: _StepFunction(f, name)
+    return _StepFunction(fn, name)
+
+
+# ------------------------------------------------------------------ execution
+
+
+class _Storage:
+    def __init__(self, root: str, workflow_id: str):
+        self.dir = os.path.join(os.fspath(root), workflow_id)
+        os.makedirs(self.dir, exist_ok=True)
+
+    def path(self, step_id: str) -> str:
+        return os.path.join(self.dir, f"{step_id}.pkl")
+
+    def has(self, step_id: str) -> bool:
+        return os.path.exists(self.path(step_id))
+
+    def load(self, step_id: str) -> Any:
+        with open(self.path(step_id), "rb") as f:
+            return pickle.load(f)
+
+    def save(self, step_id: str, value: Any) -> None:
+        tmp = self.path(step_id) + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(value, f)
+        os.replace(tmp, self.path(step_id))
+
+    def completed_steps(self) -> List[str]:
+        return sorted(
+            f[:-4] for f in os.listdir(self.dir) if f.endswith(".pkl")
+        )
+
+
+def run(
+    node: StepNode,
+    *,
+    storage: str,
+    workflow_id: str = "default",
+) -> Any:
+    """Execute the DAG rooted at `node`; persisted steps are not re-run."""
+    store = _Storage(storage, workflow_id)
+    memo: Dict[str, Any] = {}  # step_id -> ObjectRef or loaded value
+
+    def _persist_and_run(fn, step_id, store_dir, *resolved_args, **resolved_kwargs):
+        result = fn(*resolved_args, **resolved_kwargs)
+        s = _Storage(os.path.dirname(store_dir), os.path.basename(store_dir))
+        s.save(step_id, result)
+        return result
+
+    run_step = api.remote(_persist_and_run)
+
+    def submit(n: StepNode):
+        sid = n.step_id
+        if sid in memo:
+            return memo[sid]
+        if store.has(sid):
+            memo[sid] = store.load(sid)
+            return memo[sid]
+        resolved_args = [submit(a) if isinstance(a, StepNode) else a for a in n.args]
+        resolved_kwargs = {
+            k: (submit(v) if isinstance(v, StepNode) else v) for k, v in n.kwargs
+        }
+        # args that are refs are resolved by the runtime before fn runs
+        ref = run_step.remote(n.fn, sid, store.dir, *resolved_args, **resolved_kwargs)
+        memo[sid] = ref
+        return ref
+
+    out = submit(node)
+    return api.get(out) if not _is_plain(out) else out
+
+
+def _is_plain(value: Any) -> bool:
+    from ..core.runtime import ObjectRef
+
+    return not isinstance(value, ObjectRef)
+
+
+def list_completed(storage: str, workflow_id: str = "default") -> List[str]:
+    return _Storage(storage, workflow_id).completed_steps()

@@ -1,0 +1,81 @@
+"""Chaos injection: failures surface as task errors; retries recover."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core import chaos
+
+
+@pytest.fixture(autouse=True)
+def rt():
+    runtime = ray_tpu.init(num_cpus=4, detect_accelerators=False)
+    yield runtime
+    chaos.clear_chaos()
+    ray_tpu.shutdown()
+
+
+def test_injected_failure_surfaces_as_task_error():
+    chaos.set_chaos(failure_prob=1.0, name_filter="victim")
+
+    @ray_tpu.remote(name="victim")
+    def victim():
+        return 1
+
+    with pytest.raises(ray_tpu.TaskError, match="chaos"):
+        ray_tpu.get(victim.remote())
+
+
+def test_name_filter_spares_other_tasks():
+    chaos.set_chaos(failure_prob=1.0, name_filter="victim")
+
+    @ray_tpu.remote(name="innocent")
+    def innocent():
+        return 42
+
+    assert ray_tpu.get(innocent.remote()) == 42
+
+
+def test_retries_recover_from_bounded_chaos():
+    # exactly 2 injections, then clean: max_retries=3 must succeed
+    chaos.set_chaos(failure_prob=1.0, max_injections=2, name_filter="flaky")
+
+    @ray_tpu.remote(name="flaky", max_retries=3, retry_exceptions=True)
+    def flaky():
+        return "survived"
+
+    assert ray_tpu.get(flaky.remote()) == "survived"
+    assert chaos.num_injected() == 2
+
+
+def test_chaos_env_parsing(monkeypatch):
+    monkeypatch.setenv(
+        "RAY_TPU_CHAOS", "failure_prob=0.5,delay_s=0.01,max_injections=3,name_filter=x"
+    )
+    chaos.load_from_env()
+    cfg = chaos._state.config
+    assert cfg.failure_prob == 0.5
+    assert cfg.delay_s == 0.01
+    assert cfg.max_injections == 3
+    assert cfg.name_filter == "x"
+
+
+def test_chaos_under_training_controller_restart():
+    """End-to-end: chaos kills the train fn; the failure policy restarts."""
+    from ray_tpu.train import FailureConfig, RunConfig, ScalingConfig, Trainer
+
+    chaos.set_chaos(failure_prob=1.0, max_injections=1, name_filter="TrainWorker.run")
+
+    def loop(config):
+        from ray_tpu import train
+
+        train.report({"ok": 1})
+        return "done"
+
+    trainer = Trainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(failure=FailureConfig(max_failures=2)),
+        train_loop_config={},
+    )
+    result = trainer.fit()
+    assert result.status.value == "FINISHED"

@@ -1,0 +1,107 @@
+"""Tune: variants, ASHA early stopping, end-to-end sweep."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+
+
+@pytest.fixture(autouse=True)
+def rt():
+    runtime = ray_tpu.init(num_cpus=8, detect_accelerators=False)
+    yield runtime
+    ray_tpu.shutdown()
+
+
+def test_generate_variants_grid_and_random():
+    space = {
+        "a": tune.grid_search([1, 2, 3]),
+        "b": tune.uniform(0.0, 1.0),
+        "c": "fixed",
+    }
+    variants = list(tune.generate_variants(space, num_samples=2, seed=0))
+    assert len(variants) == 6  # 3 grid × 2 samples
+    assert {v["a"] for v in variants} == {1, 2, 3}
+    assert all(0.0 <= v["b"] <= 1.0 for v in variants)
+    assert all(v["c"] == "fixed" for v in variants)
+
+
+def test_domains_sample_in_range():
+    rng = np.random.default_rng(0)
+    assert 1e-4 <= tune.loguniform(1e-4, 1e-1).sample(rng) <= 1e-1
+    assert tune.randint(3, 7).sample(rng) in (3, 4, 5, 6)
+    assert tune.choice(["x", "y"]).sample(rng) in ("x", "y")
+
+
+def test_asha_stops_bad_trials_unit():
+    sched = tune.ASHAScheduler(
+        metric="score", mode="max", grace_period=2, reduction_factor=2, max_t=16
+    )
+    # first at a rung is trivially in the top fraction
+    assert sched.on_result("good1", {"training_iteration": 2, "score": 10}) == "CONTINUE"
+    # ties with the cutoff → stays (async halving keeps >= cutoff)
+    assert sched.on_result("good2", {"training_iteration": 2, "score": 10}) == "CONTINUE"
+    # clearly worse at the same rung → cut
+    assert sched.on_result("bad", {"training_iteration": 2, "score": 1}) == "STOP"
+    # once stopped, stays stopped
+    assert sched.on_result("bad", {"training_iteration": 3, "score": 99}) == "STOP"
+
+
+def test_tuner_end_to_end_sweep():
+    def trainable(config):
+        # quadratic: best at x=3
+        score = -((config["x"] - 3.0) ** 2)
+        for i in range(3):
+            tune.report({"score": score + 0.01 * i})
+        return score
+
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"x": tune.grid_search([0.0, 1.0, 3.0, 5.0])},
+        tune_config=tune.TuneConfig(num_samples=1, metric="score", mode="max"),
+    )
+    results = tuner.fit()
+    assert len(results) == 4
+    best = results.get_best_result()
+    assert best.config["x"] == 3.0
+    assert all(t.status == tune.TrialStatus.TERMINATED for t in results)
+
+
+def test_tuner_with_asha_stops_some():
+    def trainable(config):
+        for i in range(1, 9):
+            tune.report({"loss": config["badness"] * i})
+
+    sched = tune.ASHAScheduler(
+        metric="loss", mode="min", grace_period=2, reduction_factor=2, max_t=8
+    )
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"badness": tune.grid_search([1.0, 2.0, 5.0, 10.0])},
+        tune_config=tune.TuneConfig(
+            metric="loss", mode="min", scheduler=sched, max_concurrent=4
+        ),
+    )
+    results = tuner.fit()
+    best = results.get_best_result()
+    assert best.config["badness"] == 1.0
+    stopped = [t for t in results if t.status == tune.TrialStatus.STOPPED]
+    assert stopped, "ASHA never stopped anything"
+
+
+def test_tuner_handles_erroring_trial():
+    def trainable(config):
+        if config["x"] == 1:
+            raise RuntimeError("bad trial")
+        tune.report({"score": config["x"]})
+
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"x": tune.grid_search([0, 1, 2])},
+        tune_config=tune.TuneConfig(metric="score", mode="max"),
+    )
+    results = tuner.fit()
+    statuses = {t.config["x"]: t.status for t in results}
+    assert statuses[1] == tune.TrialStatus.ERRORED
+    assert results.get_best_result().config["x"] == 2

@@ -1,0 +1,94 @@
+"""Workflow: DAG execution, persistence, crash-resume semantics."""
+
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu import workflow
+
+
+@pytest.fixture(autouse=True)
+def rt():
+    runtime = ray_tpu.init(num_cpus=8, detect_accelerators=False)
+    yield runtime
+    ray_tpu.shutdown()
+
+
+def test_linear_dag(tmp_path):
+    @workflow.step
+    def add(a, b):
+        return a + b
+
+    @workflow.step
+    def double(x):
+        return 2 * x
+
+    out = double.step(add.step(1, 2))
+    assert workflow.run(out, storage=str(tmp_path), workflow_id="lin") == 6
+
+
+def test_diamond_dag_runs_shared_step_once(tmp_path):
+    calls = tmp_path / "calls"
+    calls.mkdir()
+
+    @workflow.step
+    def source():
+        (calls / f"src_{len(os.listdir(calls))}").touch()
+        return 10
+
+    @workflow.step
+    def left(x):
+        return x + 1
+
+    @workflow.step
+    def right(x):
+        return x + 2
+
+    @workflow.step
+    def join(a, b):
+        return a * b
+
+    s = source.step()
+    out = join.step(left.step(s), right.step(s))
+    assert workflow.run(out, storage=str(tmp_path), workflow_id="dia") == 11 * 12
+    # the shared upstream step executed exactly once
+    assert len(os.listdir(calls)) == 1
+
+
+def test_resume_skips_completed_steps(tmp_path):
+    progress = tmp_path / "progress.txt"
+
+    @workflow.step
+    def expensive():
+        progress.write_text(progress.read_text() + "E" if progress.exists() else "E")
+        return 5
+
+    @workflow.step
+    def flaky(x):
+        if not (tmp_path / "fixed").exists():
+            raise RuntimeError("crash on first run")
+        return x * 10
+
+    dag = flaky.step(expensive.step())
+    with pytest.raises(Exception):
+        workflow.run(dag, storage=str(tmp_path), workflow_id="wf")
+    # expensive committed before the crash
+    assert "E" == progress.read_text()
+    assert any(s.startswith("expensive") for s in workflow.list_completed(str(tmp_path), "wf"))
+
+    (tmp_path / "fixed").touch()
+    assert workflow.run(dag, storage=str(tmp_path), workflow_id="wf") == 50
+    # expensive did NOT re-run on resume
+    assert "E" == progress.read_text()
+
+
+def test_different_args_are_different_steps(tmp_path):
+    @workflow.step
+    def inc(x):
+        return x + 1
+
+    assert workflow.run(inc.step(1), storage=str(tmp_path), workflow_id="a") == 2
+    assert workflow.run(inc.step(10), storage=str(tmp_path), workflow_id="a") == 11
+    completed = workflow.list_completed(str(tmp_path), "a")
+    assert len(completed) == 2
