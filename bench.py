@@ -36,7 +36,9 @@ def main() -> None:
     from ray_tpu.parallel import MeshSpec, build_mesh
     from ray_tpu.train import create_train_state, default_optimizer, make_train_step
 
-    config = get_config("gpt2-small")
+    # full layer-unroll measured fastest on-chip at this size (+15% over
+    # scan: XLA fuses/overlaps across layer boundaries)
+    config = get_config("gpt2-small").replace(scan_unroll=12)
     devices = jax.devices()
     mesh = build_mesh(MeshSpec(), devices=devices[:1])
     opt = default_optimizer(3e-4, total_steps=1000)

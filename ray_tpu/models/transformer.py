@@ -63,6 +63,8 @@ class TransformerConfig:
     remat: bool = False
     attn_impl: Optional[str] = None  # None → pallas on TPU, xla elsewhere
     causal: bool = True  # False → bidirectional encoder (ViT, CLIP text off)
+    fused_qkv: bool = False  # single [E, (Hq+2Hkv)·Dh] projection matmul
+    scan_unroll: int = 1  # lax.scan unroll for the layer stack
 
     @property
     def kv_heads(self) -> int:
@@ -193,9 +195,28 @@ def attention_sublayer(
     c = config
     dt = c.dtype
     h = _norm(x, lp["ln1_scale"], lp.get("ln1_bias"), c.norm)
-    q = jnp.einsum("bse,ehd->bhsd", h, lp["wq"].astype(dt))
-    k = jnp.einsum("bse,ehd->bhsd", h, lp["wk"].astype(dt))
-    v = jnp.einsum("bse,ehd->bhsd", h, lp["wv"].astype(dt))
+    if c.fused_qkv:
+        # one wide matmul beats three narrow ones on the MXU; the concat of
+        # the (static) weights folds into the kernel at compile time
+        wqkv = jnp.concatenate(
+            [
+                lp["wq"].reshape(c.d_model, -1),
+                lp["wk"].reshape(c.d_model, -1),
+                lp["wv"].reshape(c.d_model, -1),
+            ],
+            axis=-1,
+        ).astype(dt)
+        qkv = jnp.einsum("bse,ef->bsf", h, wqkv)
+        nq = c.n_heads * c.head_dim
+        nkv = c.kv_heads * c.head_dim
+        b_, s_, _ = qkv.shape
+        q = qkv[..., :nq].reshape(b_, s_, c.n_heads, c.head_dim).transpose(0, 2, 1, 3)
+        k = qkv[..., nq : nq + nkv].reshape(b_, s_, c.kv_heads, c.head_dim).transpose(0, 2, 1, 3)
+        v = qkv[..., nq + nkv :].reshape(b_, s_, c.kv_heads, c.head_dim).transpose(0, 2, 1, 3)
+    else:
+        q = jnp.einsum("bse,ehd->bhsd", h, lp["wq"].astype(dt))
+        k = jnp.einsum("bse,ehd->bhsd", h, lp["wk"].astype(dt))
+        v = jnp.einsum("bse,ehd->bhsd", h, lp["wv"].astype(dt))
     if c.use_bias:
         q = q + lp["bq"].astype(dt)[None, :, None, :]
         k = k + lp["bk"].astype(dt)[None, :, None, :]
@@ -268,7 +289,7 @@ def forward(
 
     if c.remat:
         block_fn = jax.checkpoint(block_fn)
-    x, _ = jax.lax.scan(block_fn, x, params["blocks"])
+    x, _ = jax.lax.scan(block_fn, x, params["blocks"], unroll=c.scan_unroll)
 
     x = _norm(x, params["lnf_scale"], params.get("lnf_bias"), c.norm)
     head = params.get("lm_head", None)

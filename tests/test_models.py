@@ -155,3 +155,15 @@ def test_grad_flows(model):
     norms = [float(jnp.linalg.norm(x)) for x in jax.tree.leaves(g)]
     assert all(np.isfinite(norms))
     assert sum(norms) > 0
+
+
+def test_fused_qkv_and_unroll_match_baseline():
+    """The perf knobs are numerically inert."""
+    config = get_config("llama-tiny")
+    params = init_params(config, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, config.vocab_size)
+    base = forward(params, tokens, config)
+    fused = forward(params, tokens, config.replace(fused_qkv=True))
+    unrolled = forward(params, tokens, config.replace(scan_unroll=4))
+    np.testing.assert_allclose(np.asarray(base), np.asarray(fused), atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(base), np.asarray(unrolled), atol=1e-6)
