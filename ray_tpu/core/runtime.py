@@ -188,6 +188,9 @@ class Runtime:
                 "ok": error is None,
                 "attempt": spec.attempt,
                 "ts": time.time(),
+                "start_ts": spec.start_ts,
+                "end_ts": spec.end_ts or time.time(),
+                "node": spec.node_hex,
             }
         )
         if len(self._task_events) > 100_000:

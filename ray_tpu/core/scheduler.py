@@ -90,6 +90,10 @@ class TaskSpec:
     attempt: int = 0
     cancelled: bool = False
     submitted_at: float = field(default_factory=time.monotonic)
+    # observability (filled by the task runner; consumed by the timeline)
+    start_ts: float = 0.0
+    end_ts: float = 0.0
+    node_hex: str = ""
 
 
 # --------------------------------------------------------------------------- node
@@ -510,6 +514,8 @@ class ClusterScheduler:
     def _run_task(self, spec: TaskSpec, node: Node, pool: ResourceSet) -> None:
         error: Optional[BaseException] = None
         error_tb = ""
+        spec.start_ts = time.time()
+        spec.node_hex = node.node_id.hex()
         try:
             from . import chaos
 
@@ -539,6 +545,7 @@ class ClusterScheduler:
                 self.submit(spec)
                 return
             self._fail_returns(spec, TaskError(spec.name, error, error_tb))
+        spec.end_ts = time.time()
         self._on_task_done(spec, error)
         self._wake.set()
 

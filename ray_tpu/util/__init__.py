@@ -1,0 +1,18 @@
+"""ray_tpu.util — observability (metrics, state API, task timeline)."""
+
+from .metrics import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    register_runtime_gauges,
+    registry,
+    start_metrics_server,
+)
+from .state import (  # noqa: F401
+    chrome_tracing_dump,
+    list_actors,
+    list_nodes,
+    list_objects,
+    list_tasks,
+    summary,
+)

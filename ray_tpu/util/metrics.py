@@ -1,0 +1,222 @@
+"""Metrics: Counter/Gauge/Histogram registry with Prometheus exposition.
+
+Reference parity: python/ray/util/metrics.py (user-facing metric types) +
+the per-node metrics agent exporting OpenCensus → Prometheus
+(_private/metrics_agent.py). Single-process inversion: one registry, a
+stdlib HTTP /metrics endpoint, and callback gauges that sample runtime
+internals (scheduler/object-store/serve stats) at scrape time instead of a
+push pipeline.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+TagDict = Dict[str, str]
+
+
+def _tags_key(tags: Optional[TagDict]) -> Tuple[Tuple[str, str], ...]:
+    return tuple(sorted((tags or {}).items()))
+
+
+class _Metric:
+    def __init__(self, name: str, description: str = "", tag_keys: Sequence[str] = ()):
+        self.name = name
+        self.description = description
+        self.tag_keys = tuple(tag_keys)
+        self._lock = threading.Lock()
+        _registry().register(self)
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def __init__(self, name, description="", tag_keys=()):
+        super().__init__(name, description, tag_keys)
+        self._values: Dict[tuple, float] = {}
+
+    def inc(self, value: float = 1.0, tags: Optional[TagDict] = None) -> None:
+        key = _tags_key(tags)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + value
+
+    def collect(self):
+        with self._lock:
+            return [(dict(k), v) for k, v in self._values.items()]
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def __init__(self, name, description="", tag_keys=(), fn: Optional[Callable[[], float]] = None):
+        super().__init__(name, description, tag_keys)
+        self._values: Dict[tuple, float] = {}
+        self._fn = fn  # callback gauge: sampled at scrape time
+
+    def set(self, value: float, tags: Optional[TagDict] = None) -> None:
+        with self._lock:
+            self._values[_tags_key(tags)] = float(value)
+
+    def collect(self):
+        if self._fn is not None:
+            try:
+                return [({}, float(self._fn()))]
+            except Exception:
+                return []
+        with self._lock:
+            return [(dict(k), v) for k, v in self._values.items()]
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, name, description="", boundaries: Sequence[float] = (), tag_keys=()):
+        super().__init__(name, description, tag_keys)
+        self.boundaries = sorted(boundaries) or [0.01, 0.1, 1.0, 10.0]
+        self._counts: Dict[tuple, List[int]] = {}
+        self._sums: Dict[tuple, float] = {}
+        self._totals: Dict[tuple, int] = {}
+
+    def observe(self, value: float, tags: Optional[TagDict] = None) -> None:
+        key = _tags_key(tags)
+        with self._lock:
+            counts = self._counts.setdefault(key, [0] * (len(self.boundaries) + 1))
+            counts[bisect.bisect_left(self.boundaries, value)] += 1
+            self._sums[key] = self._sums.get(key, 0.0) + value
+            self._totals[key] = self._totals.get(key, 0) + 1
+
+    def collect(self):
+        with self._lock:
+            out = []
+            for key, counts in self._counts.items():
+                out.append(
+                    (dict(key), {
+                        "buckets": list(zip(self.boundaries, counts)),
+                        "sum": self._sums[key],
+                        "count": self._totals[key],
+                    })
+                )
+            return out
+
+
+class MetricsRegistry:
+    def __init__(self):
+        self._metrics: Dict[str, _Metric] = {}
+        self._lock = threading.Lock()
+
+    def register(self, metric: _Metric) -> None:
+        with self._lock:
+            self._metrics[metric.name] = metric
+
+    def get(self, name: str) -> Optional[_Metric]:
+        with self._lock:
+            return self._metrics.get(name)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._metrics.clear()
+
+    def prometheus_text(self) -> str:
+        """Prometheus exposition format (the /metrics payload)."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        lines: List[str] = []
+        for m in metrics:
+            lines.append(f"# HELP {m.name} {m.description}")
+            lines.append(f"# TYPE {m.name} {m.kind}")
+            for tags, value in m.collect():
+                label = (
+                    "{" + ",".join(f'{k}="{v}"' for k, v in sorted(tags.items())) + "}"
+                    if tags
+                    else ""
+                )
+                if m.kind == "histogram":
+                    cumulative = 0
+                    for bound, count in value["buckets"]:
+                        cumulative += count
+                        lines.append(
+                            f'{m.name}_bucket{{le="{bound}"}} {cumulative}'
+                        )
+                    lines.append(f'{m.name}_bucket{{le="+Inf"}} {value["count"]}')
+                    lines.append(f"{m.name}_sum{label} {value['sum']}")
+                    lines.append(f"{m.name}_count{label} {value['count']}")
+                else:
+                    lines.append(f"{m.name}{label} {value}")
+        return "\n".join(lines) + "\n"
+
+
+_REGISTRY: Optional[MetricsRegistry] = None
+_REG_LOCK = threading.Lock()
+
+
+def _registry() -> MetricsRegistry:
+    global _REGISTRY
+    with _REG_LOCK:
+        if _REGISTRY is None:
+            _REGISTRY = MetricsRegistry()
+        return _REGISTRY
+
+
+def registry() -> MetricsRegistry:
+    return _registry()
+
+
+def register_runtime_gauges() -> None:
+    """Callback gauges over live runtime internals (scrape-time sampling)."""
+    from ..core import runtime as rt
+
+    def usage(key):
+        def sample():
+            if not rt.is_initialized():
+                return 0.0
+            return float(rt.get_runtime().object_store.usage()[key])
+
+        return sample
+
+    Gauge("raytpu_object_store_host_bytes", "host-tier bytes", fn=usage("host_bytes"))
+    Gauge("raytpu_object_store_num_objects", "objects in store", fn=usage("num_objects"))
+
+    def tasks_finished():
+        if not rt.is_initialized():
+            return 0.0
+        return float(len(rt.get_runtime().task_events()))
+
+    Gauge("raytpu_tasks_finished_total", "completed task events", fn=tasks_finished)
+
+
+def start_metrics_server(host: str = "127.0.0.1", port: int = 0) -> int:
+    """Expose /metrics (Prometheus text); returns the bound port."""
+    import socketserver
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802
+            if self.path.rstrip("/") not in ("", "/metrics".rstrip("/"), "/metrics"):
+                self.send_response(404)
+                self.end_headers()
+                return
+            body = registry().prometheus_text().encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):
+            pass
+
+    class Server(ThreadingHTTPServer):
+        daemon_threads = True
+
+        def server_bind(self):
+            # skip getfqdn (hangs without DNS egress)
+            socketserver.TCPServer.server_bind(self)
+            self.server_name = self.server_address[0]
+            self.server_port = self.server_address[1]
+
+    server = Server((host, port), Handler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True, name="metrics-http")
+    thread.start()
+    return server.server_address[1]

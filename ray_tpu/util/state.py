@@ -1,0 +1,105 @@
+"""State API: list/summarize cluster state + chrome-trace timeline.
+
+Reference parity: python/ray/util/state (`ray list tasks/actors/objects`)
+and GlobalState.chrome_tracing_dump (_private/state.py:442) feeding
+`ray timeline` — load the JSON in chrome://tracing or Perfetto.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+from ..core import runtime as _rt
+
+
+def _runtime():
+    if not _rt.is_initialized():
+        raise RuntimeError("ray_tpu is not initialized")
+    return _rt.get_runtime()
+
+
+def list_tasks(limit: int = 1000) -> List[Dict[str, Any]]:
+    """Completed task events, newest last."""
+    return list(_runtime().task_events())[-limit:]
+
+
+def list_actors(limit: int = 1000) -> List[Dict[str, Any]]:
+    return _runtime().list_actors()[:limit]
+
+
+def list_objects(limit: int = 1000) -> List[Dict[str, Any]]:
+    store = _runtime().object_store
+    out = []
+    with store._lock:
+        entries = list(store._entries.items())[:limit]
+    for oid, entry in entries:
+        out.append(
+            {
+                "object_id": oid.hex(),
+                "state": entry.state.name,
+                "tier": entry.tier.value if entry.tier else None,
+                "nbytes": entry.nbytes,
+                "pin_count": entry.pin_count,
+            }
+        )
+    return out
+
+
+def list_nodes() -> List[Dict[str, Any]]:
+    out = []
+    for node in _runtime().scheduler.nodes():
+        avail = node.resources.available()
+        total = node.resources.total  # property
+        out.append(
+            {
+                "node_id": node.node_id.hex(),
+                "alive": node.alive,
+                "is_head": node.is_head,
+                "resources_total": dict(total),
+                "resources_available": dict(avail),
+            }
+        )
+    return out
+
+
+def summary() -> Dict[str, Any]:
+    runtime = _runtime()
+    events = runtime.task_events()
+    return {
+        "nodes": len(list_nodes()),
+        "actors": len(runtime.list_actors()),
+        "tasks_finished": sum(1 for e in events if e["ok"]),
+        "tasks_failed": sum(1 for e in events if not e["ok"]),
+        "object_store": runtime.object_store.usage(),
+        "scheduler": dict(runtime.scheduler.stats),
+    }
+
+
+def chrome_tracing_dump(path: Optional[str] = None) -> str:
+    """Chrome trace-event JSON of completed tasks (one lane per node).
+
+    Returns the JSON string; writes it to `path` when given. Open in
+    chrome://tracing or https://ui.perfetto.dev.
+    """
+    events = []
+    for e in list_tasks(limit=100_000):
+        if not e.get("start_ts"):
+            continue
+        events.append(
+            {
+                "name": e["name"],
+                "cat": "task",
+                "ph": "X",
+                "ts": e["start_ts"] * 1e6,
+                "dur": max(0.0, (e["end_ts"] - e["start_ts"]) * 1e6),
+                "pid": e.get("node", "node")[:8] or "node",
+                "tid": e["task_id"][:8],
+                "args": {"ok": e["ok"], "attempt": e["attempt"]},
+            }
+        )
+    payload = json.dumps({"traceEvents": events})
+    if path:
+        with open(path, "w") as f:
+            f.write(payload)
+    return payload

@@ -1,0 +1,111 @@
+"""Metrics registry, /metrics endpoint, state API, chrome-trace timeline."""
+
+import json
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import (
+    Counter,
+    Gauge,
+    Histogram,
+    chrome_tracing_dump,
+    list_nodes,
+    list_objects,
+    list_tasks,
+    registry,
+    start_metrics_server,
+    summary,
+)
+
+
+@pytest.fixture(autouse=True)
+def rt():
+    registry().clear()
+    runtime = ray_tpu.init(num_cpus=4, detect_accelerators=False)
+    yield runtime
+    ray_tpu.shutdown()
+    registry().clear()
+
+
+def test_counter_gauge_histogram_collect():
+    c = Counter("reqs_total", "requests", tag_keys=("route",))
+    c.inc(tags={"route": "/a"})
+    c.inc(2, tags={"route": "/a"})
+    c.inc(tags={"route": "/b"})
+    collected = dict(
+        (tuple(sorted(t.items())), v) for t, v in c.collect()
+    )
+    assert collected[(("route", "/a"),)] == 3.0
+
+    g = Gauge("queue_depth", "depth")
+    g.set(7)
+    assert g.collect() == [({}, 7.0)]
+
+    h = Histogram("latency_s", "latency", boundaries=[0.1, 1.0])
+    for v in (0.05, 0.5, 5.0):
+        h.observe(v)
+    ((_, data),) = h.collect()
+    assert data["count"] == 3
+    assert data["sum"] == pytest.approx(5.55)
+    assert data["buckets"] == [(0.1, 1), (1.0, 1)]
+
+
+def test_prometheus_text_format():
+    Counter("mycount", "a counter").inc(5)
+    text = registry().prometheus_text()
+    assert "# TYPE mycount counter" in text
+    assert "mycount 5.0" in text
+
+
+def test_metrics_http_endpoint():
+    Gauge("live_gauge", "x").set(42)
+    port = start_metrics_server()
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+        body = r.read().decode()
+    assert "live_gauge 42.0" in body
+
+
+def test_callback_gauge_samples_at_scrape():
+    state = {"v": 1.0}
+    Gauge("cb_gauge", "callback", fn=lambda: state["v"])
+    assert "cb_gauge 1.0" in registry().prometheus_text()
+    state["v"] = 9.0
+    assert "cb_gauge 9.0" in registry().prometheus_text()
+
+
+def test_state_api_lists():
+    @ray_tpu.remote
+    def work(x):
+        return x * 2
+
+    ray_tpu.get([work.remote(i) for i in range(5)])
+    tasks = list_tasks()
+    assert len(tasks) >= 5
+    assert all(t["ok"] for t in tasks if t["name"] == "work")
+    nodes = list_nodes()
+    assert len(nodes) == 1 and nodes[0]["alive"]
+    assert any(o["state"] == "READY" for o in list_objects())
+    s = summary()
+    assert s["tasks_finished"] >= 5
+
+
+def test_chrome_tracing_dump(tmp_path):
+    @ray_tpu.remote
+    def traced():
+        import time
+
+        time.sleep(0.01)
+        return 1
+
+    ray_tpu.get([traced.remote() for _ in range(3)])
+    path = tmp_path / "trace.json"
+    payload = chrome_tracing_dump(str(path))
+    trace = json.loads(payload)
+    events = [e for e in trace["traceEvents"] if e["name"] == "traced"]
+    assert len(events) == 3
+    for e in events:
+        assert e["ph"] == "X"
+        assert e["dur"] >= 10_000  # ≥10ms in microseconds
+    assert path.exists()
