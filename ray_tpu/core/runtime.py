@@ -155,7 +155,10 @@ class Runtime:
         max_retries: int = 0,
         retry_exceptions: Any = False,
         scheduling_strategy: Any = "DEFAULT",
+        runtime_env: Any = None,
     ) -> Union[ObjectRef, List[ObjectRef]]:
+        from . import runtime_env as _renv
+
         task_id = TaskID.of(self.job_id)
         return_ids = [ObjectID.for_task_return(task_id, i) for i in range(num_returns)]
         spec = TaskSpec(
@@ -170,6 +173,7 @@ class Runtime:
             retry_exceptions=retry_exceptions,
             scheduling_strategy=scheduling_strategy,
             return_ids=return_ids,
+            runtime_env=_renv.normalize(runtime_env),
         )
         for oid in return_ids:
             self.object_store.create(oid, owner_task=spec)

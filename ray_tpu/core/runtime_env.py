@@ -1,0 +1,73 @@
+"""Runtime environments: per-task/actor env_vars + py_modules.
+
+Reference parity: python/ray/_private/runtime_env (plugin.py:24 plugin
+system; env_vars, py_modules, working_dir plugins materialized by the
+runtime-env agent). In-process inversion: workers are threads, not
+processes, so env application is scoped around execution —
+
+- env_vars: os.environ is process-global, so tasks/actor-calls carrying
+  env_vars serialize on one lock for the duration of their body, applied
+  then restored. Tasks without a runtime env are unaffected (no lock).
+- py_modules: local paths appended to sys.path for the call (and left in
+  place — imports are cached anyway; matches reference semantics where the
+  env outlives the task on the worker).
+
+Multi-process workers (job drivers, jobs.py) get true isolation: the
+runtime env is exported to the subprocess environment instead.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import sys
+import threading
+from typing import Any, Dict, List, Optional
+
+_env_lock = threading.RLock()
+
+
+def normalize(runtime_env: Optional[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
+    if not runtime_env:
+        return None
+    known = {"env_vars", "py_modules"}
+    unknown = set(runtime_env) - known
+    if unknown:
+        raise ValueError(
+            f"unsupported runtime_env keys {sorted(unknown)}; supported: {sorted(known)}"
+        )
+    env_vars = runtime_env.get("env_vars") or {}
+    if not all(isinstance(k, str) and isinstance(v, str) for k, v in env_vars.items()):
+        raise TypeError("env_vars must be Dict[str, str]")
+    return {
+        "env_vars": dict(env_vars),
+        "py_modules": [os.fspath(p) for p in runtime_env.get("py_modules") or []],
+    }
+
+
+@contextlib.contextmanager
+def applied(runtime_env: Optional[Dict[str, Any]]):
+    """Apply a (normalized) runtime env around an execution body."""
+    if not runtime_env:
+        yield
+        return
+    for path in runtime_env["py_modules"]:
+        if path not in sys.path:
+            sys.path.insert(0, path)
+    env_vars: Dict[str, str] = runtime_env["env_vars"]
+    if not env_vars:
+        yield
+        return
+    with _env_lock:
+        saved: Dict[str, Optional[str]] = {
+            k: os.environ.get(k) for k in env_vars
+        }
+        os.environ.update(env_vars)
+        try:
+            yield
+        finally:
+            for k, old in saved.items():
+                if old is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = old

@@ -86,6 +86,7 @@ class TaskSpec:
     scheduling_strategy: Any = "DEFAULT"
     actor: Any = None  # set for actor method tasks; bypasses node selection
     return_ids: List[ObjectID] = field(default_factory=list)
+    runtime_env: Optional[Dict[str, Any]] = None  # normalized (runtime_env.py)
     # internal
     attempt: int = 0
     cancelled: bool = False
@@ -216,6 +217,12 @@ class ClusterScheduler:
     def nodes(self) -> List[Node]:
         with self._lock:
             return list(self._nodes.values())
+
+    def pending_demand(self) -> List[ResourceDict]:
+        """Resource requests of queued-but-unschedulable tasks (the
+        autoscaler's input; reference resource_demand_scheduler.py)."""
+        with self._lock:
+            return [dict(spec.resources) for spec in self._pending]
 
     def head_node(self) -> Node:
         with self._lock:
@@ -517,12 +524,13 @@ class ClusterScheduler:
         spec.start_ts = time.time()
         spec.node_hex = node.node_id.hex()
         try:
-            from . import chaos
+            from . import chaos, runtime_env as _renv
 
             chaos.maybe_inject(spec.name)
             args = _resolve(spec.args, self._store)
             kwargs = _resolve(spec.kwargs, self._store)
-            result = spec.func(*args, **kwargs)
+            with _renv.applied(spec.runtime_env):
+                result = spec.func(*args, **kwargs)
             self._seal_returns(spec, result)
         except BaseException as exc:  # noqa: BLE001 - boundary: remote error capture
             error = exc
