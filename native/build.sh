@@ -1,7 +1,16 @@
 #!/bin/sh
 # Build the native components into ray_tpu/core/_native/.
+#
+# Build to a temp file and rename: the rename gives the .so a fresh inode,
+# so a process that already dlopen'ed a stale copy (e.g. the ABI probe in
+# native_store._load_lib) keeps its old mapping intact and a subsequent
+# dlopen of the path maps the NEW file — relinking in place would rewrite
+# pages under a live mapping (undefined behavior) and dlopen would dedup
+# to the stale handle.
 set -e
 cd "$(dirname "$0")"
 mkdir -p ../ray_tpu/core/_native
-g++ -O2 -shared -fPIC -std=c++17 -Wall -o ../ray_tpu/core/_native/libobjstore.so objstore.cc
+out=../ray_tpu/core/_native/libobjstore.so
+g++ -O2 -shared -fPIC -std=c++17 -Wall -o "$out.tmp.$$" objstore.cc
+mv -f "$out.tmp.$$" "$out"
 echo "built ray_tpu/core/_native/libobjstore.so"

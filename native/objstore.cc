@@ -130,15 +130,31 @@ int64_t store_create(void* handle, uint64_t id, uint64_t size) {
   return -1;
 }
 
+// Seal does NOT enter the object into the LRU: a freshly sealed object is
+// readable but not yet evictable, so callers can finish their own
+// bookkeeping race-free and then flip it evictable explicitly.
 int store_seal(void* handle, uint64_t id) {
   auto* a = static_cast<Arena*>(handle);
   std::lock_guard<std::mutex> lock(a->mu);
   auto it = a->objects.find(id);
   if (it == a->objects.end() || it->second.sealed) return -1;
   it->second.sealed = true;
+  return 0;
+}
+
+// Enter a sealed, unpinned object into the LRU (eviction eligibility).
+int store_make_evictable(void* handle, uint64_t id) {
+  auto* a = static_cast<Arena*>(handle);
+  std::lock_guard<std::mutex> lock(a->mu);
+  auto it = a->objects.find(id);
+  if (it == a->objects.end() || !it->second.sealed) return -1;
   lru_push(a, id, it->second);
   return 0;
 }
+
+// Bumped whenever an exported signature or behavior changes; the Python
+// binding refuses to drive a stale prebuilt .so (it rebuilds instead).
+uint64_t store_abi_version(void* /*unused*/) { return 2; }
 
 // Pins the object and returns its offset (-1 if absent/unsealed). Pinned
 // objects are never eviction candidates.
@@ -178,12 +194,16 @@ int store_delete(void* handle, uint64_t id) {
   return 0;
 }
 
-// Oldest sealed+unpinned object, or -1 — the eviction/spill candidate.
-int64_t store_lru_candidate(void* handle) {
+// Oldest sealed+unpinned object — the eviction/spill candidate. Writes the
+// id to id_out and returns 0, or -1 if none. (Out-param, not a returned
+// int64: ids are full-range uint64 hashes, so the top bit is routinely set
+// and an in-band -1 sentinel would misread half of all ids as "none".)
+int store_lru_candidate(void* handle, uint64_t* id_out) {
   auto* a = static_cast<Arena*>(handle);
   std::lock_guard<std::mutex> lock(a->mu);
   if (a->lru.empty()) return -1;
-  return static_cast<int64_t>(a->lru.front());
+  *id_out = a->lru.front();
+  return 0;
 }
 
 uint64_t store_used(void* handle) {

@@ -99,6 +99,8 @@ _DEFAULT_TASK_OPTIONS: Dict[str, Any] = dict(
     retry_exceptions=False,
     scheduling_strategy="DEFAULT",
     name=None,
+    runtime_env=None,
+    executor="thread",  # "process" → pooled OS worker (GIL-free CPU work)
 )
 
 _DEFAULT_ACTOR_OPTIONS: Dict[str, Any] = dict(
@@ -111,6 +113,7 @@ _DEFAULT_ACTOR_OPTIONS: Dict[str, Any] = dict(
     namespace="default",
     lifetime=None,
     scheduling_strategy="DEFAULT",
+    executor="thread",  # "process" → dedicated OS worker process
 )
 
 
@@ -153,6 +156,8 @@ class RemoteFunction:
             max_retries=opts["max_retries"],
             retry_exceptions=opts["retry_exceptions"],
             scheduling_strategy=opts["scheduling_strategy"],
+            runtime_env=opts.get("runtime_env"),
+            executor=opts.get("executor", "thread"),
         )
 
     def __call__(self, *args, **kwargs):
@@ -190,6 +195,7 @@ class ActorClass:
             namespace=opts.get("namespace", "default"),
             scheduling_strategy=opts["scheduling_strategy"],
             lifetime=opts.get("lifetime"),
+            executor=opts.get("executor", "thread"),
         )
 
     def __call__(self, *args, **kwargs):

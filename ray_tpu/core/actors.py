@@ -77,6 +77,7 @@ class ActorRuntime:
         on_death=None,
         registered_name: Optional[str] = None,
         registered_namespace: str = "default",
+        executor: str = "thread",
     ):
         self.actor_id = actor_id
         self.cls = cls
@@ -93,6 +94,13 @@ class ActorRuntime:
         self.registered_name = registered_name
         self.registered_namespace = registered_namespace
         self._on_death = on_death
+        # "process": the instance lives in a dedicated OS worker process;
+        # method calls are proxied over its pipe (state survives in the
+        # child; a crash is a restartable actor death). One pipe ⇒ calls
+        # serialize even with max_concurrency > 1.
+        self.executor = executor
+        self._worker = None  # WorkerProcess when executor == "process"
+        self._incarnation = 0  # bumped on every (re)start; see _RestartSignal
 
         self._scheduler = scheduler
         self._store = object_store
@@ -100,6 +108,7 @@ class ActorRuntime:
         self._node: Optional[Node] = None
         self._pool: Optional[ResourceSet] = None
         self._instance: Any = None
+        self._worker_lock = threading.Lock()  # serializes the worker pipe
         self._lock = threading.Lock()
         self._alive_event = threading.Event()
         self._thread = threading.Thread(
@@ -175,13 +184,26 @@ class ActorRuntime:
 
     def _lifecycle(self) -> None:
         while True:
+            self._incarnation += 1
             if not self._acquire_placement():
                 self._die(self.death_cause or "unschedulable")
                 return
             try:
-                self._instance = self.cls(*self.init_args, **self.init_kwargs)
+                if self.executor == "process":
+                    from .worker_pool import WorkerProcess
+
+                    self._worker = WorkerProcess()
+                    self._worker.request(
+                        "actor_create",
+                        (self.cls, self.init_args, self.init_kwargs),
+                    )
+                else:
+                    self._instance = self.cls(*self.init_args, **self.init_kwargs)
             except BaseException as exc:  # noqa: BLE001
                 tb = traceback.format_exc()
+                if self._worker is not None:
+                    self._worker.kill()
+                    self._worker = None
                 self._die(f"__init__ raised: {exc}\n{tb}")
                 return
             with self._lock:
@@ -216,7 +238,10 @@ class ActorRuntime:
                 if msg is _POISON:
                     return False
                 if isinstance(msg, _RestartSignal):
-                    self._fail_inflight_after_restart(msg)
+                    if msg.incarnation >= 0 and msg.incarnation != self._incarnation:
+                        continue  # stale: refers to an already-replaced worker
+                    if self._fail_inflight_after_restart(msg):
+                        return False  # a queued terminate outranks restart
                     return True
                 if executor is not None:
                     executor.submit(self._execute, msg)
@@ -228,13 +253,16 @@ class ActorRuntime:
 
     def _execute(self, call: ActorMethodCall) -> None:
         try:
-            if call.method_name == "__ray_ready__":
+            if call.method_name == "__ray_ready__" and self._worker is None:
                 result = True
+            elif call.method_name == "__ray_pid__" and self._worker is None:
+                import os
+
+                result = os.getpid()
             elif call.method_name == "__ray_terminate__":
                 self._mailbox.put(_POISON)
                 result = None
             else:
-                method = getattr(self._instance, call.method_name)
                 args = tuple(
                     a.resolve() if getattr(a, "__ray_tpu_lazy__", False) else a
                     for a in call.args
@@ -243,7 +271,33 @@ class ActorRuntime:
                     k: (v.resolve() if getattr(v, "__ray_tpu_lazy__", False) else v)
                     for k, v in call.kwargs.items()
                 }
-                result = method(*args, **kwargs)
+                if self._worker is not None:
+                    from .worker_pool import WorkerCrashedError
+
+                    inc = self._incarnation
+                    try:
+                        with self._worker_lock:
+                            result = self._worker.request(
+                                "actor_call", (call.method_name, args, kwargs)
+                            )
+                    except WorkerCrashedError as crash:
+                        # Hard process death: fail this call as an actor
+                        # death and trigger the restart path (reference:
+                        # raylet detects worker death via the socket,
+                        # node_manager.cc; GCS FSM restarts). If the death
+                        # was an explicit kill (state already DEAD), do NOT
+                        # enqueue a restart — no_restart must stay final.
+                        err = ActorDiedError(self.actor_id, str(crash))
+                        for oid in call.return_ids:
+                            self._store.seal_error(oid, err)
+                        with self._lock:
+                            dead = self.state == ActorState.DEAD
+                        if not dead:
+                            self._mailbox.put(_RestartSignal(str(crash), inc))
+                        return
+                else:
+                    method = getattr(self._instance, call.method_name)
+                    result = method(*args, **kwargs)
             if call.num_returns == 1:
                 self._store.seal(call.return_ids[0], result)
             else:
@@ -261,19 +315,24 @@ class ActorRuntime:
             for oid in call.return_ids:
                 self._store.seal_error(oid, err)
 
-    def _fail_inflight_after_restart(self, signal: "_RestartSignal") -> None:
+    def _fail_inflight_after_restart(self, signal: "_RestartSignal") -> bool:
         # Drain whatever was queued before the failure; those calls fail
         # (the reference likewise fails in-flight actor tasks on restart
-        # unless max_task_retries covers them).
+        # unless max_task_retries covers them). Returns True if a queued
+        # terminate (_POISON) was drained — it must not be swallowed.
+        poisoned = False
         try:
             while True:
                 msg = self._mailbox.get_nowait()
-                if isinstance(msg, ActorMethodCall):
+                if msg is _POISON:
+                    poisoned = True
+                elif isinstance(msg, ActorMethodCall):
                     err = ActorDiedError(self.actor_id, signal.reason)
                     for oid in msg.return_ids:
                         self._store.seal_error(oid, err)
         except queue.Empty:
             pass
+        return poisoned
 
     def _release(self) -> None:
         if self._pool is not None:
@@ -281,11 +340,28 @@ class ActorRuntime:
         self._node = None
         self._pool = None
         self._instance = None
+        with self._lock:
+            worker, self._worker = self._worker, None
+        if worker is not None:
+            worker.shutdown()
+
+    def pid(self) -> Optional[int]:
+        """OS pid executing this actor (the worker's for process actors)."""
+        import os
+
+        if self._worker is not None:
+            return self._worker.pid
+        return os.getpid() if self.state == ActorState.ALIVE else None
 
     def _die(self, reason: str) -> None:
         with self._lock:
             self.state = ActorState.DEAD
             self.death_cause = reason
+            worker = self._worker  # read under lock: _release may null it
+        if worker is not None:
+            # Hard-kill the worker process now: an in-flight call observes
+            # the crash and fails immediately instead of waiting out poison.
+            worker.kill()
         self._alive_event.set()  # unblock waiters; they will observe DEAD
         if self._on_death is not None:
             try:
@@ -338,3 +414,8 @@ class ActorRuntime:
 @dataclass
 class _RestartSignal:
     reason: str = "injected failure"
+    # Incarnation that observed the failure. A signal from a previous
+    # incarnation is stale (that worker is already gone) and must not kill
+    # the restarted instance: with max_concurrency > 1, several in-flight
+    # calls can all observe one crash and each enqueue a signal.
+    incarnation: int = -1

@@ -95,14 +95,25 @@ def maybe_inject(task_name: str) -> None:
         return
     if config.name_filter and config.name_filter not in task_name:
         return
+    # Decide + count under the lock; sleep OUTSIDE it so injected delays
+    # stay concurrent across scheduler threads (a serialized delay would
+    # distort exactly the schedules chaos is meant to perturb). Delays
+    # count against max_injections too, so they are bounded.
+    delay = 0.0
+    fail_ordinal = 0
     with _state.lock:
         if 0 <= config.max_injections <= _state.injected:
             return
         if config.delay_s > 0:
-            time.sleep(config.delay_s)
-        if config.failure_prob > 0 and _state.rng.random() < config.failure_prob:
+            delay = config.delay_s
             _state.injected += 1
-            raise ChaosInjectedError(
-                f"chaos: injected failure in task {task_name!r} "
-                f"(#{_state.injected})"
-            )
+        if config.failure_prob > 0 and _state.rng.random() < config.failure_prob:
+            if delay == 0.0:
+                _state.injected += 1
+            fail_ordinal = _state.injected
+    if delay > 0:
+        time.sleep(delay)
+    if fail_ordinal:
+        raise ChaosInjectedError(
+            f"chaos: injected failure in task {task_name!r} (#{fail_ordinal})"
+        )

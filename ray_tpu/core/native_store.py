@@ -25,20 +25,48 @@ _BUILD_SCRIPT = os.path.join(
 _lib = None
 _lib_lock = threading.Lock()
 
+# Must match store_abi_version() in native/objstore.cc. A stale prebuilt
+# .so (artifacts are not in VCS) would otherwise be driven with the wrong
+# signatures — silently, via ctypes.
+_ABI_VERSION = 2
+
+
+def _try_build() -> bool:
+    if not os.path.exists(_BUILD_SCRIPT):
+        return False
+    try:
+        subprocess.run(
+            ["sh", _BUILD_SCRIPT], capture_output=True, check=True, timeout=120
+        )
+        return True
+    except Exception:
+        return False
+
+
+def _abi_matches(path: str) -> bool:
+    try:
+        probe = ctypes.CDLL(path)
+        fn = getattr(probe, "store_abi_version", None)
+        if fn is None:
+            return False
+        fn.restype = ctypes.c_uint64
+        fn.argtypes = [ctypes.c_void_p]
+        return fn(None) == _ABI_VERSION
+    except OSError:
+        return False
+
 
 def _load_lib() -> Optional[ctypes.CDLL]:
     global _lib
     with _lib_lock:
         if _lib is not None:
             return _lib
-        if not os.path.exists(_LIB_PATH) and os.path.exists(_BUILD_SCRIPT):
-            try:
-                subprocess.run(
-                    ["sh", _BUILD_SCRIPT], capture_output=True, check=True, timeout=120
-                )
-            except Exception:
+        if not os.path.exists(_LIB_PATH) or not _abi_matches(_LIB_PATH):
+            # missing or stale: rebuild (writes a fresh inode, so the CDLL
+            # below maps the new code even if a stale handle exists)
+            if not _try_build():
                 return None
-        if not os.path.exists(_LIB_PATH):
+        if not os.path.exists(_LIB_PATH) or not _abi_matches(_LIB_PATH):
             return None
         lib = ctypes.CDLL(_LIB_PATH)
         lib.store_create_arena.restype = ctypes.c_void_p
@@ -58,8 +86,13 @@ def _load_lib() -> Optional[ctypes.CDLL]:
         lib.store_unpin.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
         lib.store_delete.restype = ctypes.c_int
         lib.store_delete.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
-        lib.store_lru_candidate.restype = ctypes.c_int64
-        lib.store_lru_candidate.argtypes = [ctypes.c_void_p]
+        lib.store_make_evictable.restype = ctypes.c_int
+        lib.store_make_evictable.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.store_lru_candidate.restype = ctypes.c_int
+        lib.store_lru_candidate.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_uint64),
+        ]
         for name in ("store_used", "store_capacity", "store_num_objects",
                      "store_num_free_blocks"):
             fn = getattr(lib, name)
@@ -92,9 +125,14 @@ class NativeArena:
         self._base = lib.store_base(self._arena)
         self._closed = False
 
-    def put(self, object_id: int, payload: bytes | memoryview) -> bool:
+    def put(self, object_id: int, payload: bytes | memoryview,
+            evictable: bool = True) -> bool:
         """Copy payload into the arena and seal. False if it cannot fit even
-        after the caller's spill loop should run (use lru_candidate)."""
+        after the caller's spill loop should run (use lru_candidate).
+
+        evictable=False leaves the object out of the LRU (readable but
+        never an eviction victim) until make_evictable() — lets a caller
+        finish its own bookkeeping before eviction can race with it."""
         view = memoryview(payload)
         size = view.nbytes
         offset = self._lib.store_create(self._arena, object_id, size)
@@ -102,7 +140,12 @@ class NativeArena:
             return False
         ctypes.memmove(self._base + offset, (ctypes.c_char * size).from_buffer_copy(view), size)
         self._lib.store_seal(self._arena, object_id)
+        if evictable:
+            self._lib.store_make_evictable(self._arena, object_id)
         return True
+
+    def make_evictable(self, object_id: int) -> None:
+        self._lib.store_make_evictable(self._arena, object_id)
 
     def get(self, object_id: int) -> Optional[memoryview]:
         """Zero-copy view, pinned until `unpin(object_id)`."""
@@ -120,14 +163,23 @@ class NativeArena:
         return self._lib.store_delete(self._arena, object_id) == 0
 
     def lru_candidate(self) -> Optional[int]:
-        cand = self._lib.store_lru_candidate(self._arena)
-        return None if cand < 0 else int(cand)
+        out = ctypes.c_uint64()
+        rc = self._lib.store_lru_candidate(self._arena, ctypes.byref(out))
+        return None if rc != 0 else int(out.value)
 
-    def put_with_eviction(self, object_id: int, payload, on_evict=None) -> bool:
-        """put(), evicting LRU objects until it fits. on_evict(id, view) runs
-        before each eviction (the spill hook)."""
+    def put_with_eviction(
+        self, object_id: int, payload, on_evict=None, on_evicted=None,
+        evictable: bool = True,
+    ) -> bool:
+        """put(), evicting LRU objects until it fits.
+
+        on_evict(id, view) runs before each deletion (the spill-prepare
+        hook); on_evicted(id) runs only after the arena block is actually
+        freed (the commit hook) — if delete fails (e.g. a concurrent get
+        pinned the victim), the caller's bookkeeping is left untouched.
+        """
         while True:
-            if self.put(object_id, payload):
+            if self.put(object_id, payload, evictable=evictable):
                 return True
             victim = self.lru_candidate()
             if victim is None:
@@ -140,6 +192,8 @@ class NativeArena:
                     self.unpin(victim)
             if not self.delete(victim):
                 return False
+            if on_evicted is not None:
+                on_evicted(victim)
 
     @property
     def used(self) -> int:

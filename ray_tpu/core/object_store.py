@@ -156,7 +156,12 @@ class ObjectStore:
 
     def _try_shm_seal(self, object_id: ObjectID, value: Any, nbytes: int):
         """Place a large numpy array into the native arena; returns the
-        SHM metadata value, or None to fall through to the host tier."""
+        SHM metadata value, or None to fall through to the host tier.
+
+        Runs OUTSIDE the store lock: put_with_eviction may spill victims
+        to disk (pickle I/O in _on_arena_evict), and the arena has its own
+        internal mutex. Only the _shm_entries map is touched under the
+        store lock."""
         import numpy as np
 
         if (
@@ -166,22 +171,53 @@ class ObjectStore:
             or nbytes < _SHM_MIN_BYTES
         ):
             return None
-        aid = int(object_id.hex()[:16], 16)
-        contiguous = np.ascontiguousarray(value)
-        ok = self._arena.put_with_eviction(
-            aid, contiguous.reshape(-1).view(np.uint8).data, on_evict=self._on_arena_evict
+        # Arena ids are 64-bit. Hash the FULL object id: the bit-layout puts
+        # the return-index in the trailing bytes, so a prefix truncation
+        # collides for every return of the same task.
+        import hashlib
+
+        aid = int.from_bytes(
+            hashlib.blake2b(object_id.hex().encode(), digest_size=8).digest(), "big"
         )
+        with self._lock:
+            # Hash collision with a live object: fall through to the host
+            # tier instead of letting store_create's duplicate-id failure
+            # masquerade as out-of-space and trigger an eviction storm.
+            if aid in self._shm_entries:
+                return None
+            # Register the aid→oid mapping BEFORE placement so a concurrent
+            # seal's eviction hooks can always resolve this block.
+            self._shm_entries[aid] = object_id
+        contiguous = np.ascontiguousarray(value)
+        # evictable=False: the block is readable but NOT an LRU candidate
+        # until seal() commits the entry under the store lock and calls
+        # make_evictable — a concurrent seal's eviction can never observe
+        # a half-sealed object (block present, entry meta not yet written).
+        ok = False
+        try:
+            ok = self._arena.put_with_eviction(
+                aid,
+                contiguous.reshape(-1).view(np.uint8).data,
+                on_evict=self._on_arena_evict,
+                on_evicted=self._on_arena_evicted,
+                evictable=False,
+            )
+        finally:
+            if not ok:  # failure OR a raising spill hook: unregister the aid
+                with self._lock:
+                    self._shm_entries.pop(aid, None)
         if not ok:
             return None
-        self._shm_entries[aid] = object_id
         self.stats["shm_puts"] += 1
         return ("__shm__", aid, str(value.dtype), value.shape)
 
     def seal(self, object_id: ObjectID, value: Any) -> None:
+        nbytes = _estimate_nbytes(value)
+        # Arena placement (and any victim spilling it triggers) happens
+        # before taking the store lock — disk I/O must never run under it.
+        shm_meta = self._try_shm_seal(object_id, value, nbytes)
         with self._lock:
             entry = self._entries[object_id]
-            nbytes = _estimate_nbytes(value)
-            shm_meta = self._try_shm_seal(object_id, value, nbytes)
             if shm_meta is not None:
                 tier = Tier.SHM
                 value = shm_meta
@@ -201,6 +237,9 @@ class ObjectStore:
             entry.last_access = time.monotonic()
             callbacks = list(entry.callbacks)
             entry.callbacks.clear()
+        if shm_meta is not None:
+            # entry committed: the arena block may now become an LRU victim
+            self._arena.make_evictable(shm_meta[1])
         self.stats["puts"] += 1
         entry.event.set()
         for cb in callbacks:
@@ -371,29 +410,42 @@ class ObjectStore:
             self._arena.unpin(aid)
 
     def _on_arena_evict(self, aid: int, view) -> None:
-        """Native LRU chose a victim: spill it to disk first if we can."""
+        """Spill-PREPARE: native LRU chose a victim — write its bytes to
+        disk (if we have a spill dir) but leave all bookkeeping intact.
+        The state change commits in _on_arena_evicted only after the arena
+        block is actually freed, so a failed delete (victim pinned by a
+        concurrent get) leaves the object fully usable in the arena."""
         import numpy as np
 
-        object_id = self._shm_entries.pop(aid, None)
-        if object_id is None:
+        with self._lock:
+            object_id = self._shm_entries.get(aid)
+            entry = self._entries.get(object_id) if object_id is not None else None
+        if entry is None or self._spill_dir is None:
             return
-        entry = self._entries.get(object_id)
-        if entry is None:
-            return
-        _, _, dtype_str, shape = entry.value
-        if self._spill_dir is not None:
+        with entry.lock:
+            _, _, dtype_str, shape = entry.value
             os.makedirs(self._spill_dir, exist_ok=True)
             path = os.path.join(self._spill_dir, entry.object_id.hex())
             arr = np.frombuffer(view, dtype=np.dtype(dtype_str)).reshape(shape)
             with open(path, "wb") as f:
                 pickle.dump(arr.copy(), f, protocol=pickle.HIGHEST_PROTOCOL)
             entry.spill_path = path
-            entry.tier = Tier.SPILLED
-            self.stats["spills"] += 1
-        else:
-            entry.value = None
-            entry.state = ObjectState.LOST
-            self.stats["evictions"] += 1
+
+    def _on_arena_evicted(self, aid: int) -> None:
+        """Spill-COMMIT: the arena block is gone; flip the entry's tier."""
+        with self._lock:
+            object_id = self._shm_entries.pop(aid, None)
+            entry = self._entries.get(object_id) if object_id is not None else None
+        if entry is None:
+            return
+        with entry.lock:
+            if entry.spill_path is not None:
+                entry.tier = Tier.SPILLED
+                self.stats["spills"] += 1
+            else:
+                entry.value = None
+                entry.state = ObjectState.LOST
+                self.stats["evictions"] += 1
         self.stats["shm_evictions"] += 1
 
     def _spill(self, entry: ObjectEntry) -> None:

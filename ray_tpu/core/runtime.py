@@ -156,6 +156,7 @@ class Runtime:
         retry_exceptions: Any = False,
         scheduling_strategy: Any = "DEFAULT",
         runtime_env: Any = None,
+        executor: str = "thread",
     ) -> Union[ObjectRef, List[ObjectRef]]:
         from . import runtime_env as _renv
 
@@ -174,6 +175,7 @@ class Runtime:
             scheduling_strategy=scheduling_strategy,
             return_ids=return_ids,
             runtime_env=_renv.normalize(runtime_env),
+            executor=executor,
         )
         for oid in return_ids:
             self.object_store.create(oid, owner_task=spec)
@@ -214,6 +216,7 @@ class Runtime:
         namespace: str = "default",
         scheduling_strategy: Any = "DEFAULT",
         lifetime: Optional[str] = None,
+        executor: str = "thread",
     ) -> "ActorHandle":
         actor_id = ActorID.of(self.job_id)
         handle = ActorHandle(actor_id, self)
@@ -243,6 +246,7 @@ class Runtime:
                 on_death=_on_death,
                 registered_name=name,
                 registered_namespace=namespace,
+                executor=executor,
             )
         except BaseException:
             if name:
@@ -340,6 +344,9 @@ class Runtime:
         for rt in actors:
             rt.kill(no_restart=True, reason="runtime shutdown")
         self.scheduler.shutdown()
+        from .worker_pool import shutdown_worker_pool
+
+        shutdown_worker_pool()
 
 
 class _LazyRef:
@@ -372,6 +379,11 @@ class ActorHandle:
     @property
     def __ray_ready__(self) -> "ActorMethod":
         return ActorMethod(self, "__ray_ready__")
+
+    @property
+    def __ray_pid__(self) -> "ActorMethod":
+        """OS pid of the process executing this actor's methods."""
+        return ActorMethod(self, "__ray_pid__")
 
     def state(self) -> ActorState:
         return self._runtime.actor_runtime(self._actor_id).state

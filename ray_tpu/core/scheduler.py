@@ -30,6 +30,7 @@ from __future__ import annotations
 import enum
 import itertools
 import logging
+import os
 import threading
 import time
 import traceback
@@ -87,6 +88,9 @@ class TaskSpec:
     actor: Any = None  # set for actor method tasks; bypasses node selection
     return_ids: List[ObjectID] = field(default_factory=list)
     runtime_env: Optional[Dict[str, Any]] = None  # normalized (runtime_env.py)
+    # "thread" (default: in-process, zero-copy object passing) or "process"
+    # (pooled OS worker process — GIL-free CPU work; see worker_pool.py)
+    executor: str = "thread"
     # internal
     attempt: int = 0
     cancelled: bool = False
@@ -529,12 +533,31 @@ class ClusterScheduler:
             chaos.maybe_inject(spec.name)
             args = _resolve(spec.args, self._store)
             kwargs = _resolve(spec.kwargs, self._store)
-            with _renv.applied(spec.runtime_env):
-                result = spec.func(*args, **kwargs)
+            if spec.executor == "process":
+                # Run in a pooled worker process (GIL-free). env_vars are
+                # set in the child's environment — true isolation, no
+                # process-global lock; py_modules extend the child's path
+                # via PYTHONPATH.
+                from .worker_pool import get_worker_pool
+
+                env_vars = dict((spec.runtime_env or {}).get("env_vars") or {})
+                py_modules = (spec.runtime_env or {}).get("py_modules") or []
+                if py_modules:
+                    existing = env_vars.get("PYTHONPATH", os.environ.get("PYTHONPATH", ""))
+                    env_vars["PYTHONPATH"] = os.pathsep.join(
+                        list(py_modules) + ([existing] if existing else [])
+                    )
+                result = get_worker_pool().execute(
+                    spec.func, args, kwargs, env_vars=env_vars
+                )
+            else:
+                with _renv.applied(spec.runtime_env):
+                    result = spec.func(*args, **kwargs)
             self._seal_returns(spec, result)
         except BaseException as exc:  # noqa: BLE001 - boundary: remote error capture
             error = exc
-            error_tb = traceback.format_exc()
+            # process-executor errors carry the worker-side traceback
+            error_tb = getattr(exc, "remote_traceback", None) or traceback.format_exc()
         finally:
             pool.release(spec.resources)
             with node._lock:

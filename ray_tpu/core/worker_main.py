@@ -1,0 +1,27 @@
+"""Worker process entry point: `python -m ray_tpu.core.worker_main <fd>`.
+
+The pool launches workers as a dedicated program (reference: raylet starts
+default_worker.py, worker_pool.h:228) instead of multiprocessing-spawning
+the driver's __main__ — so a worker never re-imports or re-executes the
+user's script (which also breaks outright for stdin/REPL drivers).
+
+The single argv argument is an inherited socketpair fd; frames on it are
+the worker protocol defined in worker_pool._worker_main.
+"""
+
+from __future__ import annotations
+
+import sys
+from multiprocessing.connection import Connection
+
+
+def main() -> None:
+    fd = int(sys.argv[1])
+    conn = Connection(fd)
+    from ray_tpu.core.worker_pool import _worker_main
+
+    _worker_main(conn, {})
+
+
+if __name__ == "__main__":
+    main()

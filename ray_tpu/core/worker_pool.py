@@ -1,0 +1,356 @@
+"""Process-backed worker pool: real OS-process execution for tasks/actors.
+
+Reference parity: the raylet's WorkerPool (/root/reference/src/ray/raylet/
+worker_pool.h:228 — prestarted language workers, reuse across tasks,
+runtime-env-keyed pools) and the worker-lease reuse in the task submitter
+(core_worker/transport/normal_task_submitter.cc:108).
+
+Design inversion for TPU: in the reference EVERY worker is a process and
+the pool is the only execution path. Here threads remain the default (the
+hot loop is a compiled XLA program; passing device arrays by reference
+between threads is free), and the process pool is the opt-in path for
+CPU-bound Python work — Data map functions, tokenization, image decode —
+where the GIL would serialize thread workers. Tasks opt in with
+`@ray_tpu.remote(executor="process")` or `.options(executor="process")`.
+
+Protocol: one spawned child per worker (spawn, not fork: fork after JAX /
+thread init is unsafe), cloudpickle frames over a multiprocessing Pipe.
+Workers are reused across tasks (keyed by runtime-env env_vars, like the
+reference's runtime-env-keyed pools) and idle-reaped. Process-executor
+tasks must be self-contained: ObjectRef args are resolved in the parent
+and shipped by value; the child does not join the cluster.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import traceback
+from typing import Any, Dict, List, Optional, Tuple
+
+import cloudpickle
+
+from .exceptions import TaskError
+
+_IDLE_REAP_S = 60.0
+
+
+class WorkerCrashedError(TaskError):
+    """The worker process died mid-task (killed, OOM, segfault)."""
+
+    def __init__(self, message: str):
+        # TaskError(name, cause) signature; we are our own cause.
+        Exception.__init__(self, message)
+        self.task_name = "<process-worker>"
+        self.cause = None
+
+
+def _worker_main(conn, env_vars: Dict[str, str]) -> None:
+    """Child process loop: recv request frames, execute, reply.
+
+    Runs user functions only — no runtime/cluster state in the child
+    (reference default_worker.py ends in RunTaskExecutionLoop;
+    core_worker.h:216)."""
+    os.environ.update(env_vars or {})
+    actor = None  # set by actor_create; then actor_call dispatches onto it
+    while True:
+        try:
+            msg = conn.recv()
+        except (EOFError, OSError):
+            return
+        kind = msg[0]
+        if kind == "shutdown":
+            conn.close()
+            return
+        if kind == "ping":
+            conn.send(("ok", cloudpickle.dumps(os.getpid())))
+            continue
+        try:
+            if kind == "task":
+                func, args, kwargs = cloudpickle.loads(msg[1])
+                result = func(*args, **kwargs)
+            elif kind == "actor_create":
+                cls, args, kwargs = cloudpickle.loads(msg[1])
+                actor = cls(*args, **kwargs)
+                result = os.getpid()
+            elif kind == "actor_call":
+                method_name, args, kwargs = cloudpickle.loads(msg[1])
+                if method_name == "__ray_ready__":
+                    result = True
+                elif method_name == "__ray_pid__":
+                    result = os.getpid()
+                else:
+                    result = getattr(actor, method_name)(*args, **kwargs)
+            else:
+                raise ValueError(f"unknown message kind {kind!r}")
+            conn.send(("ok", cloudpickle.dumps(result)))
+        except BaseException as exc:  # noqa: BLE001 - remote error boundary
+            tb = traceback.format_exc()
+            try:
+                payload = cloudpickle.dumps(exc)
+            except Exception:
+                payload = cloudpickle.dumps(RuntimeError(repr(exc)))
+            conn.send(("err", payload, tb))
+
+
+class WorkerProcess:
+    """One spawned worker and its pipe. Not thread-safe; the pool hands a
+    worker to exactly one task at a time.
+
+    Launched as `python -m ray_tpu.core.worker_main <fd>` over an inherited
+    socketpair — a dedicated entry program, NOT a multiprocessing spawn of
+    the driver's __main__ (spawn re-imports the driver script in the child:
+    it breaks for stdin/REPL drivers and re-executes unguarded user code).
+    """
+
+    def __init__(self, env_vars: Optional[Dict[str, str]] = None):
+        import socket
+        import subprocess
+        import sys
+        from multiprocessing.connection import Connection
+
+        parent_sock, child_sock = socket.socketpair()
+        self.env_key = _env_key(env_vars)
+        env = dict(os.environ)
+        env.update(env_vars or {})
+        # The child must resolve by-reference pickles (module-level
+        # functions/classes) against the same import universe.
+        paths = [p for p in sys.path if p] + (
+            [env["PYTHONPATH"]] if env.get("PYTHONPATH") else []
+        )
+        env["PYTHONPATH"] = os.pathsep.join(paths)
+        child_fd = child_sock.fileno()
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.core.worker_main", str(child_fd)],
+            pass_fds=[child_fd],
+            env=env,
+            close_fds=True,
+        )
+        child_sock.close()
+        self._conn = Connection(parent_sock.detach())
+        self.last_used = time.monotonic()
+
+    @property
+    def pid(self) -> int:
+        return self.proc.pid
+
+    def alive(self) -> bool:
+        return self.proc.poll() is None
+
+    def request(self, kind: str, payload: Any = None, timeout: Optional[float] = None):
+        """Send one request frame and block for the reply.
+
+        Raises the ORIGINAL remote exception (remote traceback attached as
+        .remote_traceback) so retry_exceptions matching and isinstance
+        checks behave identically to thread execution; raises
+        WorkerCrashedError only for hard process death."""
+        try:
+            if payload is None:
+                self._conn.send((kind,))
+            else:
+                self._conn.send((kind, cloudpickle.dumps(payload)))
+        except (OSError, ValueError) as e:
+            # send-side pipe failure = the worker is gone
+            raise WorkerCrashedError(
+                f"worker {self.pid} pipe broke on send: {e!r}"
+            )
+        if kind == "shutdown":
+            return None
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            wait = 0.2 if deadline is None else min(0.2, deadline - time.monotonic())
+            if wait <= 0:
+                raise TimeoutError(f"worker {self.pid} request timed out")
+            if self._conn.poll(wait):
+                break
+            if not self.alive():
+                raise WorkerCrashedError(
+                    f"worker process {self.pid} died (exitcode "
+                    f"{self.proc.returncode}) during {kind}"
+                )
+        try:
+            reply = self._conn.recv()
+        except (EOFError, OSError) as e:
+            raise WorkerCrashedError(f"worker {self.pid} pipe broke: {e!r}")
+        self.last_used = time.monotonic()
+        if reply[0] == "ok":
+            return cloudpickle.loads(reply[1])
+        exc = cloudpickle.loads(reply[1])
+        if not isinstance(exc, BaseException):
+            exc = RuntimeError(repr(exc))
+        exc.remote_traceback = reply[2]
+        raise exc
+
+    def kill(self) -> None:
+        import subprocess
+
+        try:
+            self._conn.close()
+        except Exception:
+            pass
+        if self.alive():
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=2.0)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                try:
+                    self.proc.wait(timeout=1.0)
+                except subprocess.TimeoutExpired:
+                    pass
+
+    def shutdown(self) -> None:
+        import subprocess
+
+        try:
+            self.request("shutdown")
+        except Exception:
+            pass
+        try:
+            self.proc.wait(timeout=2.0)
+        except subprocess.TimeoutExpired:
+            self.kill()
+
+
+def _env_key(env_vars: Optional[Dict[str, str]]) -> Tuple[Tuple[str, str], ...]:
+    return tuple(sorted((env_vars or {}).items()))
+
+
+class ProcessWorkerPool:
+    """Reusable pool of worker processes, keyed by runtime-env env_vars.
+
+    acquire() prefers an idle worker with a matching env (lease reuse,
+    normal_task_submitter.cc:108); spawns when none idle and the pool is
+    under max_workers; blocks otherwise. Idle workers past the reap
+    timeout are shut down by the next acquire/release."""
+
+    def __init__(self, max_workers: Optional[int] = None):
+        self.max_workers = max_workers or max(2, (os.cpu_count() or 4))
+        self._idle: List[WorkerProcess] = []
+        self._busy: List[WorkerProcess] = []
+        self._spawning = 0  # slots reserved for in-flight spawns
+        self._closed = False
+        self._lock = threading.Lock()
+        self._free = threading.Condition(self._lock)
+        self.stats = {"spawned": 0, "reused": 0, "reaped": 0, "crashed": 0}
+
+    @staticmethod
+    def _kill_async(worker: WorkerProcess) -> None:
+        """terminate+join off-thread: kill() joins up to 2s and must never
+        run under the pool lock (it would stall every acquire/release)."""
+        threading.Thread(target=worker.kill, daemon=True,
+                         name="ray_tpu-worker-reaper").start()
+
+    def acquire(self, env_vars: Optional[Dict[str, str]] = None,
+                timeout: Optional[float] = None) -> WorkerProcess:
+        key = _env_key(env_vars)
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._free:
+            while True:
+                if self._closed:
+                    raise RuntimeError("worker pool is shut down")
+                self._reap_locked()
+                for i, w in enumerate(self._idle):
+                    if w.env_key == key and w.alive():
+                        self._idle.pop(i)
+                        self._busy.append(w)
+                        self.stats["reused"] += 1
+                        return w
+                if (len(self._idle) + len(self._busy) + self._spawning
+                        < self.max_workers):
+                    # reserve the slot, then spawn outside the lock
+                    self._spawning += 1
+                    break
+                # full: evict an idle worker with a different env if any
+                if self._idle:
+                    self._kill_async(self._idle.pop(0))
+                    continue
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    raise TimeoutError("no process worker available")
+                self._free.wait(timeout=0.2 if remaining is None else min(0.2, remaining))
+        try:
+            worker = WorkerProcess(dict(env_vars or {}))
+        except BaseException:
+            with self._free:
+                self._spawning -= 1
+                self._free.notify_all()
+            raise
+        with self._free:
+            self._spawning -= 1
+            self._busy.append(worker)
+            self.stats["spawned"] += 1
+        return worker
+
+    def release(self, worker: WorkerProcess, crashed: bool = False) -> None:
+        with self._free:
+            if worker in self._busy:
+                self._busy.remove(worker)
+            if crashed or self._closed or not worker.alive():
+                # a release after shutdown() kills the worker instead of
+                # idling it into a pool nothing will ever reap
+                self.stats["crashed"] += crashed
+                self._kill_async(worker)
+            else:
+                self._idle.append(worker)
+            self._free.notify_all()
+
+    def _reap_locked(self) -> None:
+        now = time.monotonic()
+        keep = []
+        for w in self._idle:
+            if not w.alive() or now - w.last_used > _IDLE_REAP_S:
+                self._kill_async(w)
+                self.stats["reaped"] += 1
+            else:
+                keep.append(w)
+        self._idle[:] = keep
+
+    def execute(self, func, args, kwargs,
+                env_vars: Optional[Dict[str, str]] = None) -> Any:
+        """Run one task on a pooled worker (blocking). Crash → retriable
+        WorkerCrashedError; user exception → TaskError with remote tb."""
+        worker = self.acquire(env_vars)
+        crashed = False
+        try:
+            return worker.request("task", (func, args, kwargs))
+        except WorkerCrashedError:
+            crashed = True
+            raise
+        finally:
+            self.release(worker, crashed=crashed)
+
+    def num_workers(self) -> int:
+        with self._lock:
+            return len(self._idle) + len(self._busy)
+
+    def shutdown(self) -> None:
+        """Stop idle workers now; busy workers are killed by their own
+        release() (their pipes are in use by the running task thread, so
+        sending shutdown frames here would interleave with replies)."""
+        with self._free:
+            self._closed = True
+            idle, self._idle = self._idle, []
+        for w in idle:
+            w.shutdown()
+
+
+_pool: Optional[ProcessWorkerPool] = None
+_pool_lock = threading.Lock()
+
+
+def get_worker_pool() -> ProcessWorkerPool:
+    global _pool
+    with _pool_lock:
+        if _pool is None:
+            _pool = ProcessWorkerPool()
+        return _pool
+
+
+def shutdown_worker_pool() -> None:
+    global _pool
+    with _pool_lock:
+        if _pool is not None:
+            _pool.shutdown()
+            _pool = None

@@ -427,14 +427,19 @@ def flash_attention(
     *,
     causal: bool = False,
     sm_scale: Optional[float] = None,
-    block_q: int = 128,
-    block_kv: int = 128,
+    block_q: int = 1024,
+    block_kv: int = 1024,
     implementation: Optional[str] = None,
 ) -> jax.Array:
     """Blockwise flash attention. q (B,Hq,Sq,D); k,v (B,Hkv,Skv,D).
 
     implementation: "pallas" (TPU kernel; interpreted off-TPU), "xla"
     (reference), or None = pallas on TPU backends, xla otherwise.
+
+    Default blocks are 1024 (clamped to the sequence): at head_dim 64-128
+    the kernel is grid-overhead-bound, and big tiles measured 3.1x faster
+    than 128x128 on v5e (2.37 vs 7.45 ms/layer fwd+bwd at B8 H12 S1024 D64)
+    while the f32 score tile (1024*1024*4 = 4 MB) still fits VMEM.
     """
     if implementation is None:
         implementation = "pallas" if jax.default_backend() == "tpu" else "xla"

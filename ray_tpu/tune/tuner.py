@@ -15,7 +15,7 @@ import time
 from typing import Any, Callable, Dict, List, Optional
 
 from .. import api
-from ..core.exceptions import ActorDiedError, TaskError
+from ..core.exceptions import ActorDiedError, GetTimeoutError, TaskError
 from ..train.worker_group import TrainWorker
 from .schedulers import CONTINUE, STOP, FIFOScheduler, TrialScheduler
 from .search import generate_variants
@@ -117,6 +117,26 @@ class Tuner:
             trial.status = TrialStatus.RUNNING
             running.append(trial)
 
+        MAX_POLL_TIMEOUTS = 3
+        poll_timeouts: Dict[str, int] = {}
+        try:
+            self._run_loop(
+                cfg, scheduler, pending, running, launch,
+                poll_interval, poll_timeouts, MAX_POLL_TIMEOUTS,
+            )
+        finally:
+            # Never abandon live trial actors, whatever escapes the loop.
+            for trial in running:
+                try:
+                    api.kill(trial.actor)
+                except Exception:
+                    pass
+        return ResultGrid(trials, cfg.metric, cfg.mode)
+
+    def _run_loop(
+        self, cfg, scheduler, pending, running, launch,
+        poll_interval, poll_timeouts, max_poll_timeouts,
+    ) -> None:
         while pending or running:
             while pending and len(running) < cfg.max_concurrent:
                 launch(pending.pop(0))
@@ -124,11 +144,23 @@ class Tuner:
             for trial in list(running):
                 try:
                     poll = api.get(trial.actor.poll.remote(trial.cursor), timeout=30)
+                except GetTimeoutError:
+                    # Trial is blocking its actor past the poll timeout;
+                    # retry, and only declare it failed after repeats.
+                    n = poll_timeouts.get(trial.trial_id, 0) + 1
+                    poll_timeouts[trial.trial_id] = n
+                    if n >= max_poll_timeouts:
+                        trial.status = TrialStatus.ERRORED
+                        trial.error = f"poll timed out {n} times"
+                        api.kill(trial.actor)
+                        running.remove(trial)
+                    continue
                 except (ActorDiedError, TaskError) as e:
                     trial.status = TrialStatus.ERRORED
                     trial.error = repr(e)
                     running.remove(trial)
                     continue
+                poll_timeouts.pop(trial.trial_id, None)
                 decision = CONTINUE
                 for metrics, _ckpt, _rank, _ts in poll["reports"]:
                     trial.cursor += 1
@@ -152,4 +184,3 @@ class Tuner:
                     running.remove(trial)
             if running:
                 time.sleep(poll_interval)
-        return ResultGrid(trials, cfg.metric, cfg.mode)

@@ -133,13 +133,20 @@ class MetricsRegistry:
                     else ""
                 )
                 if m.kind == "histogram":
+                    # bucket lines carry the metric's tag labels plus le, so
+                    # tagged histograms stay distinct series
+                    tag_part = "".join(
+                        f'{k}="{v}",' for k, v in sorted(tags.items())
+                    )
                     cumulative = 0
                     for bound, count in value["buckets"]:
                         cumulative += count
                         lines.append(
-                            f'{m.name}_bucket{{le="{bound}"}} {cumulative}'
+                            f'{m.name}_bucket{{{tag_part}le="{bound}"}} {cumulative}'
                         )
-                    lines.append(f'{m.name}_bucket{{le="+Inf"}} {value["count"]}')
+                    lines.append(
+                        f'{m.name}_bucket{{{tag_part}le="+Inf"}} {value["count"]}'
+                    )
                     lines.append(f"{m.name}_sum{label} {value['sum']}")
                     lines.append(f"{m.name}_count{label} {value['count']}")
                 else:
