@@ -85,7 +85,7 @@ class ObjectEntry:
     __slots__ = (
         "object_id", "state", "value", "error", "tier", "nbytes",
         "pin_count", "event", "callbacks", "spill_path", "owner_task",
-        "last_access", "lock",
+        "last_access", "lock", "handle_count", "gc_on_seal",
     )
 
     def __init__(self, object_id: ObjectID):
@@ -105,6 +105,10 @@ class ObjectEntry:
         # RLock: _restore (under this lock, via get) may trigger _maybe_spill
         # which revisits the same entry.
         self.lock = threading.RLock()
+        # Live ObjectRef handles (reference: ReferenceCounter local refs,
+        # reference_count.h:72). 0 handles + sealed → value is GC-eligible.
+        self.handle_count = 0
+        self.gc_on_seal = False
 
 
 class ObjectStore:
@@ -119,7 +123,7 @@ class ObjectStore:
         self._spill_dir = spill_dir
         self.stats = {
             "puts": 0, "gets": 0, "spills": 0, "restores": 0, "evictions": 0,
-            "shm_puts": 0, "shm_evictions": 0,
+            "shm_puts": 0, "shm_evictions": 0, "reconstructions": 0, "gc": 0,
         }
         # Opt-in native shared-memory tier (plasma-equivalent arena) for
         # large numpy payloads. In-process workers pass objects by reference
@@ -135,6 +139,15 @@ class ObjectStore:
             except Exception:
                 self._arena = None
         self._shm_entries: Dict[int, ObjectID] = {}  # arena id -> object id
+        # Lineage resubmission hook (Runtime wires scheduler.submit here):
+        # get() of a LOST entry with a recorded owner_task re-executes it
+        # (reference: ObjectRecoveryManager, object_recovery_manager.h:43).
+        self._resubmit: Optional[Callable[[Any], None]] = None
+        self._reconstruct_lock = threading.Lock()
+        self.max_reconstructions = 3
+
+    def set_resubmit(self, fn: Callable[[Any], None]) -> None:
+        self._resubmit = fn
 
     # ------------------------------------------------------------------ write
 
@@ -218,6 +231,11 @@ class ObjectStore:
         shm_meta = self._try_shm_seal(object_id, value, nbytes)
         with self._lock:
             entry = self._entries[object_id]
+            if entry.state == ObjectState.READY:
+                # Re-seal: a lineage reconstruction raced the original
+                # execution and both sealed. Replace, releasing the old
+                # value's accounting so bytes don't double-count.
+                self._release_value(entry)
             if shm_meta is not None:
                 tier = Tier.SHM
                 value = shm_meta
@@ -244,6 +262,10 @@ class ObjectStore:
         entry.event.set()
         for cb in callbacks:
             cb(entry)
+        if entry.gc_on_seal:
+            # every handle died while the task was still running
+            entry.gc_on_seal = False
+            self._gc_entry(entry)
         # Spill/evict outside the store lock: disk I/O must not block
         # unrelated puts/gets (the reference spills asynchronously too,
         # local_object_manager.h:112).
@@ -307,31 +329,140 @@ class ObjectStore:
             entry = self._entries.get(object_id)
             if entry is None:
                 entry = self.create(object_id)
-        if not entry.event.wait(timeout):
-            raise GetTimeoutError(
-                f"Get timed out after {timeout}s waiting for {object_id}"
-            )
-        self.stats["gets"] += 1
-        if entry.state == ObjectState.ERROR:
-            raise entry.error
-        if entry.state == ObjectState.LOST:
-            raise ObjectLostError(object_id)
+        deadline = None if timeout is None else time.monotonic() + timeout
+        reconstructions = 0
         restored = False
-        with entry.lock:
-            entry.last_access = time.monotonic()
-            if entry.tier == Tier.SPILLED:
-                value = self._restore(entry)
-                restored = True
-            elif entry.tier == Tier.SHM:
-                value = self._shm_get(entry)
-            else:
-                value = entry.value
+        while True:
+            remaining = None if deadline is None else deadline - time.monotonic()
+            if not entry.event.wait(remaining):
+                raise GetTimeoutError(
+                    f"Get timed out after {timeout}s waiting for {object_id}"
+                )
+            # Everything below re-validates under entry.lock: between the
+            # wait and here, a reconstruction may have flipped the entry
+            # back to PENDING (clearing the event), or eviction may have
+            # flipped READY→LOST. Act only on the state actually held.
+            done = False
+            with entry.lock:
+                state = entry.state
+                if state == ObjectState.ERROR:
+                    self.stats["gets"] += 1
+                    raise entry.error
+                if state == ObjectState.READY:
+                    entry.last_access = time.monotonic()
+                    if entry.tier == Tier.SPILLED:
+                        value = self._restore(entry)
+                        restored = True
+                    elif entry.tier == Tier.SHM:
+                        value = self._shm_get(entry)
+                    else:
+                        value = entry.value
+                    done = True
+            if done:
+                break
+            if state == ObjectState.LOST:
+                # Lineage reconstruction: re-execute the recorded creating
+                # task (reference object_recovery_manager.h:43) and wait
+                # again. Bounded so a deterministic failure cannot loop.
+                if (
+                    reconstructions < self.max_reconstructions
+                    and self._try_reconstruct(entry)
+                ):
+                    reconstructions += 1
+                    continue
+                raise ObjectLostError(object_id)
+            # PENDING again (a reconstruction won the race): just re-wait.
+        self.stats["gets"] += 1
         if restored:
             # Outside entry.lock: spilling victims takes *their* entry locks,
             # and holding one entry lock while waiting on another is an ABBA
             # deadlock between two concurrent restores.
             self._maybe_spill()
         return value
+
+    def _try_reconstruct(self, entry: ObjectEntry) -> bool:
+        """Flip a LOST entry (and its sibling returns) back to PENDING and
+        resubmit the creating task. Exactly one caller wins the flip; losers
+        just re-wait. False if there is no lineage to replay."""
+        spec = entry.owner_task
+        if spec is None or self._resubmit is None:
+            return False
+        # One flat lock for the flip phase: two getters reconstructing
+        # different returns of the same task would otherwise take sibling
+        # entry locks in opposite orders (ABBA deadlock).
+        with self._reconstruct_lock:
+            with entry.lock:
+                if entry.state != ObjectState.LOST:
+                    return True  # another getter already reconstructed
+            for oid in spec.return_ids:
+                sibling = self.entry(oid)
+                if sibling is None:
+                    continue
+                with sibling.lock:
+                    # a sibling still READY/SPILLED must release its value
+                    # (bytes, arena block, spill file) before re-execution
+                    # overwrites it — otherwise accounting drifts and SHM
+                    # aids leak (their hash is deterministic per object id)
+                    self._release_value(sibling)
+                    sibling.state = ObjectState.PENDING
+                    sibling.error = None
+                    sibling.tier = Tier.INLINE
+                    sibling.event.clear()
+        spec.attempt = 0
+        self.stats["reconstructions"] += 1
+        self._resubmit(spec)
+        return True
+
+    # ---------------------------------------------------------- handle counts
+
+    def incref(self, object_id: ObjectID) -> None:
+        """A new ObjectRef handle exists for this object."""
+        with self._lock:
+            entry = self._entries.get(object_id)
+            if entry is None:
+                # Only a re-bound handle (unpickled after the entry was
+                # fully GC'd) increfs a missing id. There is no producer,
+                # so surface the loss instead of leaving a PENDING entry
+                # nothing will ever seal (get() would hang forever).
+                entry = self.create(object_id)
+                entry.state = ObjectState.LOST
+                entry.event.set()
+        with entry.lock:
+            entry.handle_count += 1
+
+    def decref(self, object_id: ObjectID) -> None:
+        """An ObjectRef handle died. At zero handles the VALUE is released:
+        the entry drops to LOST but keeps its owner_task, so a ref that
+        comes back (e.g. unpickled later) can still reconstruct via lineage
+        — the in-process analogue of lineage pinning (reference
+        reference_count.h:72). Entries with no lineage are removed."""
+        entry = self.entry(object_id)
+        if entry is None:
+            return
+        gc_now = False
+        with entry.lock:
+            entry.handle_count = max(0, entry.handle_count - 1)
+            if entry.handle_count == 0:
+                if entry.event.is_set():
+                    gc_now = True
+                else:
+                    entry.gc_on_seal = True
+        if gc_now:
+            self._gc_entry(entry)
+
+    def _gc_entry(self, entry: ObjectEntry) -> None:
+        with entry.lock:
+            if entry.handle_count > 0 or entry.pin_count > 0:
+                return  # a handle was recreated (incref) since the decref
+            self._release_value(entry)
+            self.stats["gc"] += 1
+            if entry.owner_task is not None:
+                entry.state = ObjectState.LOST  # reconstructable via lineage
+                entry.tier = Tier.INLINE
+                return
+        # no lineage: drop the entry entirely
+        with self._lock:
+            self._entries.pop(entry.object_id, None)
 
     # ------------------------------------------------------------ ref counting
 
@@ -347,20 +478,33 @@ class ObjectStore:
             with entry.lock:
                 entry.pin_count = max(0, entry.pin_count - 1)
 
+    def _release_value(self, entry: ObjectEntry) -> None:
+        """Drop a READY entry's stored value and every resource behind it
+        (byte accounting, arena block, spill file). Caller synchronizes
+        (entry.lock, or the store lock on the seal/free paths — the store
+        lock is re-entrant, so the internal counter updates are safe)."""
+        if entry.state == ObjectState.READY:
+            if entry.tier == Tier.DEVICE:
+                with self._lock:
+                    self._device_bytes -= entry.nbytes
+            elif entry.tier in (Tier.INLINE, Tier.HOST):
+                with self._lock:
+                    self._host_bytes -= entry.nbytes
+            elif entry.tier == Tier.SHM and self._arena is not None:
+                aid = entry.value[1]
+                with self._lock:
+                    self._shm_entries.pop(aid, None)
+                self._arena.delete(aid)
+        if entry.spill_path and os.path.exists(entry.spill_path):
+            os.unlink(entry.spill_path)
+        entry.spill_path = None
+        entry.value = None
+
     def free(self, object_id: ObjectID) -> None:
         with self._lock:
             entry = self._entries.pop(object_id, None)
-            if entry is not None and entry.state == ObjectState.READY:
-                if entry.tier == Tier.DEVICE:
-                    self._device_bytes -= entry.nbytes
-                elif entry.tier in (Tier.INLINE, Tier.HOST):
-                    self._host_bytes -= entry.nbytes
-                elif entry.tier == Tier.SHM and self._arena is not None:
-                    aid = entry.value[1]
-                    self._shm_entries.pop(aid, None)
-                    self._arena.delete(aid)
-                if entry.spill_path and os.path.exists(entry.spill_path):
-                    os.unlink(entry.spill_path)
+            if entry is not None:
+                self._release_value(entry)
 
     # -------------------------------------------------------------- spill/LRU
 

@@ -25,13 +25,25 @@ from .scheduler import ClusterScheduler, Node, PlacementGroup, TaskSpec
 
 class ObjectRef:
     """A future handle to an object in the store (reference: ObjectRef in
-    python/ray/_raylet.pyx; ownership semantics reference_count.h:72)."""
+    python/ray/_raylet.pyx; ownership semantics reference_count.h:72).
 
-    __slots__ = ("object_id", "_runtime")
+    Handles are counted: construction increfs, __del__ decrefs, and when
+    the last handle dies the store releases the value (auto-GC — manual
+    free() stays available for eager release). A GC'd object with recorded
+    lineage is reconstructed on a later get()."""
+
+    __slots__ = ("object_id", "_runtime", "__weakref__")
 
     def __init__(self, object_id: ObjectID, runtime: "Runtime"):
         self.object_id = object_id
         self._runtime = runtime
+        runtime.object_store.incref(object_id)
+
+    def __del__(self):
+        try:
+            self._runtime.object_store.decref(self.object_id)
+        except Exception:
+            pass  # interpreter teardown: modules may already be gone
 
     def hex(self) -> str:
         return self.object_id.hex()
@@ -79,6 +91,8 @@ class Runtime:
         self.gcs = GlobalControlStore()
         self.object_store = ObjectStore(object_store_capacity, spill_dir=spill_dir)
         self.scheduler = ClusterScheduler(self.object_store, self._on_task_done)
+        # lineage: a get() of a LOST object re-executes its creating task
+        self.object_store.set_resubmit(self.scheduler.submit)
         self._actors: Dict[ActorID, ActorRuntime] = {}
         self._lock = threading.Lock()
         self._task_events: List[Dict[str, Any]] = []
@@ -287,11 +301,11 @@ class Runtime:
     def _materialize_args(self, args):
         # Actor calls resolve ObjectRef args lazily inside the actor thread to
         # preserve submission ordering; we wrap them so the executor resolves.
-        return tuple(_LazyRef(a.object_id, self) if isinstance(a, ObjectRef) else a for a in args)
+        return tuple(_LazyRef(a, self) if isinstance(a, ObjectRef) else a for a in args)
 
     def _materialize_kwargs(self, kwargs):
         return {
-            k: _LazyRef(v.object_id, self) if isinstance(v, ObjectRef) else v
+            k: _LazyRef(v, self) if isinstance(v, ObjectRef) else v
             for k, v in kwargs.items()
         }
 
@@ -350,14 +364,17 @@ class Runtime:
 
 
 class _LazyRef:
-    """Marker for an ObjectRef arg of an actor call, resolved at execution."""
+    """Marker for an ObjectRef arg of an actor call, resolved at execution.
+    Holds the originating ObjectRef so the arg cannot be GC'd between
+    submission and execution."""
 
-    __slots__ = ("object_id", "_runtime")
+    __slots__ = ("object_id", "_runtime", "_pin")
     __ray_tpu_lazy__ = True
 
-    def __init__(self, object_id: ObjectID, runtime: Runtime):
-        self.object_id = object_id
+    def __init__(self, ref: "ObjectRef", runtime: Runtime):
+        self.object_id = ref.object_id
         self._runtime = runtime
+        self._pin = ref
 
     def resolve(self):
         return self._runtime.object_store.get(self.object_id)

@@ -22,6 +22,12 @@ from .mesh import (  # noqa: F401
     replicated,
     single_device_mesh,
 )
+from .pipeline import (  # noqa: F401
+    create_pp_train_state,
+    make_pp_loss_fn,
+    make_pp_train_step,
+    spmd_pipeline,
+)
 from .sharding import (  # noqa: F401
     P,
     default_rules,

@@ -1,0 +1,265 @@
+"""Pipeline parallelism: a microbatched SPMD schedule over the `pp` axis.
+
+The reference gets pipeline parallelism only through vLLM's actor-per-stage
+placement (/root/reference/python/ray/llm/_internal/serve/deployments/llm/
+vllm/vllm_models.py:128) on the Compiled-Graphs substrate
+(python/ray/dag/compiled_dag_node.py:805): stage actors, NCCL channels, a
+runtime-scheduled 1F1B loop. TPU inversion: the whole pipeline is ONE XLA
+program. Layers are sharded over the `pp` mesh axis, activations move
+between stages with `lax.ppermute` over ICI, and the microbatch rotation is
+a `lax.scan` — so the "channels" are compiler-scheduled DMAs and the
+backward schedule falls out of reverse-mode AD through the scan (the
+ppermute transposes to the reverse shift), with no runtime in the loop.
+
+Schedule: GPipe-style loop of (M + S - 1) ticks for M microbatches over S
+stages. At tick t, stage s computes microbatch (t - s); stage 0 feeds new
+microbatches, the last stage banks finished ones. Work off the diagonal is
+masked, the usual (S-1)/M bubble.
+
+Composition: dp × pp. The batch shards over dp, the layer stack over pp;
+embedding/head params are replicated and their grads psum over both axes
+inside the shard_map body (each stage runs the embed/head redundantly to
+stay SPMD — the waste is head_flops × (S-1)/S, acceptable at the depths
+where PP matters; a dedicated first/last-stage embed is a later
+optimization).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.transformer import TransformerConfig, _block, _norm
+from ..ops import cross_entropy_loss, rope_frequencies
+
+
+def spmd_pipeline(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stage_params: Any,
+    microbatches: jax.Array,
+    *,
+    axis: str = "pp",
+) -> jax.Array:
+    """Run the rotating-buffer pipeline. Must be called INSIDE shard_map.
+
+    stage_fn(stage_params, x) applies this stage's layers to one microbatch
+    of activations. microbatches has shape (M, mb, ...); entries are the
+    stage-0 inputs (every stage holds a copy — only stage 0 reads them).
+    Returns (M, mb, ...): stage_fn^S applied to every microbatch, valid on
+    the LAST stage (zeros elsewhere).
+    """
+    n_stages = jax.lax.psum(1, axis)
+    s = jax.lax.axis_index(axis)
+    num_mb = microbatches.shape[0]
+
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def tick(carry, t):
+        buf, outputs = carry
+        # stage 0 ingests microbatch t; later stages take the rotated buffer
+        feed = jax.lax.dynamic_index_in_dim(
+            microbatches, jnp.clip(t, 0, num_mb - 1), axis=0, keepdims=False
+        )
+        x = jnp.where(s == 0, feed, buf)
+        y = stage_fn(stage_params, x)
+        # the last stage banks microbatch (t - (S-1)) when it is in range
+        out_idx = t - (n_stages - 1)
+        valid = jnp.logical_and(s == n_stages - 1, out_idx >= 0)
+        slot = jnp.clip(out_idx, 0, num_mb - 1)
+        current = jax.lax.dynamic_index_in_dim(outputs, slot, 0, keepdims=False)
+        outputs = jax.lax.dynamic_update_index_in_dim(
+            outputs, jnp.where(valid, y, current), slot, 0
+        )
+        buf = jax.lax.ppermute(y, axis, perm)
+        return (buf, outputs), None
+
+    buf0 = jnp.zeros_like(microbatches[0])
+    out0 = jnp.zeros_like(microbatches)
+    (_, outputs), _ = jax.lax.scan(
+        tick, (buf0, out0), jnp.arange(num_mb + n_stages - 1)
+    )
+    return outputs
+
+
+def _split_blocks(params: Dict[str, Any]) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+    rest = {k: v for k, v in params.items() if k != "blocks"}
+    return params["blocks"], rest
+
+
+def make_pp_loss_fn(
+    config: TransformerConfig,
+    mesh: Mesh,
+    num_microbatches: int,
+    *,
+    z_loss_coeff: float = 0.0,
+) -> Callable[[Any, jax.Array], jax.Array]:
+    """loss(params, tokens) with layers pipelined over `pp` and the batch
+    sharded over `dp`. Differentiable: jax.grad builds the reverse
+    pipeline through the scan/ppermute automatically."""
+    n_stages = mesh.shape["pp"]
+    if config.n_layers % n_stages != 0:
+        raise ValueError(
+            f"n_layers={config.n_layers} not divisible by pp={n_stages}"
+        )
+    c = config
+    dt = c.dtype
+
+    blocks_spec = P("pp")  # leading (layer) axis split into stage groups
+    rest_spec = P()        # embed/head/final-norm replicated
+    tokens_spec = P("dp", None)
+    other_axes = tuple(a for a in mesh.axis_names if a != "pp")
+
+    def device_loss(blocks, rest, tokens):
+        # tokens: (B/dp, S+1) — this dp shard's batch
+        inp, tgt = tokens[:, :-1], tokens[:, 1:]
+        b, seq = inp.shape
+        mb = b // num_microbatches
+        if b % num_microbatches:
+            raise ValueError(
+                f"per-dp-shard batch {b} not divisible by "
+                f"num_microbatches={num_microbatches}"
+            )
+        x = rest["wte"].astype(dt)[inp]
+        if c.pos_emb == "learned":
+            x = x + rest["wpe"].astype(dt)[None, :seq]
+            rope_tables = None
+        else:
+            rope_tables = rope_frequencies(c.head_dim, c.max_seq, c.rope_theta)
+        x_mb = x.reshape(num_microbatches, mb, seq, x.shape[-1])
+
+        def stage_fn(stage_blocks, x):
+            def body(carry, lp):
+                return _block(carry, lp, c, rope_tables, None), None
+            y, _ = jax.lax.scan(body, x, stage_blocks)
+            return y
+
+        y_mb = spmd_pipeline(stage_fn, blocks, x_mb, axis="pp")
+        y = y_mb.reshape(b, seq, -1)
+        y = _norm(y, rest["lnf_scale"], rest.get("lnf_bias"), c.norm)
+        head = rest.get("lm_head")
+        if head is None:
+            head = rest["wte"].T
+        logits = jnp.einsum("bse,ev->bsv", y, head.astype(dt))
+        loss, _ = cross_entropy_loss(logits, tgt, z_loss_coeff=z_loss_coeff)
+        # only the last stage holds real outputs; zero-mask the rest, then
+        # reassemble the replicated scalar: sum over pp, mean over dp
+        s = jax.lax.axis_index("pp")
+        n = jax.lax.psum(1, "pp")
+        loss = jnp.where(s == n - 1, loss, 0.0)
+        loss = jax.lax.psum(loss, "pp")
+        for ax in other_axes:
+            loss = jax.lax.pmean(loss, ax)
+        return loss
+
+    sharded = shard_map(
+        device_loss,
+        mesh=mesh,
+        in_specs=(blocks_spec, rest_spec, tokens_spec),
+        out_specs=P(),
+        check_vma=False,
+    )
+
+    def loss_fn(params, tokens):
+        blocks, rest = _split_blocks(params)
+        return sharded(blocks, rest, tokens)
+
+    return loss_fn
+
+
+def pp_state_specs(config: TransformerConfig, abstract_state: Any) -> Any:
+    """PartitionSpec tree for a PP TrainState: every `blocks` leaf shards
+    its leading (layer) axis over pp; everything else is replicated."""
+
+    def spec_for(path, leaf) -> P:
+        if any(getattr(k, "key", None) == "blocks" for k in path):
+            return P("pp")
+        return P()
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(abstract_state)
+    return jax.tree_util.tree_unflatten(
+        treedef, [spec_for(p, l) for p, l in flat]
+    )
+
+
+def make_pp_train_step(
+    config: TransformerConfig,
+    optimizer,
+    mesh: Mesh,
+    *,
+    num_microbatches: int,
+    state_shardings: Any,
+    z_loss_coeff: float = 0.0,
+):
+    """One jitted dp×pp training step with the same TrainState/metrics
+    contract as train.lm.make_train_step."""
+    import optax
+
+    from ..train.lm import TrainState
+
+    loss_fn = make_pp_loss_fn(
+        config, mesh, num_microbatches, z_loss_coeff=z_loss_coeff
+    )
+    batch_sharding = NamedSharding(mesh, P("dp", None))
+    metric_sharding = NamedSharding(mesh, P())
+
+    def step_fn(state: TrainState, batch):
+        tokens = batch["tokens"]
+        loss, grads = jax.value_and_grad(loss_fn)(state.params, tokens)
+        updates, new_opt = optimizer.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        new_state = TrainState(
+            step=state.step + 1,
+            params=new_params,
+            opt_state=new_opt,
+            rng=jax.random.fold_in(state.rng, state.step),
+        )
+        metrics = {
+            "loss": loss.astype(jnp.float32),
+            "grad_norm": optax.global_norm(grads).astype(jnp.float32),
+        }
+        return new_state, metrics
+
+    return jax.jit(
+        step_fn,
+        in_shardings=(state_shardings, {"tokens": batch_sharding}),
+        out_shardings=(
+            state_shardings,
+            {k: metric_sharding for k in ("loss", "grad_norm")},
+        ),
+        donate_argnums=(0,),
+    )
+
+
+def create_pp_train_state(
+    config: TransformerConfig,
+    optimizer,
+    key: jax.Array,
+    mesh: Mesh,
+) -> Tuple[Any, Any]:
+    """TrainState initialized directly into the pp-sharded layout."""
+    from ..models.transformer import init_params
+    from ..train.lm import TrainState
+
+    def build(k):
+        params = init_params(config, k)
+        return TrainState(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            opt_state=optimizer.init(params),
+            rng=jax.random.fold_in(k, 1),
+        )
+
+    abstract = jax.eval_shape(build, key)
+    spec_tree = pp_state_specs(config, abstract)
+    shardings = jax.tree.map(
+        lambda s: NamedSharding(mesh, s),
+        spec_tree,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    state = jax.jit(build, out_shardings=shardings)(key)
+    return state, shardings

@@ -59,12 +59,16 @@ class TrainController:
         run_config: RunConfig,
         train_config: Optional[Dict[str, Any]] = None,
         poll_interval: float = 0.05,
+        group_factory: Optional[Callable[[], Any]] = None,
     ):
         self.train_fn = train_fn
         self.scaling = scaling
         self.run_config = run_config
         self.train_config = train_config
         self.poll_interval = poll_interval
+        # default: in-process actor gang; pass a factory building a
+        # MultihostWorkerGroup for one-process-per-host SPMD (multihost.py)
+        self.group_factory = group_factory
         self.status = RunStatus.PENDING
         self.metrics_history: List[Dict[str, Any]] = []
         self.latest_checkpoint_step: Optional[int] = None
@@ -74,11 +78,14 @@ class TrainController:
         policy = FailurePolicy(self.run_config.failure)
         error: Optional[str] = None
         while True:
-            group = WorkerGroup(
-                self.scaling.num_workers,
-                self.scaling.worker_resources(),
-                run_name=self.run_config.name,
-            )
+            if self.group_factory is not None:
+                group = self.group_factory()
+            else:
+                group = WorkerGroup(
+                    self.scaling.num_workers,
+                    self.scaling.worker_resources(),
+                    run_name=self.run_config.name,
+                )
             try:
                 group.start()
                 self.status = RunStatus.RUNNING
@@ -126,12 +133,12 @@ class TrainController:
                 if p["error"]:
                     return p["error"]
             if all(p["done"] for p in polls):
-                # surface any exception held by the run() refs
-                from .. import api
-
+                # surface any exception held by the run() results
+                # (Exception only: KeyboardInterrupt/SystemExit must abort
+                # the controller, not count as a restartable worker failure)
                 try:
-                    api.get(result_refs, timeout=10)
-                except (TaskError, ActorDiedError) as e:
+                    group.finish(result_refs, timeout=10)
+                except Exception as e:  # noqa: BLE001 - ferried to policy
                     return repr(e)
                 return None
             time.sleep(self.poll_interval)

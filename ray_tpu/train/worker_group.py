@@ -104,6 +104,10 @@ class WorkerGroup:
             [w.poll.remote(s) for w, s in zip(self.workers, since)], timeout=60
         )
 
+    def finish(self, result_refs, timeout=None):
+        """Block for the run() results, raising any worker exception."""
+        return api.get(result_refs, timeout=timeout)
+
     def shutdown(self) -> None:
         for w in self.workers:
             try:

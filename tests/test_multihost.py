@@ -1,0 +1,147 @@
+"""Multi-host gang: N OS processes forming one jax.distributed SPMD job.
+
+The flagship check is VERDICT round-1 item 3(b): a 2-process CPU
+jax.distributed train run produces the SAME loss as the single-process
+2-device run — the SPMD program is identical, only the process topology
+changes (reference gang bootstrap: train/_internal/backend_executor.py:230).
+"""
+
+import os
+import time
+
+import pytest
+
+from ray_tpu.train.multihost import MultihostWorkerGroup
+
+# Each host process must come up on its own 1-device CPU backend, immune to
+# the parent's 8-device XLA_FLAGS and the environment's TPU plugin.
+_HOST_ENV = {
+    "JAX_PLATFORMS": "cpu",
+    "PALLAS_AXON_POOL_IPS": "",
+    "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+}
+
+
+def _make_env(n):
+    return [dict(_HOST_ENV) for _ in range(n)]
+
+
+def _tiny_train_fn(config):
+    """Real ray_tpu train stack over whatever global mesh exists."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu.models import get_config
+    from ray_tpu.parallel import MeshSpec, build_mesh, default_rules
+    from ray_tpu.train import (
+        create_train_state,
+        default_optimizer,
+        make_train_step,
+        report,
+    )
+
+    n_dev = config["n_devices"]
+    devices = jax.devices()[:n_dev]
+    mesh = build_mesh(MeshSpec(dp=n_dev), devices=devices)
+    model_cfg = get_config("llama-tiny").replace(dtype=jnp.float32)
+    opt = default_optimizer(1e-3, total_steps=10)
+    state, shardings = create_train_state(
+        model_cfg, opt, jax.random.PRNGKey(0), mesh, default_rules()
+    )
+    step = make_train_step(model_cfg, opt, mesh, state_shardings=shardings)
+
+    # deterministic GLOBAL batch; each process feeds its own shard
+    global_tokens = (
+        np.arange(8 * 33, dtype=np.int32).reshape(8, 33) % model_cfg.vocab_size
+    )
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    sharding = NamedSharding(mesh, PartitionSpec("dp", None))
+    if jax.process_count() > 1:
+        per = 8 // jax.process_count()
+        local = global_tokens[jax.process_index() * per:(jax.process_index() + 1) * per]
+        tokens = jax.make_array_from_process_local_data(sharding, local)
+    else:
+        tokens = jax.device_put(jnp.asarray(global_tokens), sharding)
+
+    losses = []
+    for _ in range(3):
+        state, metrics = step(state, {"tokens": tokens})
+        loss = float(metrics["loss"])
+        losses.append(loss)
+        try:
+            report({"loss": loss})
+        except RuntimeError:
+            pass  # baseline invocation runs outside a session
+    return losses
+
+
+def test_two_process_distributed_matches_single_process():
+    # baseline: same SPMD program on 2 devices of THIS process
+    baseline = _tiny_train_fn({"n_devices": 2})
+
+    group = MultihostWorkerGroup(
+        num_workers=2, run_name="mh-test", env_per_worker=_make_env(2)
+    )
+    try:
+        group.start()
+        pids = group.pids()
+        assert len(set(pids)) == 2 and os.getpid() not in pids
+        futs = group.run_async(_tiny_train_fn, {"n_devices": 2})
+        results = group.finish(futs, timeout=600)
+    finally:
+        group.shutdown()
+
+    # every host computed the same global losses, equal to the baseline
+    for host_losses in results:
+        assert host_losses == pytest.approx(baseline, rel=1e-5)
+
+
+def test_report_streaming_and_poll():
+    def fn(config):
+        from ray_tpu.train import report
+
+        for i in range(3):
+            report({"i": i})
+        return "done"
+
+    group = MultihostWorkerGroup(
+        num_workers=1, run_name="mh-poll", env_per_worker=_make_env(1)
+    )
+    try:
+        group.start()
+        futs = group.run_async(fn, {})
+        deadline = time.monotonic() + 60
+        seen = 0
+        while time.monotonic() < deadline:
+            polls = group.poll([seen])
+            seen += len(polls[0]["reports"])
+            if polls[0]["done"]:
+                break
+            time.sleep(0.1)
+        assert seen == 3
+        assert group.finish(futs, timeout=10) == ["done"]
+    finally:
+        group.shutdown()
+
+
+def test_host_crash_surfaces_in_poll():
+    def fn(config):
+        os._exit(9)
+
+    group = MultihostWorkerGroup(
+        num_workers=1, run_name="mh-crash", env_per_worker=_make_env(1)
+    )
+    try:
+        group.start()
+        group.run_async(fn, {})
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            polls = group.poll([0])
+            if polls[0]["error"] or polls[0]["done"]:
+                break
+            time.sleep(0.1)
+        assert polls[0]["error"] is not None
+    finally:
+        group.shutdown()

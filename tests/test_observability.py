@@ -80,7 +80,8 @@ def test_state_api_lists():
     def work(x):
         return x * 2
 
-    ray_tpu.get([work.remote(i) for i in range(5)])
+    refs = [work.remote(i) for i in range(5)]  # held: dropping them GC's the objects
+    ray_tpu.get(refs)
     tasks = list_tasks()
     assert len(tasks) >= 5
     assert all(t["ok"] for t in tasks if t["name"] == "work")

@@ -1,0 +1,153 @@
+"""Ownership: handle refcounting with auto-GC, and lineage reconstruction.
+
+Reference behaviors modeled: ReferenceCounter local-handle counting
+(reference_count.h:72 — objects free when the last reference dies),
+ObjectRecoveryManager lineage re-execution (object_recovery_manager.h:43 —
+get() of a lost object re-runs the task that created it).
+"""
+
+import gc
+
+import numpy as np
+import pytest
+
+import ray_tpu as api
+
+
+# ------------------------------------------------------------------- auto GC
+
+
+def test_unreferenced_objects_are_gcd(runtime):
+    store = runtime.object_store
+    before = store.usage()["num_objects"]
+    for i in range(20):
+        ref = api.put(np.zeros(200_000, dtype=np.float64))  # 1.6 MB each
+        del ref
+    gc.collect()
+    after = store.usage()["num_objects"]
+    # puts have no lineage → entries drop entirely once the handle dies
+    assert after <= before + 2, (before, after)
+    assert store.stats["gc"] >= 19
+
+
+def test_live_ref_is_not_gcd(runtime):
+    store = runtime.object_store
+    ref = api.put(np.arange(100_000))
+    gc.collect()
+    np.testing.assert_array_equal(api.get(ref), np.arange(100_000))
+    assert store.stats["gc"] == 0
+
+
+def test_task_result_dropped_before_completion(runtime):
+    import time
+
+    @api.remote
+    def slow():
+        time.sleep(0.3)
+        return np.ones(100_000)
+
+    store = runtime.object_store
+    ref = slow.remote()
+    oid = ref.object_id
+    del ref
+    gc.collect()
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        entry = store.entry(oid)
+        if entry is None or entry.value is None and entry.event.is_set():
+            break
+        time.sleep(0.05)
+    assert store.stats["gc"] >= 1  # sealed value released on arrival
+
+
+def test_arg_refs_keep_objects_alive_through_actor_calls(runtime):
+    @api.remote
+    class Echo:
+        def take(self, x):
+            return int(np.sum(x))
+
+    actor = Echo.remote()
+    data = api.put(np.ones(1000, dtype=np.int64))
+    ref = actor.take.remote(data)
+    del data  # the in-flight call must pin the arg
+    gc.collect()
+    assert api.get(ref) == 1000
+
+
+# ------------------------------------------------------- lineage reconstruction
+
+
+def test_evicted_object_reconstructs_via_lineage():
+    """Fill a tiny store with NO spill dir: LRU eviction marks READY objects
+    LOST; a later get() re-executes the creating task instead of raising."""
+    import ray_tpu
+
+    rt = ray_tpu.init(
+        num_cpus=4, object_store_capacity=1 << 20, detect_accelerators=False
+    )
+    try:
+        calls = {"n": 0}
+
+        @api.remote
+        def make(i):
+            calls["n"] += 1
+            return np.full(60_000, i, dtype=np.float64)  # 480 KB
+
+        refs = [make.remote(i) for i in range(6)]  # ~2.9 MB >> 1 MB capacity
+        api.wait(refs, num_returns=len(refs), timeout=30)
+        store = rt.object_store
+        assert store.stats["evictions"] >= 1  # pressure really evicted
+        # every object still readable — evicted ones come back via re-execution
+        for i, ref in enumerate(refs):
+            out = api.get(ref, timeout=30)
+            assert out[0] == i and out.shape == (60_000,)
+        assert store.stats["reconstructions"] >= 1
+        assert calls["n"] > 6  # the task really re-ran
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_lost_object_without_lineage_raises():
+    import ray_tpu
+    from ray_tpu.core.exceptions import ObjectLostError
+    from ray_tpu.core.object_store import ObjectState
+
+    rt = ray_tpu.init(num_cpus=2, detect_accelerators=False)
+    try:
+        ref = api.put(np.ones(10))
+        entry = rt.object_store.entry(ref.object_id)
+        with entry.lock:  # simulate a loss with no owner_task recorded
+            entry.state = ObjectState.LOST
+            entry.value = None
+        with pytest.raises(ObjectLostError):
+            api.get(ref, timeout=5)
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_gcd_lineage_object_reconstructs_on_new_handle():
+    """A task output whose handles all died is GC'd to LOST (lineage kept);
+    a re-bound handle (e.g. unpickled) can still get() it back."""
+    import pickle
+
+    import ray_tpu
+
+    rt = ray_tpu.init(num_cpus=2, detect_accelerators=False)
+    try:
+        @api.remote
+        def produce():
+            return np.arange(5000)
+
+        ref = produce.remote()
+        api.get(ref, timeout=10)
+        blob = pickle.dumps(ref)
+        oid = ref.object_id
+        del ref
+        gc.collect()
+        entry = rt.object_store.entry(oid)
+        assert entry is not None and entry.value is None  # GC'd, lineage kept
+        ref2 = pickle.loads(blob)
+        np.testing.assert_array_equal(api.get(ref2, timeout=30), np.arange(5000))
+        assert rt.object_store.stats["reconstructions"] >= 1
+    finally:
+        ray_tpu.shutdown()

@@ -1,0 +1,112 @@
+"""Pipeline parallelism: the dp×pp shard_map schedule must match the plain
+(non-pipelined) computation exactly — same loss, same gradients, and a
+full train step that optimizes. (VERDICT round-1 item 5; SURVEY §2.4 PP.)
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.models import get_config
+from ray_tpu.models.transformer import forward, init_params
+from ray_tpu.ops import cross_entropy_loss
+from ray_tpu.parallel import MeshSpec, build_mesh
+from ray_tpu.parallel.pipeline import (
+    create_pp_train_state,
+    make_pp_loss_fn,
+    make_pp_train_step,
+)
+from ray_tpu.train import default_optimizer
+
+
+def _cfg():
+    # 4 layers → 2 per stage at pp=2; fp32 for exact comparison on CPU
+    return get_config("gpt2-small").replace(
+        n_layers=4, d_model=64, n_heads=4, d_ff=128, vocab_size=128,
+        max_seq=32, dtype=jnp.float32, param_dtype=jnp.float32,
+    )
+
+
+def _mesh(dp, pp):
+    spec = MeshSpec(dp=dp, pp=pp)
+    return build_mesh(spec, devices=jax.devices()[: spec.num_devices])
+
+
+def _ref_loss(params, tokens, config):
+    logits = forward(params, tokens[:, :-1], config)
+    loss, _ = cross_entropy_loss(logits, tokens[:, 1:])
+    return loss
+
+
+def test_pp_loss_matches_reference():
+    config = _cfg()
+    mesh = _mesh(dp=2, pp=2)
+    params = init_params(config, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 33), 0, config.vocab_size)
+
+    pp_loss = make_pp_loss_fn(config, mesh, num_microbatches=2)
+    got = float(jax.jit(pp_loss)(params, tokens))
+    want = float(jax.jit(lambda p, t: _ref_loss(p, t, config))(params, tokens))
+    assert got == pytest.approx(want, rel=1e-5), (got, want)
+
+
+def test_pp_grads_match_reference():
+    config = _cfg()
+    mesh = _mesh(dp=2, pp=2)
+    params = init_params(config, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 33), 0, config.vocab_size)
+
+    pp_loss = make_pp_loss_fn(config, mesh, num_microbatches=4)
+    g_pp = jax.jit(jax.grad(pp_loss))(params, tokens)
+    g_ref = jax.jit(jax.grad(lambda p, t: _ref_loss(p, t, config)))(params, tokens)
+
+    flat_pp = jax.tree_util.tree_leaves_with_path(g_pp)
+    flat_ref = {jax.tree_util.keystr(p): l for p, l in
+                jax.tree_util.tree_leaves_with_path(g_ref)}
+    for path, leaf in flat_pp:
+        ref_leaf = flat_ref[jax.tree_util.keystr(path)]
+        np.testing.assert_allclose(
+            np.asarray(leaf), np.asarray(ref_leaf), rtol=2e-4, atol=2e-6,
+            err_msg=jax.tree_util.keystr(path),
+        )
+
+
+def test_pp_train_step_optimizes():
+    config = _cfg()
+    mesh = _mesh(dp=2, pp=2)
+    opt = default_optimizer(1e-2, total_steps=20)
+    state, shardings = create_pp_train_state(
+        config, opt, jax.random.PRNGKey(0), mesh
+    )
+    step = make_pp_train_step(
+        config, opt, mesh, num_microbatches=2, state_shardings=shardings
+    )
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 33), 0, config.vocab_size)
+    losses = []
+    for _ in range(8):
+        state, metrics = step(state, {"tokens": tokens})
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0] - 0.3, losses
+    assert int(state.step) == 8
+    # the layer stack is really sharded over pp
+    blocks_sharding = state.params["blocks"]["wq"].sharding
+    assert "pp" in (blocks_sharding.spec[0] or ()), blocks_sharding
+
+
+def test_pp_requires_divisible_layers():
+    config = _cfg().replace(n_layers=3)
+    mesh = _mesh(dp=1, pp=2)
+    with pytest.raises(ValueError, match="not divisible"):
+        make_pp_loss_fn(config, mesh, num_microbatches=2)
+
+
+def test_pp4_deep_stack_matches_reference():
+    config = _cfg().replace(n_layers=8)
+    mesh = _mesh(dp=2, pp=4)
+    params = init_params(config, jax.random.PRNGKey(2))
+    tokens = jax.random.randint(jax.random.PRNGKey(3), (8, 33), 0, config.vocab_size)
+    pp_loss = make_pp_loss_fn(config, mesh, num_microbatches=4)
+    got = float(jax.jit(pp_loss)(params, tokens))
+    want = float(jax.jit(lambda p, t: _ref_loss(p, t, config))(params, tokens))
+    assert got == pytest.approx(want, rel=1e-5), (got, want)
