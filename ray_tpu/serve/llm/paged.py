@@ -1,0 +1,336 @@
+"""Paged KV cache + chunked prefill: the TPU continuous-batching substrate.
+
+Reference parity: vLLM's paged attention + chunked prefill, which the
+reference rides via VLLMEngine (/root/reference/python/ray/llm/_internal/
+serve/deployments/llm/vllm/vllm_engine.py:254). TPU inversion (the ragged
+paged attention recipe from PAPERS.md): XLA needs static shapes, so
+
+- the KV cache is a fixed POOL of pages, (L, Hkv, num_pages, page_size, D),
+  shared by every slot; a host-side allocator hands out page ids and a
+  per-slot block table maps logical positions to pages. HBM no longer
+  scales with max_slots × max_seq — concurrency is bounded by actual
+  tokens, like vLLM;
+- decode attention reads ONLY the pages a slot uses: on TPU via the Pallas
+  paged-attention kernel (scalar-prefetched block tables drive the block
+  index_map, so unused pages are never fetched); off-TPU via a gather+mask
+  XLA reference with identical semantics;
+- prefill is CHUNKED: prompts are ingested page-aligned chunk by chunk
+  (one chunk per engine tick), each chunk attending to the pages written
+  so far — so a long prompt never blocks running decodes for more than
+  one chunk's latency, and every chunk reuses ONE compiled program
+  (offset is a traced scalar, the chunk length is static).
+
+Page 0 is reserved as a scratch page: idle decode lanes write there and
+block-table rows default to it, so the fixed-shape decode program needs no
+host-side compaction.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...models.transformer import TransformerConfig, _norm
+from ...ops import apply_rope, rope_frequencies
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class PagedConfig:
+    page_size: int = 64
+    num_pages: int = 256          # pool size (page 0 reserved as scratch)
+    max_pages_per_slot: int = 16  # static block-table width
+    chunk_pages: int = 4          # prefill chunk = chunk_pages * page_size
+
+    @property
+    def chunk_tokens(self) -> int:
+        return self.chunk_pages * self.page_size
+
+    @property
+    def max_slot_tokens(self) -> int:
+        return self.max_pages_per_slot * self.page_size
+
+
+def init_paged_cache(
+    model: TransformerConfig, paged: PagedConfig
+) -> Dict[str, jax.Array]:
+    shape = (
+        model.n_layers,
+        model.kv_heads,
+        paged.num_pages,
+        paged.page_size,
+        model.head_dim,
+    )
+    return {"k": jnp.zeros(shape, model.dtype), "v": jnp.zeros(shape, model.dtype)}
+
+
+class PageAllocator:
+    """Host-side free list over the page pool. Page 0 is never handed out."""
+
+    def __init__(self, num_pages: int):
+        self._free = list(range(num_pages - 1, 0, -1))
+        self._lock = threading.Lock()
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        with self._lock:
+            if len(self._free) < n:
+                return None
+            return [self._free.pop() for _ in range(n)]
+
+    def free(self, pages: List[int]) -> None:
+        with self._lock:
+            for p in pages:
+                if p > 0:
+                    self._free.append(p)
+
+    @property
+    def available(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+
+# ------------------------------------------------------------------ attention
+
+
+def _gather_ref_attention(q, k_cache, v_cache, block_tables, lengths):
+    """XLA reference paged attention. q (B, Hq, D); caches
+    (Hkv, P, ps, D); block_tables (B, maxP); lengths (B,). Returns (B, Hq, D).
+    Semantics ground truth for the Pallas kernel (and the CPU path)."""
+    b, hq, d = q.shape
+    hkv, _, ps, _ = k_cache.shape
+    # (B, maxP, Hkv, ps, D) -> (B, Hkv, maxP*ps, D)
+    k = jnp.swapaxes(k_cache[:, block_tables], 0, 1)
+    v = jnp.swapaxes(v_cache[:, block_tables], 0, 1)
+    k = k.reshape(b, hkv, -1, d)
+    v = v.reshape(b, hkv, -1, d)
+    if hq != hkv:
+        k = jnp.repeat(k, hq // hkv, axis=1)
+        v = jnp.repeat(v, hq // hkv, axis=1)
+    logits = jnp.einsum(
+        "bhd,bhkd->bhk", q, k, preferred_element_type=jnp.float32
+    ) / math.sqrt(d)
+    mask = jnp.arange(k.shape[2])[None, :] < lengths[:, None]
+    logits = jnp.where(mask[:, None, :], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhk,bhkd->bhd", probs.astype(v.dtype), v)
+
+
+def paged_attention(q, k_cache, v_cache, block_tables, lengths, *, page_size: int):
+    """Dispatch: Pallas paged kernel on TPU, gather reference elsewhere."""
+    if jax.default_backend() == "tpu":
+        from jax.experimental.pallas.ops.tpu.paged_attention import (
+            paged_attention as _kernel,
+        )
+
+        hq = q.shape[1]
+        hkv = k_cache.shape[0]
+        # kernel layout: q (B, Hq, D); pages (Hkv, P, ps, D); scale built in?
+        # The kernel computes unscaled q·k, so pre-scale q.
+        scaled = q / math.sqrt(q.shape[-1])
+        pages_per_block = max(1, min(4, block_tables.shape[1]))
+        while block_tables.shape[1] % pages_per_block:
+            pages_per_block -= 1
+        return _kernel(
+            scaled,
+            k_cache,
+            v_cache,
+            lengths,
+            block_tables,
+            pages_per_compute_block=pages_per_block,
+        )
+    return _gather_ref_attention(q, k_cache, v_cache, block_tables, lengths)
+
+
+# --------------------------------------------------------------- model passes
+
+
+def paged_decode_step(
+    params: Params,
+    cache: Dict[str, jax.Array],
+    block_tables: jax.Array,  # (B, maxP) int32
+    tokens: jax.Array,        # (B,) int32
+    positions: jax.Array,     # (B,) int32 — write slot; length = position + 1
+    config: TransformerConfig,
+    *,
+    page_size: int,
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """One continuous-batching decode step over the paged cache."""
+    c = config
+    dt = c.dtype
+    b = tokens.shape[0]
+    x = params["wte"].astype(dt)[tokens][:, None, :]  # (B, 1, E)
+    if c.pos_emb == "learned":
+        x = x + params["wpe"].astype(dt)[positions][:, None, :]
+        rope_tables = None
+    else:
+        rope_tables = rope_frequencies(c.head_dim, c.max_seq, c.rope_theta)
+    lengths = positions + 1
+    page_ids = block_tables[jnp.arange(b), positions // page_size]  # (B,)
+    rows = positions % page_size  # (B,)
+
+    def block_fn(x, scanned):
+        lp, k_cache, v_cache = scanned  # caches (Hkv, P, ps, D)
+        h = _norm(x, lp["ln1_scale"], lp.get("ln1_bias"), c.norm)
+        q = jnp.einsum("bse,ehd->bhsd", h, lp["wq"].astype(dt))
+        k = jnp.einsum("bse,ehd->bhsd", h, lp["wk"].astype(dt))
+        v = jnp.einsum("bse,ehd->bhsd", h, lp["wv"].astype(dt))
+        if c.use_bias:
+            q = q + lp["bq"].astype(dt)[None, :, None, :]
+            k = k + lp["bk"].astype(dt)[None, :, None, :]
+            v = v + lp["bv"].astype(dt)[None, :, None, :]
+        if rope_tables is not None:
+            cos, sin = rope_tables
+            pos2d = positions[:, None]
+            q = apply_rope(q, cos, sin, pos2d)
+            k = apply_rope(k, cos, sin, pos2d)
+        # scatter this token's K/V into each slot's current page/row:
+        # cache[(h, page_b, row_b, :)] = k[b, h, 0, :] for every b, h
+        newk = jnp.swapaxes(k[:, :, 0, :], 0, 1).astype(c.dtype)  # (Hkv, B, D)
+        newv = jnp.swapaxes(v[:, :, 0, :], 0, 1).astype(c.dtype)
+        k_cache = k_cache.at[:, page_ids, rows].set(newk)
+        v_cache = v_cache.at[:, page_ids, rows].set(newv)
+        attn = paged_attention(
+            q[:, :, 0, :], k_cache, v_cache, block_tables, lengths,
+            page_size=page_size,
+        )[:, :, None, :]
+        out = jnp.einsum("bhsd,hde->bse", attn.astype(dt), lp["wo"].astype(dt))
+        if c.use_bias:
+            out = out + lp["bo"].astype(dt)
+        x = x + out
+        h = _norm(x, lp["ln2_scale"], lp.get("ln2_bias"), c.norm)
+        up = jnp.einsum("bse,ef->bsf", h, lp["w_up"].astype(dt))
+        if c.use_bias:
+            up = up + lp["b_up"].astype(dt)
+        if c.act == "swiglu":
+            from ...ops import swiglu
+
+            gate = jnp.einsum("bse,ef->bsf", h, lp["w_gate"].astype(dt))
+            act = swiglu(gate, up)
+        else:
+            from ...ops import gelu
+
+            act = gelu(up)
+        down = jnp.einsum("bsf,fe->bse", act, lp["w_down"].astype(dt))
+        if c.use_bias:
+            down = down + lp["b_down"].astype(dt)
+        return x + down, (k_cache, v_cache)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        block_fn, x, (params["blocks"], cache["k"], cache["v"])
+    )
+    x = _norm(x, params["lnf_scale"], params.get("lnf_bias"), c.norm)
+    head = params.get("lm_head")
+    if head is None:
+        head = params["wte"].T
+    logits = jnp.einsum("bse,ev->bsv", x, head.astype(dt))[:, 0]
+    return logits, {"k": new_k, "v": new_v}
+
+
+def chunk_prefill_step(
+    params: Params,
+    cache: Dict[str, jax.Array],
+    page_row: jax.Array,      # (maxP,) this slot's block table
+    chunk_page_ids: jax.Array,  # (chunk_pages,) pages this chunk fills
+    tokens: jax.Array,        # (1, C) the chunk, right-padded
+    offset: jax.Array,        # () int32 — tokens already ingested (page-aligned)
+    total_len: jax.Array,     # () int32 — offset + real tokens in this chunk
+    config: TransformerConfig,
+    *,
+    page_size: int,
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Ingest one page-aligned prompt chunk: write its K/V pages and return
+    the hidden-states logits for the LAST real token (used on the final
+    chunk to sample the first generated token).
+
+    The chunk's queries attend to keys [0, total_len): earlier pages of
+    this slot plus the causal prefix inside the chunk.
+    """
+    c = config
+    dt = c.dtype
+    _, chunk = tokens.shape
+    chunk_pages = chunk // page_size
+    pos = offset + jnp.arange(chunk)  # (C,) absolute positions
+    x = params["wte"].astype(dt)[tokens]
+    if c.pos_emb == "learned":
+        x = x + params["wpe"].astype(dt)[jnp.clip(pos, 0, c.max_seq - 1)][None]
+        rope_tables = None
+    else:
+        rope_tables = rope_frequencies(c.head_dim, c.max_seq, c.rope_theta)
+
+    def block_fn(x, scanned):
+        lp, k_cache, v_cache = scanned
+        h = _norm(x, lp["ln1_scale"], lp.get("ln1_bias"), c.norm)
+        q = jnp.einsum("bse,ehd->bhsd", h, lp["wq"].astype(dt))
+        k = jnp.einsum("bse,ehd->bhsd", h, lp["wk"].astype(dt))
+        v = jnp.einsum("bse,ehd->bhsd", h, lp["wv"].astype(dt))
+        if c.use_bias:
+            q = q + lp["bq"].astype(dt)[None, :, None, :]
+            k = k + lp["bk"].astype(dt)[None, :, None, :]
+            v = v + lp["bv"].astype(dt)[None, :, None, :]
+        if rope_tables is not None:
+            cos, sin = rope_tables
+            q = apply_rope(q, cos, sin, pos[None])
+            k = apply_rope(k, cos, sin, pos[None])
+        # page-aligned chunk → whole-page scatter; k is (1, Hkv, C, D)
+        kp = k[0].transpose(1, 0, 2).reshape(chunk_pages, page_size, -1, k.shape[-1])
+        vp = v[0].transpose(1, 0, 2).reshape(chunk_pages, page_size, -1, v.shape[-1])
+        # (pages, ps, Hkv, D) -> (Hkv, pages, ps, D)
+        kp = kp.transpose(2, 0, 1, 3).astype(c.dtype)
+        vp = vp.transpose(2, 0, 1, 3).astype(c.dtype)
+        k_cache = k_cache.at[:, chunk_page_ids].set(kp)
+        v_cache = v_cache.at[:, chunk_page_ids].set(vp)
+        # attend: gather this slot's pages -> (Hkv, maxP*ps, D)
+        keys = k_cache[:, page_row].reshape(k_cache.shape[0], -1, k.shape[-1])
+        vals = v_cache[:, page_row].reshape(v_cache.shape[0], -1, v.shape[-1])
+        hq, hkv = q.shape[1], keys.shape[0]
+        if hq != hkv:
+            keys = jnp.repeat(keys, hq // hkv, axis=0)
+            vals = jnp.repeat(vals, hq // hkv, axis=0)
+        logits = jnp.einsum(
+            "hqd,hkd->hqk", q[0], keys, preferred_element_type=jnp.float32
+        ) / math.sqrt(q.shape[-1])
+        key_pos = jnp.arange(keys.shape[1])
+        causal = key_pos[None, :] <= pos[:, None]
+        valid = key_pos[None, :] < total_len
+        logits = jnp.where((causal & valid)[None], logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1)
+        attn = jnp.einsum("hqk,hkd->hqd", probs.astype(vals.dtype), vals)
+        out = jnp.einsum("hsd,hde->se", attn.astype(dt), lp["wo"].astype(dt))[None]
+        if c.use_bias:
+            out = out + lp["bo"].astype(dt)
+        x = x + out
+        h = _norm(x, lp["ln2_scale"], lp.get("ln2_bias"), c.norm)
+        up = jnp.einsum("bse,ef->bsf", h, lp["w_up"].astype(dt))
+        if c.use_bias:
+            up = up + lp["b_up"].astype(dt)
+        if c.act == "swiglu":
+            from ...ops import swiglu
+
+            act = swiglu(jnp.einsum("bse,ef->bsf", h, lp["w_gate"].astype(dt)), up)
+        else:
+            from ...ops import gelu
+
+            act = gelu(up)
+        down = jnp.einsum("bsf,fe->bse", act, lp["w_down"].astype(dt))
+        if c.use_bias:
+            down = down + lp["b_down"].astype(dt)
+        return x + down, (k_cache, v_cache)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        block_fn, x, (params["blocks"], cache["k"], cache["v"])
+    )
+    x = _norm(x, params["lnf_scale"], params.get("lnf_bias"), c.norm)
+    head = params.get("lm_head")
+    if head is None:
+        head = params["wte"].T
+    # only the last REAL token's logits matter (final chunk samples from it)
+    last = jnp.clip(total_len - offset - 1, 0, chunk - 1)
+    logits = jnp.einsum("se,ev->sv", x[0], head.astype(dt))[last]
+    return logits[None], {"k": new_k, "v": new_v}
