@@ -107,9 +107,16 @@ def maybe_inject(task_name: str) -> None:
         if config.delay_s > 0:
             delay = config.delay_s
             _state.injected += 1
-        if config.failure_prob > 0 and _state.rng.random() < config.failure_prob:
-            if delay == 0.0:
-                _state.injected += 1
+        if (
+            config.failure_prob > 0
+            # A failure is its own injection event even when a delay fired in
+            # the same call: re-check the budget (the delay may have consumed
+            # the last unit) and count it separately so max_injections bounds
+            # the TOTAL number of injections and fail ordinals are unique.
+            and not (0 <= config.max_injections <= _state.injected)
+            and _state.rng.random() < config.failure_prob
+        ):
+            _state.injected += 1
             fail_ordinal = _state.injected
     if delay > 0:
         time.sleep(delay)

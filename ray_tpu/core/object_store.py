@@ -231,6 +231,11 @@ class ObjectStore:
         shm_meta = self._try_shm_seal(object_id, value, nbytes)
         with self._lock:
             entry = self._entries[object_id]
+        # entry.lock BEFORE the store lock (the established order): the
+        # re-seal path below releases the old READY value, and a concurrent
+        # get() holding entry.lock mid-_restore/_shm_get must never have
+        # spill_path unlinked or value cleared under it.
+        with entry.lock, self._lock:
             if entry.state == ObjectState.READY:
                 # Re-seal: a lineage reconstruction raced the original
                 # execution and both sealed. Replace, releasing the old
@@ -429,6 +434,20 @@ class ObjectStore:
                 entry.event.set()
         with entry.lock:
             entry.handle_count += 1
+            # A concurrent no-lineage GC may have popped this entry between
+            # our lookup and taking entry.lock (only possible when we are the
+            # first handle back, i.e. count was 0). Re-insert it as LOST so
+            # the handle resolves to ObjectLostError instead of a later get()
+            # recreating a fresh PENDING entry nothing will ever seal. If a
+            # NEWER entry took the slot in the interim (e.g. a producer
+            # re-created it), that one is authoritative — leave it.
+            if entry.handle_count == 1:
+                with self._lock:
+                    if object_id not in self._entries:
+                        entry.state = ObjectState.LOST
+                        entry.value = None
+                        entry.event.set()
+                        self._entries[object_id] = entry
 
     def decref(self, object_id: ObjectID) -> None:
         """An ObjectRef handle died. At zero handles the VALUE is released:
@@ -460,9 +479,12 @@ class ObjectStore:
                 entry.state = ObjectState.LOST  # reconstructable via lineage
                 entry.tier = Tier.INLINE
                 return
-        # no lineage: drop the entry entirely
-        with self._lock:
-            self._entries.pop(entry.object_id, None)
+            # No lineage: drop the entry while STILL holding entry.lock so
+            # the liveness check and the pop are atomic with respect to a
+            # concurrent incref (which increments under entry.lock and
+            # re-inserts if it finds itself popped).
+            with self._lock:
+                self._entries.pop(entry.object_id, None)
 
     # ------------------------------------------------------------ ref counting
 

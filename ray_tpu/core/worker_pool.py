@@ -23,6 +23,7 @@ and shipped by value; the child does not join the cluster.
 
 from __future__ import annotations
 
+import logging
 import os
 import threading
 import time
@@ -32,6 +33,8 @@ from typing import Any, Dict, List, Optional, Tuple
 import cloudpickle
 
 from .exceptions import TaskError
+
+logger = logging.getLogger(__name__)
 
 _IDLE_REAP_S = 60.0
 
@@ -158,12 +161,24 @@ class WorkerProcess:
         if kind == "shutdown":
             return None
         deadline = None if timeout is None else time.monotonic() + timeout
+        # Watchdog: with timeout=None a wedged-but-alive worker (user code
+        # deadlocked in the child) would otherwise hang this thread silently;
+        # log periodically so stuck workers are diagnosable by pid + request.
+        start = time.monotonic()
+        next_warn = start + 30.0
         while True:
             wait = 0.2 if deadline is None else min(0.2, deadline - time.monotonic())
             if wait <= 0:
                 raise TimeoutError(f"worker {self.pid} request timed out")
             if self._conn.poll(wait):
                 break
+            now = time.monotonic()
+            if now >= next_warn:
+                logger.warning(
+                    "worker %d has not replied to %r for %.0fs (still alive; "
+                    "possibly wedged in user code)", self.pid, kind, now - start,
+                )
+                next_warn = now + 30.0
             if not self.alive():
                 raise WorkerCrashedError(
                     f"worker process {self.pid} died (exitcode "

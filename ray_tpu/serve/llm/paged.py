@@ -76,18 +76,25 @@ class PageAllocator:
 
     def __init__(self, num_pages: int):
         self._free = list(range(num_pages - 1, 0, -1))
+        self._allocated: set = set()
         self._lock = threading.Lock()
 
     def alloc(self, n: int) -> Optional[List[int]]:
         with self._lock:
             if len(self._free) < n:
                 return None
-            return [self._free.pop() for _ in range(n)]
+            pages = [self._free.pop() for _ in range(n)]
+            self._allocated.update(pages)
+            return pages
 
     def free(self, pages: List[int]) -> None:
+        # Double-free guard: a page not currently allocated is ignored, so a
+        # buggy caller can never put the same physical page on the free list
+        # twice (which would hand it to two slots and corrupt both KV caches).
         with self._lock:
             for p in pages:
-                if p > 0:
+                if p > 0 and p in self._allocated:
+                    self._allocated.discard(p)
                     self._free.append(p)
 
     @property
