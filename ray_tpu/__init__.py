@@ -45,6 +45,7 @@ from .core.exceptions import (  # noqa: F401
     TaskError,
 )
 from .core.runtime import ActorHandle, ObjectRef  # noqa: F401
+from .core.streaming import ObjectRefGenerator  # noqa: F401
 from .core.scheduler import (  # noqa: F401
     NodeAffinitySchedulingStrategy,
     PlacementGroupSchedulingStrategy,

@@ -25,17 +25,34 @@ def init(
     num_tpus: Optional[int] = None,
     resources: Optional[ResourceDict] = None,
     num_nodes: int = 1,
-    object_store_capacity: int = 8 << 30,
+    object_store_capacity: Optional[int] = None,
     spill_dir: Optional[str] = None,
     detect_accelerators: bool = True,
     ignore_reinit_error: bool = True,
+    _system_config: Optional[Dict[str, Any]] = None,
 ) -> _rt.Runtime:
     """Start (or connect to) the in-process cluster runtime.
 
     `num_nodes > 1` creates multiple logical nodes in one process — the same
     multi-node-without-a-cluster trick the reference uses for testing
     (python/ray/cluster_utils.py:135).
+
+    `_system_config` overrides central config flags for this process (the
+    reference's ray.init(_system_config=...) escape hatch over
+    common/ray_config_def.h); see `ray_tpu.core.config.cfg.describe()`.
     """
+    if _system_config and _rt.is_initialized():
+        # Components capture flags at construction; silently accepting an
+        # override that can no longer take effect would be a lie (the
+        # reference likewise rejects _system_config on reconnect).
+        raise RuntimeError(
+            "_system_config cannot be applied: the runtime is already "
+            "initialized. Call shutdown() first."
+        )
+    if _system_config:
+        from .core.config import cfg
+
+        cfg.set(**_system_config)
     if _rt.is_initialized():
         if not ignore_reinit_error:
             raise RuntimeError("ray_tpu.init() called twice")

@@ -53,6 +53,20 @@ class ActorMethodCall:
     kwargs: Dict[str, Any]
     return_ids: List[ObjectID]
     num_returns: int = 1
+    # streaming generator method (num_returns="streaming"): yields flow
+    # through `stream` (reference: ObjectRefStream, core_worker.h:273)
+    streaming: bool = False
+    stream: Any = None
+
+    def fail(self, store, error: BaseException) -> None:
+        """Seal `error` into every unresolved return slot and close the
+        stream. The one shared failure path for kill/restart/crash."""
+        for oid in self.return_ids:
+            entry = store.entry(oid)
+            if entry is None or not entry.event.is_set():
+                store.seal_error(oid, error)
+        if self.stream is not None:
+            self.stream._finish(error)
 
 
 _POISON = object()
@@ -111,6 +125,11 @@ class ActorRuntime:
         self._worker_lock = threading.Lock()  # serializes the worker pipe
         self._lock = threading.Lock()
         self._alive_event = threading.Event()
+        # Calls currently executing; _die fails them immediately (reference:
+        # a killed worker process fails its in-flight tasks at once). For
+        # thread actors the zombie thread may still finish and re-seal a
+        # value over the error — acceptable: kill-vs-result is racy anyway.
+        self._inflight: List[ActorMethodCall] = []
         self._thread = threading.Thread(
             target=self._lifecycle, name=f"ray_tpu-actor-{self.name}", daemon=True
         )
@@ -252,6 +271,18 @@ class ActorRuntime:
                 executor.shutdown(wait=True)
 
     def _execute(self, call: ActorMethodCall) -> None:
+        with self._lock:
+            self._inflight.append(call)
+        try:
+            self._execute_inner(call)
+        finally:
+            with self._lock:
+                try:
+                    self._inflight.remove(call)
+                except ValueError:
+                    pass
+
+    def _execute_inner(self, call: ActorMethodCall) -> None:
         try:
             if call.method_name == "__ray_ready__" and self._worker is None:
                 result = True
@@ -288,8 +319,7 @@ class ActorRuntime:
                         # was an explicit kill (state already DEAD), do NOT
                         # enqueue a restart — no_restart must stay final.
                         err = ActorDiedError(self.actor_id, str(crash))
-                        for oid in call.return_ids:
-                            self._store.seal_error(oid, err)
+                        call.fail(self._store, err)
                         with self._lock:
                             dead = self.state == ActorState.DEAD
                         if not dead:
@@ -298,7 +328,24 @@ class ActorRuntime:
                 else:
                     method = getattr(self._instance, call.method_name)
                     result = method(*args, **kwargs)
-            if call.num_returns == 1:
+            if call.streaming:
+                # Generator method: seal each yield into its own dynamic
+                # return id and hand it to the consumer stream immediately
+                # (reference: ObjectRefStream, core_worker.h:273).
+                if not hasattr(result, "__iter__"):
+                    raise TypeError(
+                        f"{self.name}.{call.method_name} declared "
+                        'num_returns="streaming" but returned '
+                        f"{type(result).__name__}, not an iterable"
+                    )
+                for idx, item in enumerate(result):
+                    oid = ObjectID.for_task_return(call.task_id, idx)
+                    self._store.create(oid)
+                    self._store.seal(oid, item)
+                    call.return_ids.append(oid)
+                    call.stream._append_oid(oid)
+                call.stream._finish()
+            elif call.num_returns == 1:
                 self._store.seal(call.return_ids[0], result)
             else:
                 values = list(result)
@@ -312,8 +359,7 @@ class ActorRuntime:
         except BaseException as exc:  # noqa: BLE001 - boundary
             tb = traceback.format_exc()
             err = TaskError(f"{self.name}.{call.method_name}", exc, tb)
-            for oid in call.return_ids:
-                self._store.seal_error(oid, err)
+            call.fail(self._store, err)
 
     def _fail_inflight_after_restart(self, signal: "_RestartSignal") -> bool:
         # Drain whatever was queued before the failure; those calls fail
@@ -328,8 +374,7 @@ class ActorRuntime:
                     poisoned = True
                 elif isinstance(msg, ActorMethodCall):
                     err = ActorDiedError(self.actor_id, signal.reason)
-                    for oid in msg.return_ids:
-                        self._store.seal_error(oid, err)
+                    msg.fail(self._store, err)
         except queue.Empty:
             pass
         return poisoned
@@ -362,6 +407,11 @@ class ActorRuntime:
             # Hard-kill the worker process now: an in-flight call observes
             # the crash and fails immediately instead of waiting out poison.
             worker.kill()
+        with self._lock:
+            inflight = list(self._inflight)
+        err = ActorDiedError(self.actor_id, reason)
+        for call in inflight:
+            call.fail(self._store, err)
         self._alive_event.set()  # unblock waiters; they will observe DEAD
         if self._on_death is not None:
             try:
@@ -373,9 +423,7 @@ class ActorRuntime:
             while True:
                 msg = self._mailbox.get_nowait()
                 if isinstance(msg, ActorMethodCall):
-                    err = ActorDiedError(self.actor_id, reason)
-                    for oid in msg.return_ids:
-                        self._store.seal_error(oid, err)
+                    msg.fail(self._store, ActorDiedError(self.actor_id, reason))
         except queue.Empty:
             pass
 
@@ -384,9 +432,7 @@ class ActorRuntime:
     def submit(self, call: ActorMethodCall) -> None:
         with self._lock:
             if self.state == ActorState.DEAD:
-                err = ActorDiedError(self.actor_id, self.death_cause)
-                for oid in call.return_ids:
-                    self._store.seal_error(oid, err)
+                call.fail(self._store, ActorDiedError(self.actor_id, self.death_cause))
                 return
         self._mailbox.put(call)
 

@@ -1,0 +1,183 @@
+"""Central typed flag registry with env overrides.
+
+Reference parity: the reference defines 225 ``RAY_CONFIG(type, name,
+default)`` flags in one place (/root/reference/src/ray/common/
+ray_config_def.h) with per-process env overrides ``RAY_<name>``
+(ray_config.h:104) and a ``_system_config`` escape hatch in ``ray.init``.
+
+TPU inversion: no C++ macro layer — a plain Python registry. Every flag is
+typed, documented, env-overridable via ``RAY_TPU_<NAME>``, and overridable
+at ``init(_system_config={...})`` time. Subsystems read flags through the
+singleton (``from ray_tpu.core.config import cfg``) so behavior is
+discoverable and tunable in ONE place instead of ad-hoc ``os.environ``
+reads scattered through the tree.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+from typing import Any, Callable, Dict, Optional
+
+_TRUE = ("1", "true", "yes", "on")
+_FALSE = ("0", "false", "no", "off", "")
+
+
+def _parse(raw: str, type_: type) -> Any:
+    if type_ is bool:
+        low = raw.strip().lower()
+        if low in _TRUE:
+            return True
+        if low in _FALSE:
+            return False
+        # Lenient fallback (pre-registry env checks treated any non-empty
+        # value as truthy): warn rather than crash init over a stray token.
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "unrecognized boolean value %r; treating as true", raw
+        )
+        return True
+    if type_ is int:
+        return int(float(raw))  # accepts "8e9" style
+    return type_(raw)
+
+
+@dataclasses.dataclass(frozen=True)
+class Flag:
+    name: str
+    default: Any
+    type: type
+    doc: str
+
+    @property
+    def env_var(self) -> str:
+        return "RAY_TPU_" + self.name.upper()
+
+
+_REGISTRY: Dict[str, Flag] = {}
+
+
+def define_flag(name: str, default: Any, doc: str, type_: Optional[type] = None) -> None:
+    if name in _REGISTRY:
+        raise ValueError(f"flag {name!r} already defined")
+    _REGISTRY[name] = Flag(name, default, type_ or type(default), doc)
+
+
+# --------------------------------------------------------------------- flags
+# One definition per tunable; grouped by subsystem. Keep docs to one line.
+
+# object store
+define_flag("native_store", False,
+            "Place large numpy arrays in the native C++ shared-memory arena.")
+define_flag("object_store_capacity_bytes", 8 << 30,
+            "Host-tier byte budget before LRU spill/eviction kicks in.")
+define_flag("inline_max_bytes", 100 * 1024,
+            "Objects at or under this size stay in the inline tier.")
+define_flag("shm_min_bytes", 64 * 1024,
+            "Numpy arrays at or over this size go to the native arena.")
+define_flag("spill_dir", "",
+            "Directory for spilled objects ('' = evict to LOST + lineage).")
+
+# scheduler / workers
+define_flag("worker_idle_timeout_s", 60.0,
+            "Idle process workers are reaped after this many seconds.")
+define_flag("max_process_workers", 0,
+            "Upper bound on pooled worker processes (0 = one per CPU core).")
+define_flag("task_event_buffer", 100_000,
+            "Max retained task events for the state API / timeline.")
+
+# accelerators
+define_flag("force_no_tpu", False,
+            "Pretend no TPU is attached (resource detection override).")
+
+# GCS persistence / health
+define_flag("gcs_snapshot_path", "",
+            "File path for periodic GCS table snapshots ('' = disabled).")
+define_flag("gcs_snapshot_interval_s", 5.0,
+            "Seconds between GCS snapshots when snapshotting is enabled.")
+define_flag("health_check_period_s", 0.5,
+            "Interval between node/actor health probes.")
+define_flag("health_check_failures", 3,
+            "Consecutive probe failures before a target is marked dead.")
+
+# memory monitor / OOM
+define_flag("memory_monitor_interval_s", 0.25,
+            "Polling interval of the host memory monitor (0 = disabled).")
+define_flag("memory_usage_threshold", 0.95,
+            "Fraction of host memory in use that triggers the OOM policy.")
+define_flag("oom_policy", "retriable_fifo",
+            "Worker-killing policy: 'retriable_fifo' or 'group_by_owner'.")
+
+
+class RayTpuConfig:
+    """Resolved flag values: defaults < env (RAY_TPU_<NAME>) < set() overrides."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._overrides: Dict[str, Any] = {}
+        self._listeners: Dict[str, Callable[[Any], None]] = {}
+
+    def __getattr__(self, name: str) -> Any:
+        flag = _REGISTRY.get(name)
+        if flag is None:
+            raise AttributeError(f"no such flag: {name!r}")
+        with self._lock:
+            if name in self._overrides:
+                return self._overrides[name]
+        raw = os.environ.get(flag.env_var)
+        if raw is not None:
+            try:
+                return _parse(raw, flag.type)
+            except (ValueError, TypeError) as e:
+                raise ValueError(
+                    f"bad value for {flag.env_var}={raw!r}: {e}"
+                ) from None
+        return flag.default
+
+    def set(self, **overrides: Any) -> None:
+        """Programmatic overrides (e.g. init(_system_config=...))."""
+        for name, value in overrides.items():
+            flag = _REGISTRY.get(name)
+            if flag is None:
+                raise ValueError(
+                    f"unknown config flag {name!r}; known: {sorted(_REGISTRY)}"
+                )
+            if value is not None and not isinstance(value, flag.type):
+                # int is acceptable where float is expected, etc.
+                try:
+                    value = flag.type(value)
+                except (ValueError, TypeError):
+                    raise ValueError(
+                        f"flag {name!r} expects {flag.type.__name__}, got "
+                        f"{type(value).__name__}"
+                    ) from None
+            with self._lock:
+                self._overrides[name] = value
+
+    def reset(self, name: Optional[str] = None) -> None:
+        with self._lock:
+            if name is None:
+                self._overrides.clear()
+            else:
+                self._overrides.pop(name, None)
+
+    def describe(self) -> str:
+        """Human-readable flag table (used by the CLI)."""
+        lines = []
+        for flag in sorted(_REGISTRY.values(), key=lambda f: f.name):
+            cur = getattr(self, flag.name)
+            mark = "" if cur == flag.default else "  [overridden]"
+            lines.append(
+                f"{flag.name} = {cur!r}{mark}\n"
+                f"    {flag.doc} (env: {flag.env_var}, "
+                f"default: {flag.default!r})"
+            )
+        return "\n".join(lines)
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {name: getattr(self, name) for name in _REGISTRY}
+
+
+cfg = RayTpuConfig()

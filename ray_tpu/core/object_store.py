@@ -44,9 +44,9 @@ class Tier(enum.Enum):
     SPILLED = "spilled"    # on disk
 
 
-_INLINE_MAX_BYTES = 100 * 1024  # mirrors reference task_transport inline cutoff
-_SHM_MIN_BYTES = 64 * 1024  # numpy arrays this large go to the native arena
-_NATIVE_STORE_ENV = "RAY_TPU_NATIVE_STORE"
+# Tier thresholds come from the central flag registry (config.py):
+# inline_max_bytes mirrors the reference task_transport inline cutoff;
+# shm_min_bytes gates placement into the native arena.
 
 
 def _estimate_nbytes(value: Any) -> int:
@@ -115,9 +115,13 @@ class ObjectStore:
     """Thread-safe object table with futures semantics and LRU spilling."""
 
     def __init__(self, capacity_bytes: int = 8 << 30, spill_dir: Optional[str] = None):
+        from .config import cfg
+
         self._entries: "OrderedDict[ObjectID, ObjectEntry]" = OrderedDict()
         self._lock = threading.RLock()
         self._capacity = capacity_bytes
+        self._inline_max = cfg.inline_max_bytes
+        self._shm_min = cfg.shm_min_bytes
         self._host_bytes = 0
         self._device_bytes = 0
         self._spill_dir = spill_dir
@@ -130,7 +134,7 @@ class ObjectStore:
         # already, so this buys bounded accounting + native LRU eviction and
         # is the substrate for multi-process CPU workers.
         self._arena = None
-        if os.environ.get(_NATIVE_STORE_ENV, "").lower() in ("1", "true"):
+        if cfg.native_store:
             try:
                 from .native_store import NativeArena, native_available
 
@@ -181,7 +185,7 @@ class ObjectStore:
             self._arena is None
             or not isinstance(value, np.ndarray)
             or value.dtype == object
-            or nbytes < _SHM_MIN_BYTES
+            or nbytes < self._shm_min
         ):
             return None
         # Arena ids are 64-bit. Hash the FULL object id: the bit-layout puts
@@ -247,7 +251,7 @@ class ObjectStore:
             elif _is_device_array(value):
                 tier = Tier.DEVICE
                 self._device_bytes += nbytes
-            elif nbytes <= _INLINE_MAX_BYTES:
+            elif nbytes <= self._inline_max:
                 tier = Tier.INLINE
                 self._host_bytes += nbytes
             else:
@@ -422,32 +426,43 @@ class ObjectStore:
 
     def incref(self, object_id: ObjectID) -> None:
         """A new ObjectRef handle exists for this object."""
-        with self._lock:
-            entry = self._entries.get(object_id)
-            if entry is None:
-                # Only a re-bound handle (unpickled after the entry was
-                # fully GC'd) increfs a missing id. There is no producer,
-                # so surface the loss instead of leaving a PENDING entry
-                # nothing will ever seal (get() would hang forever).
-                entry = self.create(object_id)
-                entry.state = ObjectState.LOST
-                entry.event.set()
-        with entry.lock:
-            entry.handle_count += 1
-            # A concurrent no-lineage GC may have popped this entry between
-            # our lookup and taking entry.lock (only possible when we are the
-            # first handle back, i.e. count was 0). Re-insert it as LOST so
-            # the handle resolves to ObjectLostError instead of a later get()
-            # recreating a fresh PENDING entry nothing will ever seal. If a
-            # NEWER entry took the slot in the interim (e.g. a producer
-            # re-created it), that one is authoritative — leave it.
-            if entry.handle_count == 1:
+        while True:
+            with self._lock:
+                entry = self._entries.get(object_id)
+                if entry is None:
+                    # Only a re-bound handle (unpickled after the entry was
+                    # fully GC'd) increfs a missing id. There is no producer,
+                    # so surface the loss instead of leaving a PENDING entry
+                    # nothing will ever seal (get() would hang forever).
+                    entry = self.create(object_id)
+                    entry.state = ObjectState.LOST
+                    entry.event.set()
+            with entry.lock:
+                entry.handle_count += 1
+                if entry.handle_count > 1:
+                    return  # entry demonstrably live; no pop race possible
+                # First handle back: a concurrent no-lineage GC may have
+                # popped this entry between our lookup and taking entry.lock.
+                # Re-check the table: if our entry still owns the slot we are
+                # done; if the slot is empty, re-insert it as LOST so the
+                # handle resolves to ObjectLostError instead of a later get()
+                # recreating a PENDING entry nothing will ever seal; if a
+                # NEWER entry took the slot, that one is authoritative —
+                # undo our count on the stale entry and retry against it
+                # (otherwise our eventual decref would land on the new entry
+                # and release a value a live handle still guards).
                 with self._lock:
-                    if object_id not in self._entries:
+                    current = self._entries.get(object_id)
+                    if current is entry:
+                        return
+                    if current is None:
                         entry.state = ObjectState.LOST
                         entry.value = None
                         entry.event.set()
                         self._entries[object_id] = entry
+                        return
+                    entry.handle_count -= 1
+            # loop: incref the entry that actually owns the slot now
 
     def decref(self, object_id: ObjectID) -> None:
         """An ObjectRef handle died. At zero handles the VALUE is released:

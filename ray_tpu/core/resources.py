@@ -81,7 +81,9 @@ def detect_tpu_resources() -> ResourceDict:
 
     if importlib.util.find_spec("jax") is None:  # pragma: no cover
         return {}
-    if os.environ.get("RAY_TPU_FORCE_NO_TPU"):
+    from .config import cfg
+
+    if cfg.force_no_tpu:
         return {}
     try:
         import jax

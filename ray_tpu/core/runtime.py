@@ -21,6 +21,7 @@ from .ids import ActorID, JobID, NodeID, ObjectID, TaskID
 from .object_store import ObjectStore
 from .resources import ResourceDict, default_node_resources
 from .scheduler import ClusterScheduler, Node, PlacementGroup, TaskSpec
+from .streaming import ObjectRefGenerator
 
 
 class ObjectRef:
@@ -83,10 +84,16 @@ class Runtime:
         num_tpus: Optional[int] = None,
         resources: Optional[ResourceDict] = None,
         num_nodes: int = 1,
-        object_store_capacity: int = 8 << 30,
+        object_store_capacity: Optional[int] = None,
         spill_dir: Optional[str] = None,
         detect_accelerators: bool = True,
     ):
+        from .config import cfg
+
+        if object_store_capacity is None:
+            object_store_capacity = cfg.object_store_capacity_bytes
+        if spill_dir is None:
+            spill_dir = cfg.spill_dir or None
         self.job_id = JobID.next()
         self.gcs = GlobalControlStore()
         self.object_store = ObjectStore(object_store_capacity, spill_dir=spill_dir)
@@ -164,25 +171,32 @@ class Runtime:
         args: Tuple[Any, ...],
         kwargs: Dict[str, Any],
         name: str = "",
-        num_returns: int = 1,
+        num_returns: Union[int, str] = 1,
         resources: Optional[ResourceDict] = None,
         max_retries: int = 0,
         retry_exceptions: Any = False,
         scheduling_strategy: Any = "DEFAULT",
         runtime_env: Any = None,
         executor: str = "thread",
-    ) -> Union[ObjectRef, List[ObjectRef]]:
+    ) -> Union[ObjectRef, List[ObjectRef], "ObjectRefGenerator"]:
         from . import runtime_env as _renv
 
+        streaming = num_returns == "streaming"
+        if streaming and executor == "process":
+            raise ValueError(
+                'num_returns="streaming" requires the thread executor: a '
+                "process worker returns one pickled result, not a live stream"
+            )
         task_id = TaskID.of(self.job_id)
-        return_ids = [ObjectID.for_task_return(task_id, i) for i in range(num_returns)]
+        n_static = 0 if streaming else num_returns
+        return_ids = [ObjectID.for_task_return(task_id, i) for i in range(n_static)]
         spec = TaskSpec(
             task_id=task_id,
             name=name or getattr(func, "__name__", "task"),
             func=func,
             args=args,
             kwargs=kwargs,
-            num_returns=num_returns,
+            num_returns=n_static,
             resources=dict(resources or {"CPU": 1.0}),
             max_retries=max_retries,
             retry_exceptions=retry_exceptions,
@@ -190,10 +204,15 @@ class Runtime:
             return_ids=return_ids,
             runtime_env=_renv.normalize(runtime_env),
             executor=executor,
+            streaming=streaming,
         )
+        if streaming:
+            spec.stream = ObjectRefGenerator(task_id, self)
         for oid in return_ids:
             self.object_store.create(oid, owner_task=spec)
         self.scheduler.submit(spec)
+        if streaming:
+            return spec.stream
         refs = [ObjectRef(oid, self) for oid in return_ids]
         return refs[0] if num_returns == 1 else refs
 
@@ -280,10 +299,17 @@ class Runtime:
         method_name: str,
         args: Tuple[Any, ...],
         kwargs: Dict[str, Any],
-        num_returns: int = 1,
-    ) -> Union[ObjectRef, List[ObjectRef]]:
+        num_returns: Union[int, str] = 1,
+    ) -> Union[ObjectRef, List[ObjectRef], "ObjectRefGenerator"]:
         task_id = TaskID.of(self.job_id)
-        return_ids = [ObjectID.for_task_return(task_id, i) for i in range(num_returns)]
+        streaming = num_returns == "streaming"
+        if streaming and self.actor_runtime(actor_id).executor == "process":
+            raise ValueError(
+                'num_returns="streaming" requires a thread-executor actor: a '
+                "process worker returns one pickled result, not a live stream"
+            )
+        n_static = 0 if streaming else num_returns
+        return_ids = [ObjectID.for_task_return(task_id, i) for i in range(n_static)]
         for oid in return_ids:
             self.object_store.create(oid)
         call = ActorMethodCall(
@@ -292,9 +318,13 @@ class Runtime:
             args=self._materialize_args(args),
             kwargs=self._materialize_kwargs(kwargs),
             return_ids=return_ids,
-            num_returns=num_returns,
+            num_returns=n_static,
+            streaming=streaming,
+            stream=ObjectRefGenerator(task_id, self) if streaming else None,
         )
         self.actor_runtime(actor_id).submit(call)
+        if streaming:
+            return call.stream
         refs = [ObjectRef(oid, self) for oid in return_ids]
         return refs[0] if num_returns == 1 else refs
 

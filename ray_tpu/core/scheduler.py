@@ -91,6 +91,11 @@ class TaskSpec:
     # "thread" (default: in-process, zero-copy object passing) or "process"
     # (pooled OS worker process — GIL-free CPU work; see worker_pool.py)
     executor: str = "thread"
+    # streaming generator task (num_returns="streaming"): yielded values
+    # seal into dynamic return ids and flow through `stream`
+    # (reference: ObjectRefStream, core_worker.h:273)
+    streaming: bool = False
+    stream: Any = None  # ObjectRefGenerator (producer half)
     # internal
     attempt: int = 0
     cancelled: bool = False
@@ -581,6 +586,9 @@ class ClusterScheduler:
         self._wake.set()
 
     def _seal_returns(self, spec: TaskSpec, result: Any) -> None:
+        if spec.streaming:
+            self._seal_streaming(spec, result)
+            return
         if spec.num_returns == 1:
             self._store.seal(spec.return_ids[0], result)
         else:
@@ -593,7 +601,43 @@ class ClusterScheduler:
             for oid, value in zip(spec.return_ids, values):
                 self._store.seal(oid, value)
 
+    def _seal_streaming(self, spec: TaskSpec, result: Any) -> None:
+        """Drain a generator task: each yield seals into its own dynamic
+        return id (task_id ⊕ index) and is handed to the consumer stream
+        immediately. Yield indices are deterministic, so a retry or a
+        lineage reconstruction re-seals the same ids; indices the stream
+        already delivered are not re-appended."""
+        if not hasattr(result, "__iter__"):
+            raise TypeError(
+                f"streaming task {spec.name} must return an iterable/generator, "
+                f"got {type(result).__name__}"
+            )
+        stream = spec.stream
+        already = stream._appended if stream is not None else 0
+        for idx, item in enumerate(result):
+            oid = ObjectID.for_task_return(spec.task_id, idx)
+            self._store.create(oid, owner_task=spec)
+            self._store.seal(oid, item)
+            if oid not in spec.return_ids:
+                spec.return_ids.append(oid)  # lineage: reconstruct flips these
+            if stream is not None and idx >= already:
+                stream._append_oid(oid)
+        if stream is not None:
+            stream._finish()
+
     def _fail_returns(self, spec: TaskSpec, error: BaseException) -> None:
+        if spec.streaming:
+            # Never clobber successfully yielded values; only slots a
+            # reconstruction flipped back to PENDING must error out (or a
+            # getter would hang forever). The consumer sees the error from
+            # the stream itself, after the last good item.
+            for oid in spec.return_ids:
+                entry = self._store.entry(oid)
+                if entry is not None and not entry.event.is_set():
+                    self._store.seal_error(oid, error)
+            if spec.stream is not None:
+                spec.stream._finish(error)
+            return
         for oid in spec.return_ids:
             self._store.seal_error(oid, error)
 

@@ -1,0 +1,115 @@
+"""Streaming generators: tasks/actor methods that yield a stream of objects.
+
+Reference parity: ``num_returns="streaming"`` tasks return an
+``ObjectRefStream`` the consumer iterates while the producer is still
+running (/root/reference/src/ray/core_worker/core_worker.h:273
+TryReadObjectRefStream, task_manager.h:67 ObjectRefStream, and
+AllocateDynamicReturnId core_worker.h:1105). Each yielded value is sealed
+into its own dynamically-derived ObjectID (task_id ⊕ yield-index) the
+moment it is produced, so consumers overlap with producers — the substrate
+for Serve streaming responses and Data block streaming.
+
+TPU inversion: no cross-process stream replication protocol — the stream
+is an in-process handoff queue of ObjectRefs; the *values* live in the
+ordinary tiered object store with full lineage (a lost item re-executes
+the generator task, which re-seals every yield index deterministically).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, List, Optional
+
+
+class ObjectRefGenerator:
+    """Iterator over the ObjectRefs of a streaming task.
+
+    Consumer side: ``for ref in gen: value = ray_tpu.get(ref)`` — blocks
+    until the next item is yielded or the stream finishes. A mid-stream
+    producer error is raised from ``__next__`` after all successfully
+    yielded items have been consumed.
+
+    Producer side (scheduler / actor executor threads) appends sealed
+    object ids via `_append_oid` and closes with `_finish`. `_appended`
+    counts items already delivered; a retry of the producer task skips
+    re-appending those indices (values re-seal idempotently).
+    """
+
+    def __init__(self, task_id, runtime):
+        self._task_id = task_id
+        self._runtime = runtime
+        self._cond = threading.Condition()
+        self._refs: List[Any] = []
+        self._read = 0
+        self._done = False
+        self._error: Optional[BaseException] = None
+
+    # ---------------------------------------------------------------- producer
+
+    @property
+    def _appended(self) -> int:
+        with self._cond:
+            return len(self._refs)
+
+    def _append_oid(self, object_id) -> None:
+        from .runtime import ObjectRef
+
+        ref = ObjectRef(object_id, self._runtime)
+        with self._cond:
+            self._refs.append(ref)
+            self._cond.notify_all()
+
+    def _finish(self, error: Optional[BaseException] = None) -> None:
+        with self._cond:
+            if self._done:
+                return
+            self._done = True
+            self._error = error
+            self._cond.notify_all()
+
+    # ---------------------------------------------------------------- consumer
+
+    def __iter__(self) -> "ObjectRefGenerator":
+        return self
+
+    def __next__(self):
+        return self.next_ready(timeout=None)
+
+    def next_ready(self, timeout: Optional[float] = None):
+        """Next ObjectRef; raises StopIteration at end-of-stream, the
+        producer's error after the last good item, or GetTimeoutError."""
+        from .exceptions import GetTimeoutError
+
+        with self._cond:
+            while self._read >= len(self._refs) and not self._done:
+                if not self._cond.wait(timeout):
+                    raise GetTimeoutError(
+                        f"no stream item within {timeout}s (got {self._read})"
+                    )
+            if self._read < len(self._refs):
+                ref = self._refs[self._read]
+                # Drop our copy of the handed-out ref: the stream must not
+                # pin every streamed value for its whole lifetime (the
+                # reference's ObjectRefStream likewise consumes items on
+                # TryReadObjectRefStream). The consumer now owns the ref.
+                self._refs[self._read] = None
+                self._read += 1
+                return ref
+            if self._error is not None:
+                raise self._error
+            raise StopIteration
+
+    def completed(self) -> bool:
+        with self._cond:
+            return self._done
+
+    def total_yielded(self) -> int:
+        with self._cond:
+            return len(self._refs)
+
+    def __repr__(self):
+        with self._cond:
+            return (
+                f"ObjectRefGenerator(task={self._task_id.hex()[:12]}, "
+                f"yielded={len(self._refs)}, done={self._done})"
+            )

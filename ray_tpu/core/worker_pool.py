@@ -36,8 +36,6 @@ from .exceptions import TaskError
 
 logger = logging.getLogger(__name__)
 
-_IDLE_REAP_S = 60.0
-
 
 class WorkerCrashedError(TaskError):
     """The worker process died mid-task (killed, OOM, segfault)."""
@@ -241,7 +239,12 @@ class ProcessWorkerPool:
     timeout are shut down by the next acquire/release."""
 
     def __init__(self, max_workers: Optional[int] = None):
-        self.max_workers = max_workers or max(2, (os.cpu_count() or 4))
+        from .config import cfg
+
+        self.max_workers = max_workers or (
+            cfg.max_process_workers or max(2, os.cpu_count() or 4)
+        )
+        self._idle_reap_s = cfg.worker_idle_timeout_s
         self._idle: List[WorkerProcess] = []
         self._busy: List[WorkerProcess] = []
         self._spawning = 0  # slots reserved for in-flight spawns
@@ -315,7 +318,7 @@ class ProcessWorkerPool:
         now = time.monotonic()
         keep = []
         for w in self._idle:
-            if not w.alive() or now - w.last_used > _IDLE_REAP_S:
+            if not w.alive() or now - w.last_used > self._idle_reap_s:
                 self._kill_async(w)
                 self.stats["reaped"] += 1
             else:
