@@ -1,0 +1,89 @@
+"""Serve benchmark: continuous-batched LLM inference req/s + p50 TTFT.
+
+The BASELINE.md north-star for serving ("req/s and p50 TTFT for
+continuous-batched LLM inference on TPU"). Workload: a closed burst of
+GPT-2-124M requests (192-token prompts, 48 generated tokens each) against
+the paged continuous-batching engine (paged KV pool + chunked prefill,
+ray_tpu/serve/llm/paged_engine.py).
+
+Prints ONE JSON line. vs_baseline is target_p50_ttft / measured_p50_ttft
+with a 0.5 s target under full 8-way slot contention — TTFT is the
+latency metric continuous batching exists to protect, and 0.5 s is
+interactive-serving territory for a burst 4x deeper than the slot count.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import numpy as np
+
+N_REQUESTS = 32
+PROMPT_LEN = 192
+MAX_TOKENS = 48
+TTFT_TARGET_S = 0.5
+
+
+def main() -> None:
+    from ray_tpu.models import get_config, init_params
+    from ray_tpu.serve.llm.paged import PagedConfig
+    from ray_tpu.serve.llm.paged_engine import PagedEngineConfig, PagedLLMEngine
+
+    config = get_config("gpt2-small")
+    params = init_params(config, jax.random.PRNGKey(0))
+    engine = PagedLLMEngine(
+        config,
+        params,
+        PagedEngineConfig(
+            max_slots=8,
+            decode_block_steps=24,
+            paged=PagedConfig(
+                page_size=64, num_pages=512, max_pages_per_slot=8, chunk_pages=4
+            ),
+        ),
+    )
+    rng = np.random.default_rng(0)
+
+    def prompt():
+        return [int(t) for t in rng.integers(1, config.vocab_size, size=PROMPT_LEN)]
+
+    try:
+        # warmup: trigger every compile (chunk prefill, decode, sample)
+        engine.generate(prompt(), max_tokens=4)
+
+        streams = []
+        t0 = time.perf_counter()
+        for _ in range(N_REQUESTS):
+            streams.append(engine.submit(prompt(), max_tokens=MAX_TOKENS))
+        outs = [s.result(timeout=600) for s in streams]
+        elapsed = time.perf_counter() - t0
+
+        assert all(len(o) == MAX_TOKENS for o in outs), "short generation"
+        ttfts = sorted(s.ttft_s for s in streams)
+        p50 = ttfts[len(ttfts) // 2]
+        p95 = ttfts[int(len(ttfts) * 0.95)]
+        decode_tps = N_REQUESTS * MAX_TOKENS / elapsed
+        print(
+            json.dumps(
+                {
+                    "metric": "gpt2_124m_serve_req_per_s",
+                    "value": round(N_REQUESTS / elapsed, 2),
+                    "unit": "req/s",
+                    "vs_baseline": round(TTFT_TARGET_S / p50, 3),
+                    "p50_ttft_s": round(p50, 4),
+                    "p95_ttft_s": round(p95, 4),
+                    "decode_tokens_per_s": round(decode_tps, 1),
+                    "device_kind": getattr(
+                        jax.devices()[0], "device_kind", "unknown"
+                    ),
+                }
+            )
+        )
+    finally:
+        engine.shutdown()
+
+
+if __name__ == "__main__":
+    main()

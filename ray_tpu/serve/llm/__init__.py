@@ -1,4 +1,6 @@
 """ray_tpu.serve.llm — continuous-batched LLM inference on TPU."""
 
 from .engine import EngineConfig, LLMEngine, ResponseStream  # noqa: F401
+from .paged import PagedConfig, PageAllocator  # noqa: F401
+from .paged_engine import PagedEngineConfig, PagedLLMEngine  # noqa: F401
 from .server import LLMServer, build_llm_app  # noqa: F401

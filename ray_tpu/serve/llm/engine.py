@@ -76,6 +76,8 @@ class ResponseStream:
             token = self._request.out.get()
             if token is None:
                 return
+            if isinstance(token, BaseException):
+                raise token
             yield token
 
     def result(self, timeout: Optional[float] = None) -> List[int]:
@@ -86,6 +88,8 @@ class ResponseStream:
             token = self._request.out.get(timeout=remaining)
             if token is None:
                 return tokens
+            if isinstance(token, BaseException):
+                raise token
             tokens.append(token)
 
     @property
@@ -175,6 +179,7 @@ class LLMEngine:
             out=queue.Queue(),
         )
         self._queue.put(request)
+        _reject_if_dead(self, request)
         self._wake.set()
         return ResponseStream(request)
 
@@ -273,12 +278,47 @@ class LLMEngine:
                 self._finish(slot)
 
     def _loop(self) -> None:
-        while not self._stop.is_set():
-            self._admit()
-            n_active = sum(1 for s in self.slots if not s.free)
-            self.metrics["ongoing"] = float(n_active) + self._queue.qsize()
-            if n_active == 0:
-                self._wake.wait(timeout=0.05)
-                self._wake.clear()
-                continue
-            self._decode_round()
+        # The loop thread is the engine: if it dies, every pending stream
+        # hangs forever. Fail them all with the cause instead.
+        try:
+            while not self._stop.is_set():
+                self._admit()
+                n_active = sum(1 for s in self.slots if not s.free)
+                self.metrics["ongoing"] = float(n_active) + self._queue.qsize()
+                if n_active == 0:
+                    self._wake.wait(timeout=0.05)
+                    self._wake.clear()
+                    continue
+                self._decode_round()
+        except BaseException as exc:  # noqa: BLE001 - engine death boundary
+            self._death_cause = exc
+            _fail_all_requests(self.slots, self._queue, exc)
+            raise
+
+
+def _fail_all_requests(slots, request_queue, exc: BaseException) -> None:
+    """Engine-death path: surface `exc` on every active and queued stream."""
+    for slot in slots:
+        if slot.request is not None:
+            slot.request.out.put(exc)
+            slot.request = None
+    while True:
+        try:
+            request_queue.get_nowait().out.put(exc)
+        except queue.Empty:
+            return
+
+
+def _reject_if_dead(engine, request: "_Request") -> None:
+    """Close the submit-vs-death race: the death path sets _death_cause
+    BEFORE draining the queue, so a submit that enqueued after the final
+    drain is guaranteed to observe _death_cause here and fail its own
+    request instead of waiting on a loop that will never run."""
+    cause = getattr(engine, "_death_cause", None)
+    if cause is not None:
+        while True:
+            try:
+                engine._queue.get_nowait().out.put(cause)
+            except queue.Empty:
+                break
+        raise RuntimeError("LLM engine is dead") from cause

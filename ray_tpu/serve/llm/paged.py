@@ -130,8 +130,15 @@ def _gather_ref_attention(q, k_cache, v_cache, block_tables, lengths):
 
 
 def paged_attention(q, k_cache, v_cache, block_tables, lengths, *, page_size: int):
-    """Dispatch: Pallas paged kernel on TPU, gather reference elsewhere."""
-    if jax.default_backend() == "tpu":
+    """Dispatch: Pallas paged kernel on TPU, gather reference elsewhere.
+
+    The Mosaic lowering requires the trailing block dims be (8, 128)-
+    divisible, so the kernel is only eligible for head_dim % 128 == 0 and
+    page_size % 8 == 0 (e.g. Llama-class models); smaller shapes (tiny
+    test configs, GPT-2's 64-dim heads) take the gather reference, which
+    XLA fuses well at those sizes anyway."""
+    head_dim = q.shape[-1]
+    if jax.default_backend() == "tpu" and head_dim % 128 == 0 and page_size % 8 == 0:
         from jax.experimental.pallas.ops.tpu.paged_attention import (
             paged_attention as _kernel,
         )

@@ -1,0 +1,614 @@
+"""Paged continuous-batching engine: vLLM-class serving, TPU-native.
+
+Reference parity: the vLLM engine the reference rides
+(/root/reference/python/ray/llm/_internal/serve/deployments/llm/vllm/
+vllm_engine.py:254 — paged KV, chunked prefill, continuous batching).
+TPU inversion (the ragged-paged-attention recipe from PAPERS.md):
+
+- HBM holds one fixed PAGE POOL shared by all slots (paged.py); a slot's
+  KV occupancy scales with its actual tokens, not max_seq — like vLLM,
+  unlike the dense engine's (L, max_slots, H, max_seq, D) grid.
+- Prefill is CHUNKED and interleaved: each engine tick runs at most one
+  prompt chunk plus one decode block, so a long prompt delays running
+  streams by one chunk's latency, never by its full length.
+- Decode runs in BLOCKS of K fused decode+sample steps per device call
+  (lax.scan), with sampled tokens staying ON DEVICE between blocks and
+  results fetched through an async pipeline one block deep. The host
+  never blocks on a device read in the dispatch path — essential both on
+  real TPU (host reads stall the device pipeline) and on tunneled chips
+  (a synchronous read costs a full network round trip per token).
+- Backpressure is physical: admission, prefill growth, and the K-step
+  lookahead all wait on the page allocator; finished slots return pages.
+
+Retirement (EOS / budget) is detected at emission, up to one block after
+the fact; blocks already in flight for a retired slot write only into
+pages that are either still owned or provably overwritten before they
+become visible (pages fill strictly forward from row 0 and attention
+masks rows beyond a slot's length), so late retirement never corrupts a
+neighbor.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import queue
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...models.transformer import TransformerConfig
+from .engine import ResponseStream, _Request, _fail_all_requests, _reject_if_dead
+from .paged import (
+    PagedConfig,
+    PageAllocator,
+    chunk_prefill_step,
+    init_paged_cache,
+    paged_decode_step,
+)
+
+
+@dataclasses.dataclass
+class PagedEngineConfig:
+    max_slots: int = 8
+    eos_id: int = -1
+    decode_block_steps: int = 16  # K: fused decode+sample steps per dispatch
+    max_inflight_blocks: int = 8  # device blocks outstanding before gating
+    paged: PagedConfig = dataclasses.field(default_factory=PagedConfig)
+
+
+@dataclasses.dataclass
+class _PagedSlot:
+    request: Optional[_Request] = None
+    pages: List[int] = dataclasses.field(default_factory=list)
+    position: int = 0          # next KV write index at DISPATCH time
+    prefill_offset: int = 0    # prompt tokens already ingested
+    stalled: bool = False      # waiting on a page
+    # dispatch-side generation bookkeeping
+    dispatch_remaining: int = 0
+    done_dispatching: bool = False
+    blocks_in_flight: int = 0
+    awaiting_first: bool = False  # first token rides the next block's row 0
+    # emission-side bookkeeping
+    emit_remaining: int = 0
+    finished_emit: bool = False
+
+    @property
+    def free(self) -> bool:
+        return self.request is None
+
+    @property
+    def prefilling(self) -> bool:
+        return (
+            self.request is not None
+            and self.prefill_offset < len(self.request.prompt)
+        )
+
+    @property
+    def decodable(self) -> bool:
+        return (
+            self.request is not None
+            and not self.prefilling
+            and not self.done_dispatching
+            and self.dispatch_remaining > 0
+        )
+
+
+class PagedLLMEngine:
+    """Continuous batching over a paged KV pool with chunked prefill and
+    pipelined block decoding."""
+
+    def __init__(
+        self,
+        model_config: TransformerConfig,
+        params: Any,
+        engine_config: Optional[PagedEngineConfig] = None,
+    ):
+        self.model_config = model_config
+        self.params = params
+        self.config = engine_config or PagedEngineConfig()
+        pc = self.config.paged
+        if pc.max_pages_per_slot % pc.chunk_pages:
+            raise ValueError(
+                f"max_pages_per_slot ({pc.max_pages_per_slot}) must be a "
+                f"multiple of chunk_pages ({pc.chunk_pages}): prefill grows "
+                "page tables chunk-aligned"
+            )
+        if pc.chunk_pages > pc.num_pages - 1:
+            raise ValueError(
+                f"chunk_pages ({pc.chunk_pages}) exceeds the pool "
+                f"({pc.num_pages - 1} allocatable pages)"
+            )
+        self.paged = pc
+        self.cache = init_paged_cache(model_config, pc)
+        self.allocator = PageAllocator(pc.num_pages)
+        self.slots = [_PagedSlot() for _ in range(self.config.max_slots)]
+        self.block_tables = np.zeros(
+            (self.config.max_slots, pc.max_pages_per_slot), dtype=np.int32
+        )
+        self._queue: "queue.Queue[_Request]" = queue.Queue()
+        self._rid = itertools.count()
+        self._stop = threading.Event()
+        self._wake = threading.Event()
+        # Device→host results flow through a dedicated DRAIN THREAD: on
+        # tunneled TPUs a host read costs a full network round trip that
+        # copy_to_host_async does not hide, so the blocking np.asarray
+        # must never run on the dispatch thread. Entries:
+        #   ("first", (slot, request), (1,) arr)
+        #   ("block", [(slot, request), ...], (K, B) arr)
+        self._fetchq: "queue.Queue[Optional[Tuple[str, Any, jax.Array]]]" = queue.Queue()
+        self._doneq: "queue.Queue[Tuple[str, Any, Any]]" = queue.Queue()
+        self._inflight = 0  # fetch entries not yet emitted
+        self.drain_log: List[Tuple[int, float]] = []  # (batch_size, seconds)
+
+        mc = model_config
+        ps = pc.page_size
+        K = self.config.decode_block_steps
+
+        def _sample_logits(logits, key, temps):
+            greedy = jnp.argmax(logits, axis=-1)
+            scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+            sampled = jax.random.categorical(key, scaled, axis=-1)
+            return jnp.where(temps <= 0.0, greedy, sampled).astype(jnp.int32)
+
+        def _decode_block(params, cache, block_tables, tokens, positions, key, temps):
+            """K fused decode+sample steps; tokens never leave the device.
+            Output row 0 is the INPUT token vector — a freshly prefilled
+            lane's first sampled token rides along with its first block,
+            so it never needs a fetch of its own (every materialization
+            costs a full round trip on tunneled TPUs)."""
+
+            def body(carry, _):
+                cache, toks_c, pos_c, key_c = carry
+                logits, cache = paged_decode_step(
+                    params, cache, block_tables, toks_c, pos_c, mc,
+                    page_size=ps,
+                )
+                key_c, sub = jax.random.split(key_c)
+                nxt = _sample_logits(logits, sub, temps)
+                return (cache, nxt, pos_c + 1, key_c), nxt
+
+            (cache, final, _, _), toks = jax.lax.scan(
+                body, (cache, tokens, positions, key), None, length=K
+            )
+            toks = jnp.concatenate([tokens[None], toks], axis=0)  # (K+1, B)
+            return toks, final, cache
+
+        def _chunk(params, cache, page_row, chunk_page_ids, tokens, offset, total):
+            return chunk_prefill_step(
+                params, cache, page_row, chunk_page_ids, tokens, offset, total,
+                mc, page_size=ps,
+            )
+
+        def _set_token(tokens, idx, value):
+            return tokens.at[idx].set(value[0])
+
+        self._decode_block = jax.jit(_decode_block, donate_argnums=(1,))
+        self._chunk = jax.jit(_chunk, donate_argnums=(1,))
+        self._sample = jax.jit(_sample_logits)
+        self._set_token = jax.jit(_set_token, donate_argnums=(0,))
+        self._tokens_dev = jnp.zeros((self.config.max_slots,), jnp.int32)
+        self._key = jax.random.PRNGKey(0)
+        self.metrics: Dict[str, float] = {
+            "generated_tokens": 0.0,
+            "decode_steps": 0.0,
+            "decode_blocks": 0.0,
+            "prefill_chunks": 0.0,
+            "ongoing": 0.0,
+            "page_stalls": 0.0,
+            "pages_in_use": 0.0,
+        }
+        self._drainer = threading.Thread(
+            target=self._drain_worker, daemon=True, name="paged-llm-drain"
+        )
+        self._drainer.start()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="paged-llm-engine"
+        )
+        self._thread.start()
+
+    # ------------------------------------------------------------------- API
+
+    def submit(
+        self,
+        prompt_tokens: List[int],
+        max_tokens: int = 64,
+        temperature: float = 0.0,
+    ) -> ResponseStream:
+        limit = self.paged.max_slot_tokens
+        if len(prompt_tokens) + max_tokens > limit:
+            raise ValueError(
+                f"prompt({len(prompt_tokens)}) + max_tokens({max_tokens}) "
+                f"exceeds per-slot page capacity {limit}"
+            )
+        if not prompt_tokens:
+            raise ValueError("empty prompt")
+        request = _Request(
+            rid=next(self._rid),
+            prompt=list(prompt_tokens),
+            max_tokens=max_tokens,
+            temperature=temperature,
+            out=queue.Queue(),
+        )
+        self._queue.put(request)
+        _reject_if_dead(self, request)
+        self._wake.set()
+        return ResponseStream(request)
+
+    def generate(
+        self, prompt_tokens: List[int], max_tokens: int = 64, temperature: float = 0.0
+    ) -> List[int]:
+        return self.submit(prompt_tokens, max_tokens, temperature).result()
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        self._fetchq.put(None)
+        self._thread.join(timeout=10)
+        self._drainer.join(timeout=10)
+
+    # ------------------------------------------------------------- admission
+
+    def _admit(self) -> None:
+        for idx, slot in enumerate(self.slots):
+            if not slot.free or self._queue.empty():
+                continue
+            pages = self.allocator.alloc(self.paged.chunk_pages)
+            if pages is None:
+                self.metrics["page_stalls"] += 1
+                return
+            try:
+                request = self._queue.get_nowait()
+            except queue.Empty:
+                self.allocator.free(pages)
+                return
+            slot.request = request
+            slot.pages = pages
+            slot.position = 0
+            slot.prefill_offset = 0
+            slot.stalled = False
+            slot.dispatch_remaining = 0
+            slot.done_dispatching = False
+            slot.blocks_in_flight = 0
+            slot.awaiting_first = False
+            slot.emit_remaining = request.max_tokens
+            slot.finished_emit = False
+            self.block_tables[idx, :] = 0
+            self.block_tables[idx, : len(pages)] = pages
+
+    # --------------------------------------------------------------- prefill
+
+    def _prefill_tick(self) -> bool:
+        """Ingest ONE chunk of ONE prefilling slot per engine tick; the
+        final chunk samples the first token on device and queues its
+        emission. Returns True if a chunk ran."""
+        for idx, slot in enumerate(self.slots):
+            if not slot.prefilling:
+                continue
+            request = slot.request
+            prompt = request.prompt
+            ct = self.paged.chunk_tokens
+            offset = slot.prefill_offset
+            n_real = min(ct, len(prompt) - offset)
+            first_page = offset // self.paged.page_size
+            need = first_page + self.paged.chunk_pages - len(slot.pages)
+            if need > 0:
+                extra = self.allocator.alloc(need)
+                if extra is None:
+                    slot.stalled = True
+                    self.metrics["page_stalls"] += 1
+                    continue
+                slot.pages.extend(extra)
+                self.block_tables[idx, : len(slot.pages)] = slot.pages
+            slot.stalled = False
+            chunk = np.zeros((1, ct), dtype=np.int32)
+            chunk[0, :n_real] = prompt[offset : offset + n_real]
+            chunk_page_ids = np.asarray(
+                slot.pages[first_page : first_page + self.paged.chunk_pages],
+                dtype=np.int32,
+            )
+            total = offset + n_real
+            logits, self.cache = self._chunk(
+                self.params,
+                self.cache,
+                jnp.asarray(self.block_tables[idx]),
+                jnp.asarray(chunk_page_ids),
+                jnp.asarray(chunk),
+                jnp.asarray(offset, dtype=jnp.int32),
+                jnp.asarray(total, dtype=jnp.int32),
+            )
+            slot.prefill_offset = total
+            slot.position = total
+            self.metrics["prefill_chunks"] += 1
+            if not slot.prefilling:
+                # final chunk: sample the first generated token ON DEVICE,
+                # thread it into the decode token vector, and queue an
+                # async fetch for emission — no host read here.
+                self._key, sub = jax.random.split(self._key)
+                temps = jnp.asarray([request.temperature], dtype=jnp.float32)
+                first_dev = self._sample(logits, sub, temps)
+                self._tokens_dev = self._set_token(
+                    self._tokens_dev, idx, first_dev
+                )
+                slot.dispatch_remaining = request.max_tokens - 1
+                if slot.dispatch_remaining <= 0:
+                    # no decode block will ever carry this lane's first
+                    # token: fetch it directly (rare max_tokens=1 path)
+                    slot.done_dispatching = True
+                    _async_fetch(first_dev)
+                    self._inflight += 1
+                    self._fetchq.put(("first", (idx, request), first_dev))
+                else:
+                    slot.awaiting_first = True
+            return True
+        return False
+
+    # ---------------------------------------------------------------- decode
+
+    def _dispatch_decode_block(self) -> bool:
+        """Launch one K-step fused decode+sample block for every decodable
+        lane. No host reads: results drain later via _drain()."""
+        K = self.config.decode_block_steps
+        ps = self.paged.page_size
+        cap = self.paged.max_slot_tokens
+        bt = np.zeros_like(self.block_tables)  # inactive lanes → scratch
+        positions = np.zeros(len(self.slots), dtype=np.int32)
+        temps = np.zeros(len(self.slots), dtype=np.float32)
+        lanes: List[Tuple[int, _Request]] = []
+        useful_steps: Dict[int, int] = {}
+        for i, slot in enumerate(self.slots):
+            if not slot.decodable:
+                continue
+            # Only the USEFUL steps of a lane's final block need real
+            # pages; overshoot steps (budget < K) write to unmapped block
+            # table entries, i.e. the scratch page, and their sampled
+            # tokens are dropped at emission.
+            useful = min(K, slot.dispatch_remaining)
+            if slot.position + useful > cap:
+                # cannot fit the remaining budget before page capacity:
+                # stop here and let emission retire the stream (possibly
+                # short of max_tokens when budget brushes capacity)
+                slot.done_dispatching = True
+                continue
+            pages_needed = (slot.position + useful - 1) // ps + 1
+            if pages_needed > len(slot.pages):
+                extra = self.allocator.alloc(pages_needed - len(slot.pages))
+                if extra is None:
+                    if not slot.stalled:
+                        slot.stalled = True
+                        self.metrics["page_stalls"] += 1
+                    continue
+                slot.pages.extend(extra)
+                self.block_tables[i, : len(slot.pages)] = slot.pages
+            slot.stalled = False
+            bt[i] = self.block_tables[i]
+            positions[i] = slot.position
+            temps[i] = slot.request.temperature
+            useful_steps[i] = useful
+            lanes.append((i, slot.request, slot.awaiting_first))
+            slot.awaiting_first = False
+        if not lanes:
+            return False
+        self._key, sub = jax.random.split(self._key)
+        toks, self._tokens_dev, self.cache = self._decode_block(
+            self.params,
+            self.cache,
+            jnp.asarray(bt),
+            self._tokens_dev,
+            jnp.asarray(positions),
+            sub,
+            jnp.asarray(temps),
+        )
+        _async_fetch(toks)
+        for i, _, _ in lanes:
+            slot = self.slots[i]
+            slot.position += useful_steps[i]
+            slot.dispatch_remaining -= K
+            slot.blocks_in_flight += 1
+            if slot.dispatch_remaining <= 0:
+                slot.done_dispatching = True
+        self._inflight += 1
+        self._fetchq.put(("block", lanes, toks))
+        self.metrics["decode_blocks"] += 1
+        self.metrics["decode_steps"] += K
+        return True
+
+    # -------------------------------------------------------------- emission
+
+    def _drain_worker(self) -> None:
+        """Dedicated thread that pays the device→host read latency.
+        Everything queued is fetched in ONE jax.device_get batch — on a
+        tunneled TPU each separate read costs a full network round trip,
+        but N batched reads cost one, so backlog amortizes instead of
+        serializing. FIFO order is preserved (a request's first token is
+        enqueued before any of its decode blocks)."""
+        while True:
+            item = self._fetchq.get()
+            if item is None:
+                return
+            batch = [item]
+            while True:
+                try:
+                    nxt = self._fetchq.get_nowait()
+                except queue.Empty:
+                    break
+                if nxt is None:
+                    self._fetchq.put(None)  # re-post shutdown sentinel
+                    break
+                batch.append(nxt)
+            # One fetch thread per entry: transfers overlap across threads
+            # (a single device_get over pending computations serializes —
+            # wait-compute then fetch, per array, each paying the RTT).
+            all_vals: List[Any] = [None] * len(batch)
+            errors: List[BaseException] = []
+
+            def fetch(i: int, arr) -> None:
+                try:
+                    all_vals[i] = np.asarray(arr)
+                except BaseException as exc:  # noqa: BLE001 - device boundary
+                    errors.append(exc)
+
+            t0 = time.perf_counter()
+            threads = [
+                threading.Thread(target=fetch, args=(i, b[2]), daemon=True)
+                for i, b in enumerate(batch)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            self.drain_log.append((len(batch), time.perf_counter() - t0))
+            if len(self.drain_log) > 1000:
+                del self.drain_log[:500]
+            if errors:
+                self._doneq.put(("error", errors[0], None))
+                return
+            for (kind, meta, _), vals in zip(batch, all_vals):
+                self._doneq.put((kind, meta, vals))
+
+    def _pump_completed(self, wait: bool = False) -> bool:
+        """Emit every completed fetch. wait=True blocks briefly for one
+        (used when nothing is dispatchable, so the loop makes progress)."""
+        drained = False
+        while True:
+            try:
+                timeout = 0.05 if (wait and not drained) else None
+                entry = (
+                    self._doneq.get(timeout=timeout)
+                    if timeout is not None
+                    else self._doneq.get_nowait()
+                )
+            except queue.Empty:
+                return drained
+            kind, meta, vals = entry
+            if kind == "error":
+                raise meta
+            self._inflight -= 1
+            drained = True
+            if kind == "first":
+                idx, request = meta
+                self._emit(idx, request, int(vals[0]), first=True)
+                self._maybe_retire(idx, request)
+            else:
+                # vals is (K+1, B): row 0 = the block's input tokens —
+                # emitted only for lanes whose first token rides this block
+                for k in range(vals.shape[0]):
+                    for idx, request, fresh in meta:
+                        if k == 0 and not fresh:
+                            continue
+                        self._emit(idx, request, int(vals[k, idx]), first=(k == 0))
+                for idx, request, _ in meta:
+                    slot = self.slots[idx]
+                    if slot.request is request:
+                        slot.blocks_in_flight -= 1
+                    self._maybe_retire(idx, request)
+
+    def _emit(self, idx: int, request: _Request, token: int, first: bool = False) -> None:
+        slot = self.slots[idx]
+        if slot.request is not request or slot.finished_emit:
+            return  # stale block for an already-retired stream
+        if first and request.first_token_at is None:
+            request.first_token_at = time.perf_counter()
+        request.out.put(token)
+        slot.emit_remaining -= 1
+        self.metrics["generated_tokens"] += 1
+        if token == self.config.eos_id or slot.emit_remaining <= 0:
+            slot.finished_emit = True
+
+    def _maybe_retire(self, idx: int, request: _Request) -> None:
+        slot = self.slots[idx]
+        if slot.request is not request:
+            return
+        if slot.finished_emit or (
+            slot.done_dispatching and slot.blocks_in_flight == 0
+        ):
+            self._finish(idx, slot)
+
+    def _finish(self, idx: int, slot: _PagedSlot) -> None:
+        if slot.request is not None:
+            slot.request.out.put(None)
+        self.allocator.free(slot.pages)
+        slot.pages = []
+        slot.request = None
+        slot.stalled = False
+        slot.dispatch_remaining = 0
+        slot.blocks_in_flight = 0
+        slot.finished_emit = False
+        self.block_tables[idx, :] = 0
+
+    # ------------------------------------------------------------------ loop
+
+    def _all_stalled_deadlock(self) -> Optional[int]:
+        """Every occupied slot waits on an empty pool and nothing is in
+        flight: truncate the largest page-holder rather than deadlock."""
+        occupied = [(i, s) for i, s in enumerate(self.slots) if not s.free]
+        if not occupied or self._inflight:
+            return None
+        if all(s.stalled or s.prefilling for _, s in occupied) and (
+            self.allocator.available == 0
+        ):
+            return max(occupied, key=lambda t: len(t[1].pages))[0]
+        return None
+
+    def _loop(self) -> None:
+        try:
+            self._loop_inner()
+        except BaseException as exc:  # noqa: BLE001 - engine death boundary
+            self._death_cause = exc
+            _fail_all_requests(self.slots, self._queue, exc)
+            raise
+
+    def _loop_inner(self) -> None:
+        pc = self.paged
+        while not self._stop.is_set():
+            self._admit()
+            progressed = self._prefill_tick()
+            # Prefer draining the prefill backlog before launching a decode
+            # block: chunks are sub-millisecond, and grouping admissions
+            # into ONE joint block minimizes fetch round trips (each block
+            # materialization costs a full RTT on tunneled TPUs).
+            if not progressed and self._inflight < self.config.max_inflight_blocks:
+                progressed |= self._dispatch_decode_block()
+            dispatchable = any(
+                s.decodable or s.prefilling for s in self.slots
+            )
+            gated = self._inflight >= self.config.max_inflight_blocks
+            progressed |= self._pump_completed(
+                wait=self._inflight > 0 and (gated or not dispatchable)
+            )
+            # Safety sweep: a lane can become retirable outside any pending
+            # block (e.g. the capacity gate fired with nothing in flight).
+            for i, slot in enumerate(self.slots):
+                if slot.request is not None and not slot.prefilling:
+                    self._maybe_retire(i, slot.request)
+            occupied = sum(1 for s in self.slots if not s.free)
+            self.metrics["ongoing"] = occupied + self._queue.qsize()
+            self.metrics["pages_in_use"] = float(
+                pc.num_pages - 1 - self.allocator.available
+            )
+            if occupied == 0 and not self._inflight:
+                self._wake.wait(timeout=0.02)
+                self._wake.clear()
+                continue
+            if not progressed:
+                victim = self._all_stalled_deadlock()
+                if victim is not None:
+                    self._finish(victim, self.slots[victim])
+                else:
+                    time.sleep(0.001)
+
+
+def _async_fetch(arr: jax.Array) -> None:
+    """Start the device→host transfer without blocking (falls back to a
+    no-op where the runtime lacks copy_to_host_async; np.asarray later
+    then pays the full read)."""
+    start = getattr(arr, "copy_to_host_async", None)
+    if start is not None:
+        try:
+            start()
+        except Exception:
+            pass

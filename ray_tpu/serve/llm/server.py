@@ -17,23 +17,31 @@ from ...models import get_config, init_params
 from ...models.transformer import TransformerConfig
 from ..deployment import Application, deployment
 from .engine import EngineConfig, LLMEngine
+from .paged_engine import PagedEngineConfig, PagedLLMEngine
 
 
 class LLMServer:
-    """Deployment class hosting one engine (one model replica)."""
+    """Deployment class hosting one engine (one model replica).
+
+    engine_config selects the engine: PagedEngineConfig → paged KV pool
+    with chunked prefill (the vLLM-class default for real serving),
+    EngineConfig → the dense slot-grid engine (simplest, fixed HBM)."""
 
     def __init__(
         self,
         model: str | TransformerConfig = "gpt2-tiny",
         params: Any = None,
-        engine_config: Optional[EngineConfig] = None,
+        engine_config: Optional[EngineConfig | PagedEngineConfig] = None,
         seed: int = 0,
     ):
         config = get_config(model) if isinstance(model, str) else model
         if params is None:
             params = init_params(config, jax.random.PRNGKey(seed))
         self.model_config = config
-        self.engine = LLMEngine(config, params, engine_config)
+        if isinstance(engine_config, PagedEngineConfig):
+            self.engine = PagedLLMEngine(config, params, engine_config)
+        else:
+            self.engine = LLMEngine(config, params, engine_config)
 
     def generate(self, payload: Dict[str, Any]) -> Dict[str, Any]:
         """{"prompt_tokens": [...], "max_tokens": n, "temperature": t} →
@@ -74,9 +82,14 @@ def build_llm_app(
     num_replicas: int = 1,
     max_slots: int = 8,
     params: Any = None,
+    paged: bool = False,
 ) -> Application:
     """OpenAI-compatible app builder (reference build_openai_app)."""
     dep = deployment(
         LLMServer, name=name, num_replicas=num_replicas, max_ongoing_requests=max_slots * 2
     )
-    return dep.bind(model, params, EngineConfig(max_slots=max_slots))
+    engine_config = (
+        PagedEngineConfig(max_slots=max_slots) if paged
+        else EngineConfig(max_slots=max_slots)
+    )
+    return dep.bind(model, params, engine_config)

@@ -22,6 +22,12 @@ from .lm import (  # noqa: F401
     make_eval_step,
     make_train_step,
 )
-from .session import get_context, get_session, report  # noqa: F401
+from .session import (  # noqa: F401
+    get_checkpoint,
+    get_context,
+    get_session,
+    load_trial_checkpoint,
+    report,
+)
 from .trainer import LMTrainer, Trainer  # noqa: F401
 from .worker_group import TrainWorker, WorkerGroup  # noqa: F401

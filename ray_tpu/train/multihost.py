@@ -45,8 +45,8 @@ class _FileSession(Session):
         super().__init__(context)
         self._path = path
 
-    def report(self, metrics, checkpoint_step=None) -> None:
-        super().report(metrics, checkpoint_step)
+    def report(self, metrics, checkpoint_step=None, checkpoint=None) -> None:
+        super().report(metrics, checkpoint_step, checkpoint)
         rec = {
             "metrics": dict(metrics),
             "checkpoint_step": checkpoint_step,

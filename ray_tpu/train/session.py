@@ -1,12 +1,16 @@
-"""Per-worker train session: report()/get_context() (reference parity:
-ray.train.report + TrainContext, train/_internal/session.py)."""
+"""Per-worker train session: report()/get_context()/checkpoints
+(reference parity: ray.train.report + TrainContext + ray.train.Checkpoint,
+train/_internal/session.py, train/_checkpoint.py:56)."""
 
 from __future__ import annotations
 
 import dataclasses
+import os
 import threading
 import time
 from typing import Any, Dict, List, Optional
+
+import cloudpickle
 
 
 @dataclasses.dataclass
@@ -33,7 +37,14 @@ class Session:
         self._reports: List[Report] = []
         self._lock = threading.Lock()
 
-    def report(self, metrics: Dict[str, Any], checkpoint_step: Optional[int] = None) -> None:
+    def report(
+        self,
+        metrics: Dict[str, Any],
+        checkpoint_step: Optional[int] = None,
+        checkpoint: Any = None,
+    ) -> None:
+        if checkpoint is not None:
+            checkpoint_step = self.save_checkpoint(checkpoint, checkpoint_step)
         with self._lock:
             self._reports.append(
                 Report(
@@ -43,6 +54,49 @@ class Session:
                     time=time.time(),
                 )
             )
+
+    # ------------------------------------------------------------ checkpoints
+    # Object checkpoints live in the trial dir as atomic pickle files —
+    # the substrate for Tune trial restore and PBT exploit/explore
+    # (reference: tune/execution/experiment_state.py, Checkpoint dirs).
+
+    def save_checkpoint(self, obj: Any, step: Optional[int] = None) -> int:
+        trial_dir = self.context.trial_dir
+        if trial_dir is None:
+            raise RuntimeError(
+                "report(checkpoint=...) requires a trial_dir (runs launched "
+                "by Tuner/Trainer set one automatically)"
+            )
+        os.makedirs(trial_dir, exist_ok=True)
+        if step is None:
+            # Monotonic across actor restarts: a fresh Session must write
+            # AFTER whatever already exists on disk, or the pruner would
+            # delete the new files as "oldest" and loads would return
+            # stale pre-crash state.
+            existing = list_checkpoints(trial_dir)
+            step = (
+                int(existing[-1][len("ckpt_"):-len(".pkl")]) + 1
+                if existing else 0
+            )
+        path = os.path.join(trial_dir, f"ckpt_{step:08d}.pkl")
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            cloudpickle.dump(obj, f)
+        os.replace(tmp, path)  # atomic: readers never see partial writes
+        self._prune_checkpoints(trial_dir, keep=2)
+        return step
+
+    @staticmethod
+    def _prune_checkpoints(trial_dir: str, keep: int) -> None:
+        for old in list_checkpoints(trial_dir)[:-keep]:
+            try:
+                os.unlink(os.path.join(trial_dir, old))
+            except OSError:
+                pass
+
+    def load_checkpoint(self) -> Any:
+        """Latest checkpoint object in this trial's dir, or None."""
+        return load_trial_checkpoint(self.context.trial_dir)
 
     def drain(self, since: int) -> List[Report]:
         with self._lock:
@@ -71,10 +125,40 @@ def get_session() -> Session:
     return session
 
 
-def report(metrics: Dict[str, Any], checkpoint_step: Optional[int] = None) -> None:
-    """ray.train.report equivalent: stream metrics (and optionally note a
-    completed checkpoint step) to the controller."""
-    get_session().report(metrics, checkpoint_step)
+def report(
+    metrics: Dict[str, Any],
+    checkpoint_step: Optional[int] = None,
+    checkpoint: Any = None,
+) -> None:
+    """ray.train.report equivalent: stream metrics (and optionally persist
+    a checkpoint object / note a completed checkpoint step)."""
+    get_session().report(metrics, checkpoint_step, checkpoint)
+
+
+def get_checkpoint() -> Any:
+    """Latest persisted checkpoint for this trial, or None on a fresh
+    start (reference: ray.train.get_checkpoint). How trainables resume
+    after a failure, a Tuner.restore, or a PBT exploit."""
+    return get_session().load_checkpoint()
+
+
+def list_checkpoints(trial_dir: Optional[str]) -> List[str]:
+    """Checkpoint filenames in a trial dir, oldest→latest. The ONE place
+    that knows the naming scheme (save/prune/load/PBT-clone all use it)."""
+    if trial_dir is None or not os.path.isdir(trial_dir):
+        return []
+    return sorted(
+        f for f in os.listdir(trial_dir)
+        if f.startswith("ckpt_") and f.endswith(".pkl")
+    )
+
+
+def load_trial_checkpoint(trial_dir: Optional[str]) -> Any:
+    ckpts = list_checkpoints(trial_dir)
+    if not ckpts:
+        return None
+    with open(os.path.join(trial_dir, ckpts[-1]), "rb") as f:
+        return cloudpickle.load(f)
 
 
 def get_context() -> TrainContext:

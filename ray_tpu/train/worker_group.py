@@ -18,9 +18,13 @@ from .session import Session, TrainContext, _set_session
 class TrainWorker:
     """Actor body. Created via api.remote inside WorkerGroup.start()."""
 
-    def __init__(self, rank: int, world_size: int, run_name: str):
+    def __init__(
+        self, rank: int, world_size: int, run_name: str,
+        trial_dir: "Optional[str]" = None,
+    ):
         self._context = TrainContext(
-            world_rank=rank, world_size=world_size, run_name=run_name
+            world_rank=rank, world_size=world_size, run_name=run_name,
+            trial_dir=trial_dir,
         )
         self._session = Session(self._context)
         self._done = False
@@ -64,10 +68,19 @@ class WorkerGroup:
         num_workers: int,
         resources_per_worker: Dict[str, float],
         run_name: str = "train_run",
+        trial_dir: Optional[str] = None,
     ):
         self.num_workers = num_workers
         self.resources_per_worker = resources_per_worker
         self.run_name = run_name
+        # Shared checkpoint dir for report(checkpoint=...)/get_checkpoint()
+        # (all ranks see the same dir, like the reference's shared
+        # StorageContext; by convention rank 0 writes).
+        if trial_dir is None:
+            import tempfile
+
+            trial_dir = tempfile.mkdtemp(prefix=f"ray_tpu_train_{run_name}_")
+        self.trial_dir = trial_dir
         self.pg: Optional[PlacementGroup] = None
         self.workers: List[Any] = []
 
@@ -90,7 +103,7 @@ class WorkerGroup:
                     placement_group=self.pg, placement_group_bundle_index=i
                 ),
                 name=f"{self.run_name}-worker-{i}",
-            ).remote(i, self.num_workers, self.run_name)
+            ).remote(i, self.num_workers, self.run_name, self.trial_dir)
             for i in range(self.num_workers)
         ]
         api.get([w.ping.remote() for w in self.workers], timeout=30)

@@ -5,8 +5,10 @@ stopping), and a Tuner running concurrent trial actors with early stop.
 Report from a trainable with ray_tpu.train.report(...).
 """
 
-from ..train.session import report  # noqa: F401  (tune.report alias)
+from ..train.session import get_checkpoint, report  # noqa: F401  (tune aliases)
 from .schedulers import (  # noqa: F401
+    Exploit,
+    PopulationBasedTraining,
     ASHAScheduler,
     FIFOScheduler,
     MedianStoppingRule,

@@ -83,7 +83,17 @@ def generate_variants(
     param_space: Dict[str, Any], num_samples: int, seed: int = 0
 ) -> Iterator[Dict[str, Any]]:
     """Cross-product of grid axes × num_samples draws of random domains.
-    Plain values pass through."""
+    Plain values pass through. Accepts both the constructor form
+    (tune.grid_search([...])) and the reference's literal dict form
+    ({"grid_search": [...]})."""
+    param_space = {
+        k: (
+            GridSearch(list(v["grid_search"]))
+            if isinstance(v, dict) and set(v) == {"grid_search"}
+            else v
+        )
+        for k, v in param_space.items()
+    }
     grid_keys = [k for k, v in param_space.items() if isinstance(v, GridSearch)]
     grid_values = [param_space[k].values for k in grid_keys]
     rng = np.random.default_rng(seed)

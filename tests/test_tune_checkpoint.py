@@ -1,0 +1,154 @@
+"""Tune trial checkpointing, failure retry, Tuner.restore, and PBT
+(reference: tune/execution/experiment_state.py, tune/schedulers/pbt.py:221)."""
+
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.train import session as train_session
+from ray_tpu.tune import TuneConfig, Tuner
+from ray_tpu.tune.schedulers import Exploit, PopulationBasedTraining
+
+
+@pytest.fixture(autouse=True)
+def _cluster():
+    ray_tpu.init(num_cpus=8, detect_accelerators=False)
+    yield
+    ray_tpu.shutdown()
+
+
+def _counting_trainable(config):
+    """Counts up, checkpointing each step; resumes where it left off and
+    crashes once at step 3 unless it already restarted."""
+    ckpt = train_session.get_checkpoint()
+    start = ckpt["step"] + 1 if ckpt else 0
+    for step in range(start, 6):
+        if step == 3 and ckpt is None:
+            raise RuntimeError("injected trial crash")
+        train_session.report(
+            {"step": step, "resumed": ckpt is not None},
+            checkpoint={"step": step},
+        )
+
+
+def test_trial_crash_resumes_from_checkpoint(tmp_path):
+    tuner = Tuner(
+        _counting_trainable,
+        param_space={"x": [1]},
+        tune_config=TuneConfig(
+            metric="step", mode="max", max_failures=1,
+            storage_path=str(tmp_path),
+        ),
+    )
+    grid = tuner.fit()
+    best = grid.get_best_result()
+    assert best.status.value == "TERMINATED"
+    # crashed at step 3, resumed from ckpt step 2, continued through 5
+    assert best.last_result["step"] == 5
+    assert best.last_result["resumed"] is True
+    assert best.num_failures == 1
+    steps = [r["step"] for r in best.history]
+    assert steps[-3:] == [3, 4, 5]
+
+
+def test_no_retry_budget_errors_out(tmp_path):
+    def always_crash(config):
+        raise RuntimeError("nope")
+
+    grid = Tuner(
+        always_crash,
+        param_space={"x": [1]},
+        tune_config=TuneConfig(storage_path=str(tmp_path)),
+    ).fit()
+    trial = list(grid)[0]
+    assert trial.status.value == "ERRORED"
+    assert "nope" in trial.error
+
+
+def test_tuner_restore_skips_finished_reruns_unfinished(tmp_path):
+    calls_file = tmp_path / "calls.txt"
+
+    def trainable(config):
+        with open(calls_file, "a") as f:
+            f.write(f"{config['idx']}\n")
+        if config["idx"] == 1 and not train_session.get_checkpoint():
+            # first run of trial 1 dies without finishing
+            train_session.report({"score": 0}, checkpoint={"seen": True})
+            raise RuntimeError("die once")
+        train_session.report({"score": config["idx"] * 10})
+
+    cfg = TuneConfig(metric="score", mode="max", storage_path=str(tmp_path))
+    grid = Tuner(
+        trainable, param_space={"idx": {"grid_search": [0, 1]}}, tune_config=cfg
+    ).fit()
+    statuses = {t.trial_id: t.status.value for t in grid}
+    assert statuses["trial_00000"] == "TERMINATED"
+    assert statuses["trial_00001"] == "ERRORED"
+
+    restored = Tuner.restore(str(tmp_path), trainable)
+    grid2 = restored.fit()
+    statuses = {t.trial_id: t.status.value for t in grid2}
+    assert statuses["trial_00001"] == "TERMINATED"  # resumed via checkpoint
+    runs = [int(x) for x in calls_file.read_text().split()]
+    # trial 0 ran exactly once: restore did not re-run the finished trial
+    assert runs.count(0) == 1
+
+
+def test_pbt_exploits_and_mutates(tmp_path):
+    """Weak trials must adopt (and perturb) strong trials' configs, and
+    resume from the donor's checkpoint."""
+
+    def trainable(config):
+        import time as _time
+
+        ckpt = train_session.get_checkpoint() or {"acc": 0.0, "steps": 0}
+        acc, start = ckpt["acc"], ckpt["steps"]
+        for step in range(start, start + 12):
+            acc += config["lr"]  # higher lr == strictly better here
+            train_session.report(
+                {"acc": acc, "lr": config["lr"]},
+                checkpoint={"acc": acc, "steps": step + 1},
+            )
+            _time.sleep(0.05)  # let controller polls interleave the population
+
+    pbt = PopulationBasedTraining(
+        metric="acc",
+        mode="max",
+        perturbation_interval=3,
+        hyperparam_mutations={"lr": [0.1, 0.5, 1.0, 2.0]},
+        quantile_fraction=0.34,
+        seed=7,
+    )
+    grid = Tuner(
+        trainable,
+        param_space={"lr": {"grid_search": [0.1, 0.5, 2.0]}},
+        tune_config=TuneConfig(
+            metric="acc", mode="max", scheduler=pbt, max_concurrent=3,
+            storage_path=str(tmp_path),
+        ),
+    ).fit()
+    assert pbt.num_exploits >= 1
+    exploited = [t for t in grid if t.num_exploits > 0]
+    assert exploited, "no trial ever exploited"
+    # the weakest config must not still be running lr=0.1 at the end
+    for t in exploited:
+        assert t.config["lr"] != 0.1
+        # exploited trials carried donor progress: their reported acc must
+        # exceed anything reachable alone from scratch with lr=0.1
+        assert t.last_result["acc"] > 0.1 * 12 + 1e-9
+
+
+def test_pbt_scheduler_unit():
+    pbt = PopulationBasedTraining(
+        metric="score", mode="max", perturbation_interval=2,
+        hyperparam_mutations={"lr": [1, 2, 4]}, quantile_fraction=0.5, seed=0,
+    )
+    pbt.on_trial_config("a", {"lr": 4})
+    pbt.on_trial_config("b", {"lr": 1})
+    assert pbt.on_result("a", {"score": 10, "training_iteration": 2}) == "CONTINUE"
+    verdict = pbt.on_result("b", {"score": 1, "training_iteration": 2})
+    assert isinstance(verdict, Exploit)
+    assert verdict.donor_trial == "a"
+    assert "lr" in verdict.new_config
