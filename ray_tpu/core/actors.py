@@ -302,7 +302,13 @@ class ActorRuntime:
                     k: (v.resolve() if getattr(v, "__ray_tpu_lazy__", False) else v)
                     for k, v in call.kwargs.items()
                 }
-                if self._worker is not None:
+                if call.method_name == "__ray_apply__" and self._worker is None:
+                    # fn(instance, *args) — the reference's __ray_call__
+                    # escape hatch (python/ray/actor.py); the substrate for
+                    # compiled-DAG execution loops (ray_tpu/experimental/dag)
+                    fn = args[0]
+                    result = fn(self._instance, *args[1:], **kwargs)
+                elif self._worker is not None:
                     from .worker_pool import WorkerCrashedError
 
                     inc = self._incarnation

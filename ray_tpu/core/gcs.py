@@ -10,9 +10,13 @@ implementation for multi-host control can replace it behind the same API.
 from __future__ import annotations
 
 import fnmatch
+import logging
+import os
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
 
 
 class KVStore:
@@ -97,7 +101,10 @@ class GlobalControlStore:
     def register_named_actor(self, name: str, handle: Any, namespace: str = "default") -> None:
         with self._lock:
             key = (namespace, name)
-            if key in self._named_actors:
+            # A None entry is a restored placeholder (the name existed
+            # before a control-plane restart; the actor is gone) — it MUST
+            # be reclaimable, or restart recovery defeats itself.
+            if self._named_actors.get(key) is not None:
                 raise ValueError(f"Actor name {name!r} already taken in namespace {namespace!r}")
             self._named_actors[key] = handle
         self.pubsub.publish("actors", {"event": "registered", "name": name})
@@ -113,3 +120,58 @@ class GlobalControlStore:
     def list_named_actors(self, namespace: str = "default") -> List[str]:
         with self._lock:
             return [n for (ns, n) in self._named_actors if ns == namespace]
+
+    # ------------------------------------------------------- persistence
+    # Reference parity: RedisGcsTableStorage (gcs_table_storage.h:275)
+    # makes the GCS restartable. Inversion: one atomic pickle snapshot of
+    # the durable tables (KV + named-actor registry + whatever the
+    # runtime passes in `extra`, e.g. job records), written periodically
+    # and restored at init. Live handles are NOT durable across a process
+    # restart — names are recorded so a restarted control plane knows
+    # what existed; actors themselves must be re-created.
+
+    def snapshot(self, path: str, extra: Optional[Dict[str, Any]] = None) -> None:
+        import cloudpickle
+
+        with self.kv._lock:
+            kv_items = []
+            for k, v in self.kv._data.items():
+                try:
+                    blob = cloudpickle.dumps(v)
+                except Exception:
+                    logger.warning("gcs snapshot: skipping unpicklable key %r", k)
+                    continue
+                kv_items.append((k, blob))
+        with self._lock:
+            actor_names = list(self._named_actors.keys())
+        payload = {
+            "kv": kv_items,
+            "named_actors": actor_names,
+            "extra": extra or {},
+            "ts": time.time(),
+        }
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            cloudpickle.dump(payload, f)
+        os.replace(tmp, path)  # atomic: a crash never leaves a torn snapshot
+
+    def restore(self, path: str) -> Dict[str, Any]:
+        """Load a snapshot into this store; returns the `extra` payload.
+        Restored named-actor entries map to None (the actor process is
+        gone) so lookups distinguish 'never existed' from 'existed before
+        the restart'."""
+        import cloudpickle
+
+        with open(path, "rb") as f:
+            payload = cloudpickle.load(f)
+        with self.kv._lock:
+            for k, blob in payload["kv"]:
+                try:
+                    self.kv._data[k] = cloudpickle.loads(blob)
+                except Exception:
+                    logger.warning("gcs restore: skipping undecodable key %r", k)
+        with self._lock:
+            for key in payload["named_actors"]:
+                self._named_actors.setdefault(key, None)
+        return payload.get("extra", {})

@@ -111,6 +111,72 @@ class Runtime:
             self.scheduler.add_node(
                 Node(NodeID.from_random(), dict(node_res), is_head=(i == 0))
             )
+        # failure detection + OOM policy + GCS durability (all flag-driven)
+        from .health import HealthCheckManager, MemoryMonitor
+
+        self.health = HealthCheckManager(
+            cfg.health_check_period_s, cfg.health_check_failures
+        )
+        self.health.start()
+        self.memory_monitor = MemoryMonitor(
+            cfg.memory_usage_threshold,
+            cfg.memory_monitor_interval_s,
+            cfg.oom_policy,
+        )
+        self.memory_monitor.start()
+        self._snapshot_stop = threading.Event()
+        self._snapshot_path = cfg.gcs_snapshot_path or None
+        if self._snapshot_path:
+            import os as _os
+
+            if _os.path.exists(self._snapshot_path):
+                self._restore_gcs(self._snapshot_path)
+            interval = cfg.gcs_snapshot_interval_s
+            threading.Thread(
+                target=self._snapshot_loop, args=(interval,), daemon=True,
+                name="gcs-snapshot",
+            ).start()
+
+    # ------------------------------------------------------------ persistence
+
+    def _snapshot_gcs(self) -> None:
+        import dataclasses
+
+        from .. import jobs as jobs_mod
+
+        extra = {}
+        if jobs_mod._default_manager is not None:
+            with jobs_mod._default_manager._lock:
+                # deep-ish copies UNDER the lock: the watcher thread mutates
+                # live JobInfo objects, and pickling a mutating object can
+                # tear or raise mid-snapshot
+                extra["jobs"] = [
+                    dataclasses.replace(j, metadata=dict(j.metadata))
+                    for j in jobs_mod._default_manager._jobs.values()
+                ]
+        self.gcs.snapshot(self._snapshot_path, extra=extra)
+
+    def _restore_gcs(self, path: str) -> None:
+        from .. import jobs as jobs_mod
+        from ..jobs import JobStatus, default_job_manager
+
+        extra = self.gcs.restore(path)
+        for info in extra.get("jobs", ()):  # job records survive restarts
+            if info.status in (JobStatus.PENDING, JobStatus.RUNNING):
+                # the driver process died with the old control plane
+                info.status = JobStatus.FAILED
+            mgr = default_job_manager()
+            with mgr._lock:
+                mgr._jobs.setdefault(info.job_id, info)
+
+    def _snapshot_loop(self, interval: float) -> None:
+        while not self._snapshot_stop.wait(interval):
+            try:
+                self._snapshot_gcs()
+            except Exception:  # noqa: BLE001 - persistence must not kill the runtime
+                import logging
+
+                logging.getLogger(__name__).exception("gcs snapshot failed")
 
     # ------------------------------------------------------------------ store
 
@@ -262,6 +328,10 @@ class Runtime:
             # unschedulable, restarts exhausted) — not just on explicit kill.
             if rt.registered_name:
                 self.gcs.unregister_named_actor(rt.registered_name, rt.registered_namespace)
+            # stop probing a dead actor (and drop the closure pinning it)
+            target = getattr(rt, "_health_target", None)
+            if target is not None:
+                self.health.unregister(target)
 
         try:
             runtime = ActorRuntime(
@@ -287,7 +357,39 @@ class Runtime:
             raise
         with self._lock:
             self._actors[actor_id] = runtime
+        if executor == "process":
+            self._register_actor_health(actor_id, runtime)
         return handle
+
+    def _register_actor_health(self, actor_id: ActorID, rt: ActorRuntime) -> None:
+        """Probe a process actor's worker so a killed/crashed process is
+        detected and restarted WITHOUT waiting for the next method call
+        (reference: GcsHealthCheckManager pings every raylet,
+        gcs_health_check_manager.h:45)."""
+        from .actors import _RestartSignal
+
+        target = f"actor:{actor_id.hex()[:12]}:{rt.name}"
+        rt._health_target = target  # unregistered by the on_death hook
+
+        def probe() -> bool:
+            if rt.state != ActorState.ALIVE:
+                return True  # pending/restarting/dead: nothing to detect
+            worker = rt._worker
+            return worker is None or worker.alive()
+
+        def on_dead(_tid: str) -> None:
+            with rt._lock:
+                dead = rt.state == ActorState.DEAD
+            if not dead:
+                rt._mailbox.put(
+                    _RestartSignal(
+                        "health check: worker process died", rt._incarnation
+                    )
+                )
+                # re-arm: the restarted incarnation gets probed too
+                self.health.register(target, probe, on_dead)
+
+        self.health.register(target, probe, on_dead)
 
     def actor_runtime(self, actor_id: ActorID) -> ActorRuntime:
         with self._lock:
@@ -383,6 +485,14 @@ class Runtime:
         return list(self._task_events)
 
     def shutdown(self) -> None:
+        self.health.stop()
+        self.memory_monitor.stop()
+        self._snapshot_stop.set()
+        if self._snapshot_path:
+            try:
+                self._snapshot_gcs()  # final snapshot: durable state survives
+            except Exception:
+                pass
         with self._lock:
             actors = list(self._actors.values())
         for rt in actors:
@@ -432,6 +542,12 @@ class ActorHandle:
         """OS pid of the process executing this actor's methods."""
         return ActorMethod(self, "__ray_pid__")
 
+    @property
+    def __ray_apply__(self) -> "ActorMethod":
+        """Run fn(instance, *args) inside the actor (reference
+        __ray_call__): the compiled-DAG loop entry point."""
+        return ActorMethod(self, "__ray_apply__")
+
     def state(self) -> ActorState:
         return self._runtime.actor_runtime(self._actor_id).state
 
@@ -452,6 +568,13 @@ class ActorMethod:
         return self._handle._runtime.submit_actor_task(
             self._handle._actor_id, self._name, args, kwargs, self._num_returns
         )
+
+    def bind(self, *args, **kwargs):
+        """Bind this method into a DAG graph (reference dag_node.py bind);
+        compile with .experimental_compile() on the leaf node."""
+        from ..experimental.dag import ClassMethodNode
+
+        return ClassMethodNode(self._handle, self._name, args, kwargs)
 
 
 # --------------------------------------------------------------------- globals

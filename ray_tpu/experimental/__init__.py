@@ -1,0 +1,12 @@
+"""ray_tpu.experimental — channels + compiled actor DAGs (aDAG).
+
+Reference parity: python/ray/experimental/channel/ and python/ray/dag/.
+"""
+
+from .channel import Channel, ChannelClosedError, ChannelReader  # noqa: F401
+from .dag import (  # noqa: F401
+    CompiledDAG,
+    DAGNode,
+    InputNode,
+    MultiOutputNode,
+)

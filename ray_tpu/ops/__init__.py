@@ -12,6 +12,7 @@ None). None auto-selects pallas on TPU backends, xla elsewhere.
 
 from .attention import flash_attention, mha_reference  # noqa: F401
 from .ring_attention import ring_attention, ring_attention_sharded  # noqa: F401
+from .ulysses import ulysses_attention, ulysses_attention_sharded  # noqa: F401
 from .layers import (  # noqa: F401
     apply_rope,
     gelu,

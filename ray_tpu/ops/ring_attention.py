@@ -51,15 +51,11 @@ def _ring_attention_local(
     q32 = q.astype(jnp.float32)
     perm = [(i, (i + 1) % n) for i in range(n)]  # send kv to the next host
 
-    def step(carry, step_idx):
-        m_prev, l_prev, acc, k_cur, v_cur = carry
-        # whose kv shard do we hold after `step_idx` rotations?
-        kv_idx = (my_idx - step_idx) % n
-
+    def _block(m_prev, l_prev, acc, k_cur, v_cur, kv_idx, masked: bool):
         s = jnp.einsum(
             "bhqd,bhkd->bhqk", q32, k_cur.astype(jnp.float32)
         ) * sm_scale
-        if causal:
+        if masked:
             q_pos = my_idx * s_local + lax.broadcasted_iota(
                 jnp.int32, (1, 1, s_local, s_local), 2
             )
@@ -67,7 +63,6 @@ def _ring_attention_local(
                 jnp.int32, (1, 1, s_local, s_local), 3
             )
             s = jnp.where(kv_pos <= q_pos, s, _NEG_INF)
-
         m_cur = jnp.max(s, axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
         p = jnp.exp(s - m_new)
@@ -76,6 +71,35 @@ def _ring_attention_local(
         acc = acc * alpha + jnp.einsum(
             "bhqk,bhkd->bhqd", p, v_cur.astype(jnp.float32)
         )
+        return m_new, l_new, acc
+
+    def step(carry, step_idx):
+        m_prev, l_prev, acc, k_cur, v_cur = carry
+        # whose kv shard do we hold after `step_idx` rotations?
+        kv_idx = (my_idx - step_idx) % n
+
+        if causal:
+            # Causal block skipping (Liu et al.): a KV shard entirely in
+            # this device's future contributes nothing — branch to a
+            # no-op instead of computing a fully-masked block, so the
+            # ring does ~n/2 block matmuls instead of n. The diagonal
+            # block is the only one that needs the intra-block mask.
+            branch = jnp.where(
+                kv_idx > my_idx, 0, jnp.where(kv_idx == my_idx, 1, 2)
+            )
+            m_new, l_new, acc = lax.switch(
+                branch,
+                [
+                    lambda *a: (m_prev, l_prev, acc),  # future: skip
+                    lambda *a: _block(*a, masked=True),  # diagonal
+                    lambda *a: _block(*a, masked=False),  # past: full
+                ],
+                m_prev, l_prev, acc, k_cur, v_cur, kv_idx,
+            )
+        else:
+            m_new, l_new, acc = _block(
+                m_prev, l_prev, acc, k_cur, v_cur, kv_idx, masked=False
+            )
         k_next = lax.ppermute(k_cur, axis_name, perm)
         v_next = lax.ppermute(v_cur, axis_name, perm)
         return (m_new, l_new, acc, k_next, v_next), None

@@ -1,0 +1,205 @@
+"""Channels + compiled actor DAGs (reference: python/ray/dag/
+compiled_dag_node.py:805, experimental/channel/shared_memory_channel.py:151,
+experimental_mutable_object_manager.h:44)."""
+
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.experimental import (
+    Channel,
+    ChannelClosedError,
+    ChannelReader,
+    InputNode,
+    MultiOutputNode,
+)
+
+
+@pytest.fixture(autouse=True)
+def _cluster():
+    ray_tpu.init(num_cpus=8, detect_accelerators=False)
+    yield
+    ray_tpu.shutdown()
+
+
+# ------------------------------------------------------------------ channels
+
+
+def test_channel_version_semantics():
+    ch = Channel(num_readers=1)
+    r = ChannelReader(ch)
+    ch.write("a")
+    assert r.read() == "a"
+    ch.write("b")
+    assert r.read() == "b"
+    with pytest.raises(TimeoutError):
+        r.read(timeout=0.05)  # no new version
+
+
+def test_channel_backpressure_blocks_writer():
+    ch = Channel(num_readers=1)
+    r = ChannelReader(ch)
+    ch.write(1)
+    with pytest.raises(TimeoutError):
+        ch.write(2, timeout=0.05)  # reader has not consumed v1
+    assert r.read() == 1
+    ch.write(2)
+    assert r.read() == 2
+
+
+def test_channel_multi_reader_each_sees_each_version():
+    ch = Channel(num_readers=2)
+    r1, r2 = ChannelReader(ch), ChannelReader(ch)
+    ch.write("x")
+    assert r1.read() == "x"
+    with pytest.raises(TimeoutError):
+        ch.write("y", timeout=0.05)  # r2 still owes a read
+    assert r2.read() == "x"
+    ch.write("y")
+    assert (r1.read(), r2.read()) == ("y", "y")
+
+
+def test_channel_close_unblocks():
+    ch = Channel(num_readers=1)
+    r = ChannelReader(ch)
+    errs = []
+
+    def blocked_read():
+        try:
+            r.read(timeout=10)
+        except ChannelClosedError as e:
+            errs.append(e)
+
+    t = threading.Thread(target=blocked_read)
+    t.start()
+    time.sleep(0.05)
+    ch.close()
+    t.join(timeout=5)
+    assert errs and not t.is_alive()
+    with pytest.raises(ChannelClosedError):
+        ch.write(1)
+
+
+# ---------------------------------------------------------------------- DAGs
+
+
+@ray_tpu.remote
+class Adder:
+    def __init__(self, inc):
+        self.inc = inc
+        self.calls = 0
+
+    def add(self, x):
+        self.calls += 1
+        return x + self.inc
+
+    def add2(self, x, y):
+        return x + y
+
+    def boom(self, x):
+        raise ValueError(f"bad input {x}")
+
+    def ncalls(self):
+        return self.calls
+
+
+def test_linear_dag_pipeline():
+    a = Adder.remote(1)
+    b = Adder.remote(10)
+    with InputNode() as inp:
+        x = a.add.bind(inp)
+        y = b.add.bind(x)
+    dag = y.experimental_compile()
+    try:
+        futs = [dag.execute(i) for i in range(5)]
+        assert [f.get(timeout=30) for f in futs] == [11 + i for i in range(5)]
+    finally:
+        dag.teardown()
+
+
+def test_dag_reuses_actors_without_task_submission():
+    a = Adder.remote(5)
+    with InputNode() as inp:
+        out = a.add.bind(inp)
+    dag = out.experimental_compile()
+    try:
+        for i in range(20):
+            assert dag.execute(i).get(timeout=30) == i + 5
+    finally:
+        dag.teardown()
+    # the loop ran inside ONE __ray_apply__ call; method state persisted
+    assert ray_tpu.get(a.ncalls.remote()) == 20
+
+
+def test_dag_fan_out_and_multi_output():
+    a = Adder.remote(1)
+    b = Adder.remote(2)
+    c = Adder.remote(3)
+    with InputNode() as inp:
+        x = a.add.bind(inp)       # consumed by two downstream stages
+        y = b.add.bind(x)
+        z = c.add.bind(x)
+    dag = MultiOutputNode([y, z]).experimental_compile()
+    try:
+        assert dag.execute(10).get(timeout=30) == [13, 14]
+        assert dag.execute(0).get(timeout=30) == [3, 4]
+    finally:
+        dag.teardown()
+
+
+def test_dag_join_two_upstreams():
+    a = Adder.remote(1)
+    b = Adder.remote(2)
+    j = Adder.remote(0)
+    with InputNode() as inp:
+        x = a.add.bind(inp)
+        y = b.add.bind(inp)
+        out = j.add2.bind(x, y)
+    dag = out.experimental_compile()
+    try:
+        assert dag.execute(10).get(timeout=30) == 11 + 12
+    finally:
+        dag.teardown()
+
+
+def test_dag_const_args():
+    a = Adder.remote(0)
+    with InputNode() as inp:
+        out = a.add2.bind(inp, 100)
+    dag = out.experimental_compile()
+    try:
+        assert dag.execute(1).get(timeout=30) == 101
+    finally:
+        dag.teardown()
+
+
+def test_dag_error_propagates_to_future():
+    a = Adder.remote(1)
+    b = Adder.remote(2)
+    with InputNode() as inp:
+        x = a.boom.bind(inp)
+        y = b.add.bind(x)
+    dag = y.experimental_compile()
+    try:
+        with pytest.raises(ValueError, match="bad input 7"):
+            dag.execute(7).get(timeout=30)
+        # the pipeline survives an error and keeps serving
+        with pytest.raises(ValueError, match="bad input 8"):
+            dag.execute(8).get(timeout=30)
+    finally:
+        dag.teardown()
+
+
+def test_dag_teardown_releases_actor():
+    a = Adder.remote(1)
+    with InputNode() as inp:
+        out = a.add.bind(inp)
+    dag = out.experimental_compile()
+    assert dag.execute(1).get(timeout=30) == 2
+    dag.teardown()
+    # the actor's executor thread is free again for normal calls
+    assert ray_tpu.get(a.ncalls.remote(), timeout=30) == 1
+    with pytest.raises(RuntimeError, match="torn down"):
+        dag.execute(2)
