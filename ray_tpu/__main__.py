@@ -1,0 +1,5 @@
+"""`python -m ray_tpu` entry point (reference: the `ray` console script)."""
+
+from .cli import main
+
+raise SystemExit(main())

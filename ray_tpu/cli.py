@@ -1,0 +1,165 @@
+"""Command-line interface: `python -m ray_tpu <command>`.
+
+Reference parity: `ray` CLI (/root/reference/python/ray/scripts/
+scripts.py — `ray start` :706, `ray status`, `ray job submit` :1787,
+`ray timeline`). TPU inversion: the runtime is in-process, so commands
+that need a live cluster start one, act, and report — there is no
+daemon to attach to. Job commands supervise real subprocesses; `doctor`
+checks the JAX/TPU environment; `dashboard` serves the live view.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def _cmd_doctor(args) -> int:
+    """Environment sanity: devices, backend, config flags."""
+    import jax
+
+    print(f"python: {sys.version.split()[0]}")
+    print(f"jax: {jax.__version__}")
+    print(f"backend: {jax.default_backend()}")
+    for d in jax.devices():
+        print(f"device: {d} (kind={getattr(d, 'device_kind', '?')})")
+    import ray_tpu
+
+    rt = ray_tpu.init(detect_accelerators=not args.no_tpu)
+    print(f"cluster resources: {rt.cluster_resources()}")
+
+    @ray_tpu.remote
+    def probe():
+        return "ok"
+
+    assert ray_tpu.get(probe.remote(), timeout=60) == "ok"
+    print("task round-trip: ok")
+    ray_tpu.shutdown()
+    return 0
+
+
+def _cmd_config(args) -> int:
+    from .core.config import cfg
+
+    print(cfg.describe())
+    return 0
+
+
+def _cmd_status(args) -> int:
+    import ray_tpu
+    from .util import state
+
+    ray_tpu.init(detect_accelerators=not args.no_tpu)
+    print(json.dumps(state.summary(), indent=2, default=str))
+    for n in state.list_nodes():
+        print(f"node {n['node_id'][:12]} head={n['is_head']} "
+              f"avail={n['resources_available']}")
+    ray_tpu.shutdown()
+    return 0
+
+
+def _cmd_job(args) -> int:
+    from .jobs import default_job_manager
+
+    mgr = default_job_manager()
+    if args.job_cmd == "submit":
+        jid = mgr.submit(args.entrypoint, job_id=args.job_id)
+        print(f"submitted {jid}")
+        if args.wait:
+            status = mgr.wait(jid)
+            print(mgr.logs(jid), end="")
+            print(f"job {jid}: {status.value}")
+            return 0 if status.value == "SUCCEEDED" else 1
+        return 0
+    if args.job_cmd == "list":
+        for info in mgr.list():
+            print(f"{info.job_id}  {info.status.value:9}  {info.entrypoint}")
+        return 0
+    if args.job_cmd == "logs":
+        print(mgr.logs(args.job_id), end="")
+        return 0
+    if args.job_cmd == "status":
+        print(mgr.status(args.job_id).value)
+        return 0
+    if args.job_cmd == "stop":
+        print("stopped" if mgr.stop(args.job_id) else "not running")
+        return 0
+    raise SystemExit(f"unknown job command {args.job_cmd!r}")
+
+
+def _cmd_timeline(args) -> int:
+    import ray_tpu
+    from .util import state
+
+    if not ray_tpu.is_initialized():
+        print("no live runtime in this process; timeline covers the "
+              "current session only", file=sys.stderr)
+        ray_tpu.init(detect_accelerators=False)
+    state.chrome_tracing_dump(args.output)
+    print(f"wrote {args.output} (open in chrome://tracing or Perfetto)")
+    return 0
+
+
+def _cmd_dashboard(args) -> int:
+    import ray_tpu
+    from .dashboard import start_dashboard
+
+    ray_tpu.init(detect_accelerators=not args.no_tpu)
+    url = start_dashboard(port=args.port)
+    print(f"dashboard live at {url} (ctrl-c to stop)")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="ray_tpu", description="ray_tpu cluster/runtime CLI"
+    )
+    p.add_argument("--no-tpu", action="store_true",
+                   help="skip accelerator detection")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    sub.add_parser("doctor", help="check the JAX/TPU environment")
+    sub.add_parser("config", help="print all config flags")
+    sub.add_parser("status", help="start a runtime and print cluster state")
+
+    jp = sub.add_parser("job", help="submit/inspect driver jobs")
+    jsub = jp.add_subparsers(dest="job_cmd", required=True)
+    js = jsub.add_parser("submit")
+    js.add_argument("entrypoint")
+    js.add_argument("--job-id")
+    js.add_argument("--wait", action="store_true",
+                    help="block until the job finishes; tail its logs")
+    jsub.add_parser("list")
+    for name in ("logs", "status", "stop"):
+        jx = jsub.add_parser(name)
+        jx.add_argument("job_id")
+
+    tp = sub.add_parser("timeline", help="dump a chrome-trace of this session")
+    tp.add_argument("output", nargs="?", default="timeline.json")
+
+    dp = sub.add_parser("dashboard", help="serve the cluster dashboard")
+    dp.add_argument("--port", type=int, default=8265)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    handler = {
+        "doctor": _cmd_doctor,
+        "config": _cmd_config,
+        "status": _cmd_status,
+        "job": _cmd_job,
+        "timeline": _cmd_timeline,
+        "dashboard": _cmd_dashboard,
+    }[args.command]
+    return handler(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -160,7 +160,15 @@ class Runtime:
         from .. import jobs as jobs_mod
         from ..jobs import JobStatus, default_job_manager
 
-        extra = self.gcs.restore(path)
+        try:
+            extra = self.gcs.restore(path)
+        except Exception:  # noqa: BLE001 - a bad snapshot must not brick init
+            import logging
+
+            logging.getLogger(__name__).exception(
+                "gcs snapshot %s is unreadable; starting fresh", path
+            )
+            return
         for info in extra.get("jobs", ()):  # job records survive restarts
             if info.status in (JobStatus.PENDING, JobStatus.RUNNING):
                 # the driver process died with the old control plane

@@ -1,0 +1,146 @@
+"""Dashboard: a single-page cluster view over the state API + metrics.
+
+Reference parity: the aiohttp dashboard (/root/reference/python/ray/
+dashboard/head.py — jobs/state/metrics modules, 32k LoC of React). TPU
+inversion: the runtime is in-process, so the dashboard is a thin HTTP
+server over the EXISTING state API (util/state.py) and metrics registry —
+JSON endpoints plus one self-refreshing HTML page; no build step, no
+node agents, nothing the control plane doesn't already know.
+
+    from ray_tpu.dashboard import start_dashboard
+    url = start_dashboard(port=8265)   # -> http://127.0.0.1:8265
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Tuple
+
+_PAGE = """<!doctype html>
+<html><head><title>ray_tpu dashboard</title><style>
+body{font-family:system-ui,sans-serif;margin:2em;background:#fafafa;color:#222}
+h1{font-size:1.3em} h2{font-size:1.05em;margin-top:1.4em}
+table{border-collapse:collapse;min-width:30em}
+td,th{border:1px solid #ccc;padding:.25em .6em;font-size:.85em;text-align:left}
+th{background:#eee} code{background:#eee;padding:0 .3em}
+#err{color:#b00}
+</style></head><body>
+<h1>ray_tpu dashboard</h1>
+<div id="err"></div>
+<h2>Cluster</h2><table id="summary"></table>
+<h2>Nodes</h2><table id="nodes"></table>
+<h2>Actors</h2><table id="actors"></table>
+<h2>Recent tasks</h2><table id="tasks"></table>
+<h2>Jobs</h2><table id="jobs"></table>
+<script>
+function fill(id, rows) {
+  const t = document.getElementById(id);
+  if (!rows.length) { t.innerHTML = "<tr><td>(none)</td></tr>"; return; }
+  const cols = Object.keys(rows[0]);
+  t.innerHTML = "<tr>" + cols.map(c => `<th>${c}</th>`).join("") + "</tr>" +
+    rows.map(r => "<tr>" + cols.map(c =>
+      `<td>${typeof r[c] === "object" ? JSON.stringify(r[c]) : r[c]}</td>`
+    ).join("") + "</tr>").join("");
+}
+async function refresh() {
+  try {
+    const s = await (await fetch("/api/summary")).json();
+    fill("summary", [s]);
+    fill("nodes", await (await fetch("/api/nodes")).json());
+    fill("actors", await (await fetch("/api/actors")).json());
+    const tasks = await (await fetch("/api/tasks")).json();
+    fill("tasks", tasks.slice(-20).reverse());
+    fill("jobs", await (await fetch("/api/jobs")).json());
+    document.getElementById("err").textContent = "";
+  } catch (e) { document.getElementById("err").textContent = "refresh failed: " + e; }
+}
+refresh(); setInterval(refresh, 2000);
+</script></body></html>"""
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def log_message(self, *args):  # quiet
+        pass
+
+    def do_GET(self):  # noqa: N802 - http.server API
+        try:
+            if self.path == "/" or self.path == "/index.html":
+                self._send(200, _PAGE, "text/html")
+                return
+            if self.path.startswith("/api/"):
+                self._send(200, json.dumps(self._api(self.path[5:])),
+                           "application/json")
+                return
+            if self.path == "/metrics":
+                from .util.metrics import registry
+
+                self._send(200, registry().prometheus_text(), "text/plain")
+                return
+            self._send(404, "not found", "text/plain")
+        except Exception as e:  # noqa: BLE001 - handler must answer something
+            self._send(500, json.dumps({"error": repr(e)}), "application/json")
+
+    def _api(self, name: str):
+        from .util import state
+
+        if name == "summary":
+            return state.summary()
+        if name == "nodes":
+            return state.list_nodes()
+        if name == "actors":
+            return state.list_actors()
+        if name == "tasks":
+            return state.list_tasks()
+        if name == "objects":
+            return state.list_objects()
+        if name == "timeline":
+            return json.loads(state.chrome_tracing_dump())
+        if name == "jobs":
+            from .jobs import _default_manager
+
+            if _default_manager is None:
+                return []
+            return [
+                {
+                    "job_id": j.job_id,
+                    "status": j.status.value,
+                    "entrypoint": j.entrypoint,
+                    "submitted_at": j.submitted_at,
+                    "returncode": j.returncode,
+                }
+                for j in _default_manager.list()
+            ]
+        raise ValueError(f"unknown endpoint {name!r}")
+
+    def _send(self, code: int, body: str, ctype: str) -> None:
+        data = body.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+
+_server: Optional[ThreadingHTTPServer] = None
+
+
+def start_dashboard(port: int = 8265, host: str = "127.0.0.1") -> str:
+    """Serve the dashboard for the current runtime; returns its URL.
+    port=0 picks a free port."""
+    global _server
+    if _server is not None:
+        return f"http://{_server.server_address[0]}:{_server.server_address[1]}"
+    _server = ThreadingHTTPServer((host, port), _Handler)
+    threading.Thread(
+        target=_server.serve_forever, daemon=True, name="ray-tpu-dashboard"
+    ).start()
+    return f"http://{host}:{_server.server_address[1]}"
+
+
+def stop_dashboard() -> None:
+    global _server
+    if _server is not None:
+        _server.shutdown()
+        _server = None

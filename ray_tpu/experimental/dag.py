@@ -179,6 +179,11 @@ class CompiledDAG:
                 return
             seen[id(node)] = node
             if isinstance(node, InputNode):
+                if input_node is not None and input_node is not node:
+                    raise ValueError(
+                        "DAG has multiple InputNodes; build the whole graph "
+                        "from ONE InputNode (the reference enforces this too)"
+                    )
                 input_node = node
                 return
             if not isinstance(node, ClassMethodNode):
@@ -207,6 +212,16 @@ class CompiledDAG:
             visit(out)
         if input_node is None:
             raise ValueError("DAG has no InputNode")
+        # One node per actor: each node dedicates the actor's (single)
+        # executor thread to its loop, so a second node on the same actor
+        # would never start and the DAG would hang at the first execute.
+        actor_ids = [n.handle._actor_id for n in nodes]
+        if len(set(actor_ids)) != len(actor_ids):
+            raise ValueError(
+                "an actor is bound to more than one DAG node; compiled "
+                "DAGs dedicate one actor per node — use separate actors "
+                "(or one method that does both steps)"
+            )
 
         # one channel per producer, sized by its consumer count
         self._input_channel = Channel(num_readers=consumers.get(id(input_node), 0))
@@ -254,14 +269,21 @@ class CompiledDAG:
                 raise RuntimeError("compiled DAG is torn down")
             fut = _DAGFuture()
             self._pending.append(fut)
-            try:
-                self._input_channel.write(value, timeout=timeout)
-            except BaseException:
+        # The blocking write runs OUTSIDE the lock: a stalled pipeline must
+        # not make teardown() (which needs the lock) unreachable — closing
+        # the input channel is exactly what unblocks this write.
+        try:
+            self._input_channel.write(value, timeout=timeout)
+        except BaseException:
+            with self._lock:
                 # never leave an orphaned future: it would swallow the NEXT
                 # execution's result and desynchronize every one after it
-                self._pending.remove(fut)
-                raise
-            return fut
+                try:
+                    self._pending.remove(fut)
+                except ValueError:
+                    pass  # collector already resolved it
+            raise
+        return fut
 
     def _collect(self) -> None:
         while True:

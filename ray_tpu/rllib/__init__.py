@@ -1,0 +1,9 @@
+"""ray_tpu.rllib — reinforcement learning on the actor runtime.
+
+Reference parity: rllib (/root/reference/rllib/ — Algorithm :202,
+EnvRunner groups, PPO). Scoped to the load-bearing core: vectorized
+envs, actor rollout workers, and PPO as one fused XLA update.
+"""
+
+from .env import CartPoleVectorEnv, VectorEnv, make_env, register_env  # noqa: F401
+from .ppo import PPO, PPOConfig, RolloutWorker, init_policy, policy_forward  # noqa: F401

@@ -14,12 +14,21 @@ from ..parallel.mesh import MeshSpec
 @dataclasses.dataclass
 class ScalingConfig:
     """Gang shape. On TPU the unit is a host driving a slice of chips; the
-    mesh spec describes how those chips form dp/fsdp/tp/... axes."""
+    mesh spec describes how those chips form dp/fsdp/tp/... axes.
+
+    min_workers enables ELASTIC scaling (reference v2 ScalingPolicy,
+    scaling_policy.py:29): each (re)start sizes the gang to what the
+    cluster can actually place, between min_workers and num_workers —
+    a partial-slice failure shrinks the gang and training continues from
+    the last checkpoint instead of waiting for capacity; a later restart
+    grows back. The train_fn builds its mesh from the context's
+    world_size, so re-meshing is one restart away."""
 
     num_workers: int = 1
     mesh: Optional[MeshSpec] = None
     resources_per_worker: Optional[Dict[str, float]] = None
     use_tpu: bool = False
+    min_workers: Optional[int] = None  # None = fixed-size gang
 
     def worker_resources(self) -> Dict[str, float]:
         if self.resources_per_worker:

@@ -73,18 +73,42 @@ class TrainController:
         self.metrics_history: List[Dict[str, Any]] = []
         self.latest_checkpoint_step: Optional[int] = None
         self.num_restarts = 0
+        self.world_sizes: List[int] = []  # gang size per (re)start attempt
+
+    def decide_num_workers(self) -> int:
+        """Elastic sizing (reference v2 ScalingPolicy): fit the gang to
+        currently-placeable resources, clamped to [min_workers,
+        num_workers]. Fixed-size when min_workers is None."""
+        want = self.scaling.num_workers
+        floor = self.scaling.min_workers
+        if floor is None:
+            return want
+        # a zero-worker gang would vacuously "finish" without training
+        floor = max(1, floor)
+        from .. import api
+
+        per = self.scaling.worker_resources()
+        avail = api.available_resources()
+        feasible = want
+        for res, amount in per.items():
+            if amount > 0:
+                feasible = min(feasible, int(avail.get(res, 0.0) // amount))
+        return max(floor, min(want, feasible))
 
     def run(self) -> Result:
         policy = FailurePolicy(self.run_config.failure)
         error: Optional[str] = None
         while True:
+            num_workers = self.decide_num_workers()
+            self.world_sizes.append(num_workers)
             if self.group_factory is not None:
                 group = self.group_factory()
             else:
                 group = WorkerGroup(
-                    self.scaling.num_workers,
+                    num_workers,
                     self.scaling.worker_resources(),
                     run_name=self.run_config.name,
+                    trial_dir=self.run_config.storage_path,
                 )
             try:
                 group.start()

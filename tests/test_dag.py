@@ -203,3 +203,17 @@ def test_dag_teardown_releases_actor():
     assert ray_tpu.get(a.ncalls.remote(), timeout=30) == 1
     with pytest.raises(RuntimeError, match="torn down"):
         dag.execute(2)
+
+
+def test_dag_rejects_same_actor_twice_and_multiple_inputs():
+    a = Adder.remote(1)
+    with InputNode() as inp:
+        x = a.add.bind(inp)
+        y = a.add.bind(x)  # same actor bound twice
+    with pytest.raises(ValueError, match="more than one DAG node"):
+        y.experimental_compile()
+
+    b, c = Adder.remote(1), Adder.remote(2)
+    i1, i2 = InputNode(), InputNode()
+    with pytest.raises(ValueError, match="multiple InputNodes"):
+        MultiOutputNode([b.add.bind(i1), c.add.bind(i2)]).experimental_compile()

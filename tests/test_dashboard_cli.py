@@ -1,0 +1,104 @@
+"""Dashboard HTTP endpoints + CLI commands (reference: python/ray/
+dashboard/, scripts/scripts.py)."""
+
+import json
+import subprocess
+import sys
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu.dashboard import start_dashboard, stop_dashboard
+
+
+@pytest.fixture(autouse=True)
+def _cluster():
+    ray_tpu.init(num_cpus=4, detect_accelerators=False)
+    yield
+    stop_dashboard()
+    ray_tpu.shutdown()
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.status, r.read().decode()
+
+
+def test_dashboard_serves_state_and_page():
+    @ray_tpu.remote
+    def work(x):
+        return x + 1
+
+    assert ray_tpu.get(work.remote(1)) == 2
+
+    @ray_tpu.remote
+    class A:
+        def noop(self):
+            return None
+
+    a = A.options(name="dash-actor").remote()
+    ray_tpu.get(a.noop.remote())
+
+    url = start_dashboard(port=0)
+    status, page = _get(url + "/")
+    assert status == 200 and "ray_tpu dashboard" in page
+
+    status, body = _get(url + "/api/summary")
+    summary = json.loads(body)
+    assert summary["nodes"] == 1
+    assert summary["tasks_finished"] >= 1
+
+    status, body = _get(url + "/api/actors")
+    actors = json.loads(body)
+    assert any(x["name"] == "dash-actor" for x in actors)
+
+    status, body = _get(url + "/api/tasks")
+    assert any(t["name"] == "work" for t in json.loads(body))
+
+    status, body = _get(url + "/api/timeline")
+    assert "traceEvents" in json.loads(body)
+
+    status, body = _get(url + "/metrics")
+    assert status == 200
+
+    with pytest.raises(Exception):
+        _get(url + "/api/nonsense")
+
+
+def _run_cli(*args, check=True):
+    proc = subprocess.run(
+        [sys.executable, "-m", "ray_tpu", *args],
+        capture_output=True, text=True, timeout=120,
+    )
+    if check:
+        assert proc.returncode == 0, proc.stderr
+    return proc
+
+
+def test_cli_config_lists_flags():
+    out = _run_cli("config").stdout
+    assert "object_store_capacity_bytes" in out
+    assert "RAY_TPU_NATIVE_STORE" in out
+
+
+def test_cli_status():
+    out = _run_cli("--no-tpu", "status").stdout
+    assert '"nodes": 1' in out
+    assert "head=True" in out
+
+
+def test_cli_job_submit_wait_and_logs():
+    out = _run_cli(
+        "job", "submit", "python -c 'print(\"hello-from-job\")'",
+        "--job-id", "cli-test-job", "--wait",
+    ).stdout
+    assert "hello-from-job" in out
+    assert "SUCCEEDED" in out
+
+    failing = _run_cli(
+        "job", "submit", "python -c 'raise SystemExit(3)'", "--wait",
+        check=False,
+    )
+    assert failing.returncode == 1
+    assert "FAILED" in failing.stdout

@@ -1,0 +1,84 @@
+"""RL library: env physics, rollout machinery, PPO learning
+(reference: rllib/algorithms/ppo, rllib/env/)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rllib import CartPoleVectorEnv, PPOConfig, register_env
+
+
+@pytest.fixture(autouse=True)
+def _cluster():
+    ray_tpu.init(num_cpus=8, detect_accelerators=False)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_cartpole_env_basics():
+    env = CartPoleVectorEnv(num_envs=4)
+    obs = env.reset(seed=0)
+    assert obs.shape == (4, 4)
+    total_dones = 0
+    for _ in range(300):
+        obs, rewards, dones = env.step(np.random.randint(0, 2, size=4))
+        assert rewards.shape == (4,) and (rewards == 1.0).all()
+        total_dones += int(dones.sum())
+    # random policy fails well before 300 steps: every lane reset at least once
+    assert total_dones >= 4
+    assert np.isfinite(obs).all()
+
+
+def test_random_policy_baseline_short_episodes():
+    env = CartPoleVectorEnv(num_envs=8)
+    env.reset(seed=1)
+    lengths = []
+    steps = np.zeros(8)
+    for _ in range(500):
+        _, _, dones = env.step(np.random.randint(0, 2, size=8))
+        steps += 1
+        for i in np.nonzero(dones)[0]:
+            lengths.append(steps[i])
+            steps[i] = 0
+    assert 5 < np.mean(lengths) < 60  # classic random-CartPole range
+
+
+def test_ppo_learns_cartpole():
+    """The end-to-end RL story: PPO must clearly beat the random baseline."""
+    algo = PPOConfig(
+        env="cartpole", num_workers=2, num_envs_per_worker=8,
+        rollout_len=128, lr=3e-3, num_epochs=4, seed=0,
+    ).build()
+    try:
+        first = None
+        result = None
+        for _ in range(25):
+            result = algo.train()
+            if first is None and result["episodes_this_iter"] > 0:
+                first = result["episode_reward_mean"]
+        assert result["training_iteration"] == 25
+        assert result["timesteps_this_iter"] == 2 * 8 * 128
+        # random CartPole averages ~20; learning must at least double it
+        # and clear 60 outright
+        assert result["episode_reward_mean"] > max(60.0, 2 * first), (
+            first, result["episode_reward_mean"]
+        )
+    finally:
+        algo.stop()
+
+
+def test_custom_env_registration():
+    class ConstantEnv(CartPoleVectorEnv):
+        pass
+
+    register_env("constant", lambda n: ConstantEnv(n))
+    algo = PPOConfig(env="constant", num_workers=1, num_envs_per_worker=2,
+                     rollout_len=8).build()
+    try:
+        result = algo.train()
+        assert result["timesteps_this_iter"] == 16
+    finally:
+        algo.stop()
+
+    with pytest.raises(ValueError, match="unknown env"):
+        PPOConfig(env="nope").build()

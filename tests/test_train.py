@@ -182,3 +182,43 @@ def test_gang_trainer_restarts_then_succeeds(runtime, tmp_path):
     assert result.status == RunStatus.FINISHED
     assert result.num_restarts == 1
     assert result.metrics["attempt"] == 1
+
+
+def test_elastic_gang_resizes_on_capacity(runtime):
+    """Elastic scaling (reference v2 ScalingPolicy): with part of the
+    cluster occupied the gang starts small; after capacity returns, the
+    restart grows it back and training resumes from the checkpoint."""
+    from ray_tpu.train import (
+        FailureConfig, RunConfig, RunStatus, ScalingConfig, TrainController,
+    )
+    from ray_tpu.train.session import get_context, report
+
+    @ray_tpu.remote
+    class Blocker:
+        def ping(self):
+            return "ok"
+
+    blockers = [Blocker.options(num_cpus=1).remote() for _ in range(5)]
+    ray_tpu.get([b.ping.remote() for b in blockers], timeout=30)
+
+    def train_fn(config=None):
+        ctx = get_context()
+        if ctx.world_size < 4:
+            if ctx.world_rank == 0:
+                for b in blockers:
+                    ray_tpu.kill(b)  # capacity comes back
+            report({"loss": 1.0}, checkpoint_step=5)
+            raise RuntimeError("partial-capacity attempt dies")
+        report({"loss": 0.5}, checkpoint_step=10)
+
+    controller = TrainController(
+        train_fn,
+        ScalingConfig(num_workers=4, min_workers=1),
+        RunConfig(name="elastic", failure=FailureConfig(max_failures=2)),
+    )
+    result = controller.run()
+    assert result.status == RunStatus.FINISHED
+    assert controller.world_sizes[0] < 4  # degraded start
+    assert controller.world_sizes[-1] == 4  # grew back after restart
+    assert result.checkpoint_step == 10
+    assert result.num_restarts >= 1
