@@ -14,12 +14,16 @@ from .block import (  # noqa: F401
     block_to_items,
 )
 from .dataset import (  # noqa: F401
+    ActorPoolStrategy,
     DataContext,
     DataIterator,
     Dataset,
+    from_generator,
     from_items,
     from_numpy,
     range,
+    read_csv,
+    read_json,
     read_npy,
     read_parquet,
     read_text,

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import builtins
 import dataclasses
+import itertools
 import queue
 import threading
 from collections import deque
@@ -37,14 +38,27 @@ from .block import (
     block_to_items,
 )
 from .datasource import (
+    CsvSource,
     Datasource,
     ItemsSource,
+    JsonlSource,
     NpyFileSource,
     NumpySource,
     ParquetSource,
     RangeSource,
     TextSource,
 )
+
+
+@dataclasses.dataclass
+class ActorPoolStrategy:
+    """compute= strategy for map_batches: a pool of stateful actors
+    instead of stateless tasks (reference ActorPoolMapOperator,
+    _internal/execution/operators/actor_pool_map_operator.py). Use with a
+    CLASS udf whose (expensive) __init__ runs once per actor — model
+    weights, tokenizers — and whose __call__ maps a block."""
+
+    size: int = 2
 
 
 @dataclasses.dataclass
@@ -69,11 +83,28 @@ class DataContext:
 
 @dataclasses.dataclass
 class _Op:
-    kind: str  # read | map_batches | filter | repartition | shuffle | limit
+    kind: str  # read | read_stream | map_batches | map_batches_actors |
+    #            filter | repartition | shuffle | limit
     fn: Optional[Callable] = None
     source: Optional[Datasource] = None
     n: Optional[int] = None
     seed: Optional[int] = None
+    compute: Optional[ActorPoolStrategy] = None
+    fn_args: tuple = ()
+    fn_kwargs: Optional[Dict[str, Any]] = None
+
+
+class _BlockUDFActor:
+    """Actor body hosting one stateful udf instance (class or callable)."""
+
+    def __init__(self, fn_or_cls, args, kwargs):
+        if isinstance(fn_or_cls, type):
+            self.fn = fn_or_cls(*args, **(kwargs or {}))
+        else:
+            self.fn = fn_or_cls
+
+    def apply(self, block: Block) -> Block:
+        return self.fn(block)
 
 
 # ----------------------------------------------------------------- execution
@@ -92,13 +123,64 @@ def _stream_submit(
         yield pending.popleft()
 
 
+def _actor_pool_stream(
+    stream: Iterator[Any], op: _Op, ctx: DataContext
+) -> Iterator[Any]:
+    """Stateful map over an actor pool: blocks round-robin across N udf
+    actors (in-order yield; the in-flight window is the backpressure).
+    Actors are killed when the stage drains."""
+    actor_cls = api.remote(_BlockUDFActor)
+    pool = [
+        actor_cls.options(num_cpus=1).remote(op.fn, op.fn_args, op.fn_kwargs)
+        for _ in builtins.range(op.compute.size)  # module range() is a Dataset
+    ]
+    produced: List[Any] = []
+
+    def submit(ref):
+        out = pool[next(counter) % len(pool)].apply.remote(ref)
+        produced.append(out)
+        return out
+
+    try:
+        counter = itertools.count()
+        yield from _stream_submit(
+            stream, submit, max(ctx.prefetch_blocks, len(pool))
+        )
+    finally:
+        # downstream stages may still be EXECUTING the yielded refs; a
+        # kill now would fail them with ActorDiedError mid-pipeline. Let
+        # every submitted apply() finish before releasing the actors.
+        if produced:
+            try:
+                api.wait(produced, num_returns=len(produced), timeout=300)
+            except Exception:
+                pass
+        for a in pool:
+            try:
+                api.kill(a)
+            except Exception:
+                pass
+
+
 def _plan_iter(ops: List[_Op], ctx: DataContext) -> Iterator[Any]:
     """Compose the per-op ref streams (each stage overlaps with the next)."""
-    assert ops and ops[0].kind == "read"
-    read_remote = api.remote(lambda task: task())
-    stream: Iterator[Any] = _stream_submit(
-        iter(ops[0].source.read_tasks()), lambda t: read_remote.remote(t), ctx.prefetch_blocks
-    )
+    assert ops and ops[0].kind in ("read", "read_stream")
+    if ops[0].kind == "read_stream":
+        # unknown-cardinality ingest: ONE streaming-generator task yields
+        # blocks as they are produced (num_returns="streaming" substrate)
+        gen_fn = ops[0].fn
+
+        def produce():
+            for batch in gen_fn():
+                yield batch if isinstance(batch, dict) else block_from_items(batch)
+
+        produce_remote = api.remote(produce)
+        stream = iter(produce_remote.options(num_returns="streaming").remote())
+    else:
+        read_remote = api.remote(lambda task: task())
+        stream = _stream_submit(
+            iter(ops[0].source.read_tasks()), lambda t: read_remote.remote(t), ctx.prefetch_blocks
+        )
 
     for op in ops[1:]:
         if op.kind == "map_batches":
@@ -106,6 +188,8 @@ def _plan_iter(ops: List[_Op], ctx: DataContext) -> Iterator[Any]:
             stream = _stream_submit(
                 stream, lambda ref, r=map_remote: r.remote(ref), ctx.prefetch_blocks
             )
+        elif op.kind == "map_batches_actors":
+            stream = _actor_pool_stream(stream, op, ctx)
         elif op.kind == "filter":
             fn = op.fn
 
@@ -192,7 +276,32 @@ class Dataset:
 
     # -- transforms (lazy) --
 
-    def map_batches(self, fn: Callable[[Block], Block]) -> "Dataset":
+    def map_batches(
+        self,
+        fn: Callable[[Block], Block] | type,
+        *,
+        compute: Optional[ActorPoolStrategy] = None,
+        fn_constructor_args: tuple = (),
+        fn_constructor_kwargs: Optional[Dict[str, Any]] = None,
+    ) -> "Dataset":
+        """Map blocks with a function (stateless tasks) or, with
+        compute=ActorPoolStrategy(n), a CLASS udf hosted on a pool of n
+        stateful actors — __init__ runs once per actor (reference
+        ActorPoolMapOperator)."""
+        if compute is not None:
+            return Dataset(
+                self._ops + [_Op(
+                    "map_batches_actors", fn=fn, compute=compute,
+                    fn_args=fn_constructor_args,
+                    fn_kwargs=fn_constructor_kwargs,
+                )],
+                self._ctx,
+            )
+        if isinstance(fn, type):
+            raise ValueError(
+                "class udfs need compute=ActorPoolStrategy(n) so instances "
+                "have somewhere stateful to live"
+            )
         return Dataset(self._ops + [_Op("map_batches", fn=fn)], self._ctx)
 
     def map(self, fn: Callable[[Any], Any]) -> "Dataset":
@@ -363,3 +472,21 @@ def read_parquet(paths, *, columns: Optional[List[str]] = None) -> Dataset:
             "environment; convert to .npy shards and use read_npy"
         ) from e
     return Dataset([_Op("read", source=ParquetSource(paths, columns))])
+
+
+def read_csv(paths) -> Dataset:
+    return Dataset([_Op("read", source=CsvSource(paths))])
+
+
+def read_json(paths) -> Dataset:
+    """Line-delimited JSON (one object per line ⇒ one row)."""
+    return Dataset([_Op("read", source=JsonlSource(paths))])
+
+
+def from_generator(gen_fn: Callable[[], Iterator[Any]]) -> Dataset:
+    """Unknown-cardinality ingest: `gen_fn()` yields batches (a columnar
+    dict or a list of rows), each becoming a block the moment it is
+    produced — backed by a num_returns="streaming" generator task, so
+    consumers overlap with production (reference: streaming reads +
+    ObjectRefStream)."""
+    return Dataset([_Op("read_stream", fn=gen_fn)])

@@ -151,3 +151,74 @@ def _expand(paths: Sequence[str]) -> List[str]:
     if not out:
         raise FileNotFoundError(f"no files matched {paths!r}")
     return out
+
+
+class CsvSource(Datasource):
+    """One block per CSV file; columns inferred, numeric where possible
+    (reference _internal/datasource/csv_datasource.py)."""
+
+    def __init__(self, paths: Sequence[str]):
+        self.paths = _expand(paths)
+
+    def read_tasks(self) -> List[ReadTask]:
+        def make(path: str) -> ReadTask:
+            def read() -> Block:
+                import csv
+
+                with open(path, newline="") as f:
+                    rows = list(csv.DictReader(f))
+                if not rows:
+                    return {}
+                block: Block = {}
+                for name in rows[0]:
+                    col = [r[name] for r in rows]
+                    try:
+                        block[name] = np.asarray([float(x) for x in col])
+                        if all(float(x).is_integer() for x in col):
+                            block[name] = block[name].astype(np.int64)
+                    except ValueError:
+                        block[name] = np.asarray(col, dtype=object)
+                return block
+
+            return read
+
+        return [make(p) for p in self.paths]
+
+
+class JsonlSource(Datasource):
+    """One block per .jsonl file: each line a JSON object ⇒ one row
+    (reference _internal/datasource/json_datasource.py)."""
+
+    def __init__(self, paths: Sequence[str]):
+        self.paths = _expand(paths)
+
+    def read_tasks(self) -> List[ReadTask]:
+        def make(path: str) -> ReadTask:
+            def read() -> Block:
+                import json
+
+                rows = []
+                with open(path) as f:
+                    for line in f:
+                        line = line.strip()
+                        if line:
+                            rows.append(json.loads(line))
+                if not rows:
+                    return {}
+                names: List[str] = []
+                for r in rows:  # union over ALL rows: later-appearing keys count
+                    for k in r:
+                        if k not in names:
+                            names.append(k)
+                block: Block = {}
+                for name in names:
+                    col = [r.get(name) for r in rows]
+                    try:
+                        block[name] = np.asarray(col)
+                    except Exception:
+                        block[name] = np.asarray(col, dtype=object)
+                return block
+
+            return read
+
+        return [make(p) for p in self.paths]

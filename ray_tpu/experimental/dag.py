@@ -161,6 +161,10 @@ class CompiledDAG:
         self._loop_refs: List[Any] = []
         self._pending: "deque[_DAGFuture]" = deque()
         self._lock = threading.Lock()
+        # serializes execute(): future-append order MUST equal input-write
+        # order or concurrent executes cross-deliver results. Separate
+        # from _lock so teardown() stays reachable while a write blocks.
+        self._submit_lock = threading.Lock()
         self._torn_down = False
         self._compile()
 
@@ -264,25 +268,26 @@ class CompiledDAG:
     def execute(self, value: Any = None, timeout: Optional[float] = None) -> _DAGFuture:
         """Feed one input; returns a future. Executions pipeline: stage k
         of call i runs concurrently with stage k-1 of call i+1."""
-        with self._lock:
-            if self._torn_down:
-                raise RuntimeError("compiled DAG is torn down")
-            fut = _DAGFuture()
-            self._pending.append(fut)
-        # The blocking write runs OUTSIDE the lock: a stalled pipeline must
-        # not make teardown() (which needs the lock) unreachable — closing
-        # the input channel is exactly what unblocks this write.
-        try:
-            self._input_channel.write(value, timeout=timeout)
-        except BaseException:
+        with self._submit_lock:
             with self._lock:
-                # never leave an orphaned future: it would swallow the NEXT
-                # execution's result and desynchronize every one after it
-                try:
-                    self._pending.remove(fut)
-                except ValueError:
-                    pass  # collector already resolved it
-            raise
+                if self._torn_down:
+                    raise RuntimeError("compiled DAG is torn down")
+                fut = _DAGFuture()
+                self._pending.append(fut)
+            # The blocking write runs outside self._lock (teardown needs it
+            # to close the channel, which is what unblocks this write) but
+            # INSIDE the submit lock, keeping append order == write order.
+            try:
+                self._input_channel.write(value, timeout=timeout)
+            except BaseException:
+                with self._lock:
+                    # never leave an orphaned future: it would swallow the
+                    # NEXT execution's result and desynchronize the rest
+                    try:
+                        self._pending.remove(fut)
+                    except ValueError:
+                        pass  # collector already resolved it
+                raise
         return fut
 
     def _collect(self) -> None:

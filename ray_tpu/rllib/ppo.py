@@ -223,7 +223,6 @@ class PPO:
 
         def update(params, opt_state, key, rollouts):
             # rollouts: stacked (W, T, N, ...) host arrays
-            rewards = rollouts["rewards"].reshape(-1, *rollouts["rewards"].shape[2:])
             obs = rollouts["obs"]
             W, T, N = obs.shape[0], obs.shape[1], obs.shape[2]
             adv = jax.vmap(compute_gae)(
@@ -268,7 +267,6 @@ class PPO:
             policy_loss, value_loss, entropy = jax.tree.map(
                 lambda x: x[-1, -1], aux
             )
-            del rewards
             return params, opt_state, {
                 "policy_loss": policy_loss,
                 "value_loss": value_loss,

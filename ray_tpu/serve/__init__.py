@@ -20,4 +20,5 @@ from .deployment import (  # noqa: F401
     DeploymentConfig,
     deployment,
 )
+from .multiplex import get_multiplexed_model_id, multiplexed  # noqa: F401
 from .router import DeploymentHandle  # noqa: F401

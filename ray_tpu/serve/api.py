@@ -70,16 +70,30 @@ class _HttpProxy:
         proxy = self
 
         class Handler(BaseHTTPRequestHandler):
+            # chunked transfer is illegal on HTTP/1.0; spec-compliant
+            # clients only dechunk 1.1 responses
+            protocol_version = "HTTP/1.1"
+
             def do_POST(self):  # noqa: N802
                 try:
+                    from urllib.parse import parse_qs, urlsplit
+
+                    url = urlsplit(self.path)
+                    query = parse_qs(url.query)
                     length = int(self.headers.get("Content-Length", 0))
                     payload = json.loads(self.rfile.read(length) or b"{}")
-                    # path = /<deployment>[/<method>]
-                    parts = [p for p in self.path.split("/") if p]
+                    # path = /<deployment>[/<method>][?stream=1][&model_id=m]
+                    parts = [p for p in url.path.split("/") if p]
                     if not parts:
                         raise KeyError("missing deployment in path")
                     handle = controller.get_handle(parts[0])
+                    model_id = query.get("model_id", [None])[0]
+                    if model_id:
+                        handle = handle.options(multiplexed_model_id=model_id)
                     method = parts[1] if len(parts) > 1 else "__call__"
+                    if query.get("stream", ["0"])[0] in ("1", "true"):
+                        self._stream_response(handle, method, payload)
+                        return
                     ref = getattr(handle, method).remote(payload) if method != "__call__" else handle.remote(payload)
                     result = _core_api.get(ref, timeout=120)
                     body = json.dumps({"result": result}).encode()
@@ -94,6 +108,29 @@ class _HttpProxy:
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+
+            def _stream_response(self, handle, method, payload) -> None:
+                """Chunked transfer: one JSON line per yielded item
+                (reference: Serve streaming responses over ASGI). Items
+                flow as the replica's generator produces them — backed by
+                num_returns='streaming' on the actor call."""
+                stream = getattr(handle.options(stream=True), method).remote(payload)
+                self.send_response(200)
+                self.send_header("Content-Type", "application/jsonl")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+
+                def chunk(data: bytes) -> None:
+                    self.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+                    self.wfile.flush()
+
+                try:
+                    for ref in stream:
+                        item = _core_api.get(ref, timeout=120)
+                        chunk((json.dumps({"result": item}) + "\n").encode())
+                except Exception as e:  # noqa: BLE001 - surfaces as final line
+                    chunk((json.dumps({"error": repr(e)}) + "\n").encode())
+                self.wfile.write(b"0\r\n\r\n")
 
             def log_message(self, *args):  # silence request logs
                 pass

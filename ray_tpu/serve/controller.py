@@ -28,13 +28,36 @@ class _ReplicaWrapper:
         self._instance = cls(*args, **kwargs)
 
     def call(self, method: str, *args, **kwargs):
-        return getattr(self._instance, method)(*args, **kwargs)
+        from .multiplex import _set_model_id
+
+        model_id = kwargs.pop("_multiplexed_model_id", None)
+        _set_model_id(model_id)
+        try:
+            result = getattr(self._instance, method)(*args, **kwargs)
+            if model_id and hasattr(result, "__next__"):
+                # generator bodies run at iteration time (the streaming
+                # executor drains them after this returns): re-establish
+                # the model-id context around the actual execution
+                return _with_model_id(result, model_id)
+            return result
+        finally:
+            _set_model_id(None)
 
     def health(self) -> str:
         check = getattr(self._instance, "check_health", None)
         if check is not None:
             check()
         return "ok"
+
+
+def _with_model_id(gen, model_id: str):
+    from .multiplex import _set_model_id
+
+    _set_model_id(model_id)
+    try:
+        yield from gen
+    finally:
+        _set_model_id(None)
 
 
 class _DeploymentState:
@@ -63,8 +86,29 @@ class ServeController:
 
     # ------------------------------------------------------------- lifecycle
 
-    def deploy(self, app: Application) -> DeploymentHandle:
+    def deploy(self, app: Application, _is_child: bool = False) -> DeploymentHandle:
+        # COMPOSITION (reference: deployment graphs / handle chaining):
+        # an Application passed as an init arg deploys first and is
+        # replaced by its DeploymentHandle, so deployments call
+        # deployments through the router (per-hop load balancing).
         dep = app.deployment
+        with self._lock:
+            existing = self._states.get(dep.name)
+        if _is_child and existing is not None:
+            # a child shared by several parents (or bound twice in one
+            # graph) deploys once; later references reuse its replica set
+            return DeploymentHandle(existing.replica_set)
+        if existing is not None:
+            self.delete(dep.name)  # explicit redeploy: release old replicas
+        init_args = tuple(
+            self.deploy(a, _is_child=True) if isinstance(a, Application) else a
+            for a in app.init_args
+        )
+        init_kwargs = {
+            k: self.deploy(v, _is_child=True) if isinstance(v, Application) else v
+            for k, v in app.init_kwargs.items()
+        }
+        app = Application(app.deployment, init_args, init_kwargs)
         with self._lock:
             state = _DeploymentState(dep, app)
             self._states[dep.name] = state

@@ -22,6 +22,9 @@ class ReplicaSet:
         self._lock = threading.Lock()
         self._replicas: List[Any] = []  # ActorHandles
         self._ongoing: Dict[int, int] = {}  # id(handle) -> count
+        # model-multiplex affinity: model_id -> MRU list of replica ids
+        # (reference pow_2_scheduler.py is multiplex-aware the same way)
+        self._affinity: Dict[str, List[int]] = {}
 
     def set_replicas(self, replicas: List[Any]) -> None:
         with self._lock:
@@ -35,16 +38,32 @@ class ReplicaSet:
         with self._lock:
             return list(self._replicas)
 
-    def pick(self) -> Any:
-        """Pow-2 choice by ongoing count."""
+    def pick(self, model_id: Optional[str] = None) -> Any:
+        """Pow-2 choice by ongoing count; with a multiplexed model id,
+        prefer a replica that already holds the model (affinity)."""
         with self._lock:
             if not self._replicas:
                 raise RuntimeError(f"deployment {self.name!r} has no replicas")
-            if len(self._replicas) == 1:
-                chosen = self._replicas[0]
-            else:
-                a, b = random.sample(self._replicas, 2)
-                chosen = a if self._ongoing[id(a)] <= self._ongoing[id(b)] else b
+            chosen = None
+            if model_id:
+                cands = [
+                    r for r in self._replicas
+                    if id(r) in self._affinity.get(model_id, ())
+                ]
+                if cands:
+                    chosen = min(cands, key=lambda r: self._ongoing[id(r)])
+            if chosen is None:
+                if len(self._replicas) == 1:
+                    chosen = self._replicas[0]
+                else:
+                    a, b = random.sample(self._replicas, 2)
+                    chosen = a if self._ongoing[id(a)] <= self._ongoing[id(b)] else b
+            if model_id:
+                mru = self._affinity.setdefault(model_id, [])
+                if id(chosen) in mru:
+                    mru.remove(id(chosen))
+                mru.insert(0, id(chosen))
+                del mru[2:]  # at most 2 replicas per model keep affinity
             self._ongoing[id(chosen)] += 1
             return chosen
 
@@ -64,19 +83,35 @@ class ReplicaSet:
 
 class DeploymentHandle:
     """What users call: handle.method.remote(args) → ObjectRef (reference
-    serve/handle.py DeploymentHandle)."""
+    serve/handle.py DeploymentHandle). options(stream=True) streams a
+    generator method's yields; options(multiplexed_model_id=...) routes
+    with model affinity and exposes the id via
+    serve.get_multiplexed_model_id() inside the replica."""
 
-    def __init__(self, replica_set: ReplicaSet):
+    def __init__(self, replica_set: ReplicaSet, *, stream: bool = False,
+                 multiplexed_model_id: Optional[str] = None):
         self._set = replica_set
+        self._stream = stream
+        self._model_id = multiplexed_model_id
+
+    def options(self, *, stream: Optional[bool] = None,
+                multiplexed_model_id: Optional[str] = None) -> "DeploymentHandle":
+        return DeploymentHandle(
+            self._set,
+            stream=self._stream if stream is None else stream,
+            multiplexed_model_id=multiplexed_model_id or self._model_id,
+        )
 
     def __getattr__(self, method: str) -> "_MethodCaller":
         if method.startswith("_"):
             raise AttributeError(method)
-        return _MethodCaller(self._set, method)
+        return _MethodCaller(self._set, method, self._stream, self._model_id)
 
     def remote(self, *args, **kwargs):
         """Callable deployments: handle.remote(x) → instance.__call__(x)."""
-        return _MethodCaller(self._set, "__call__").remote(*args, **kwargs)
+        return _MethodCaller(
+            self._set, "__call__", self._stream, self._model_id
+        ).remote(*args, **kwargs)
 
     @property
     def deployment_name(self) -> str:
@@ -84,15 +119,23 @@ class DeploymentHandle:
 
 
 class _MethodCaller:
-    def __init__(self, replica_set: ReplicaSet, method: str):
+    def __init__(self, replica_set: ReplicaSet, method: str,
+                 stream: bool = False, model_id: Optional[str] = None):
         self._set = replica_set
         self._method = method
+        self._stream = stream
+        self._model_id = model_id
 
     def remote(self, *args, **kwargs):
-        replica = self._set.pick()
+        replica = self._set.pick(self._model_id)
+        if self._model_id:
+            kwargs["_multiplexed_model_id"] = self._model_id
         try:
             # replicas are _ReplicaWrapper actors: dispatch by method name
-            ref = replica.call.remote(self._method, *args, **kwargs)
+            call = replica.call
+            if self._stream:
+                call = call.options(num_returns="streaming")
+            ref = call.remote(self._method, *args, **kwargs)
         except BaseException:
             self._set.release(replica)
             raise
@@ -128,6 +171,8 @@ class _Reaper:
         self._event.set()
 
     def _loop(self) -> None:
+        from ..core.streaming import ObjectRefGenerator
+
         while True:
             self._event.wait()
             with self._lock:
@@ -135,17 +180,30 @@ class _Reaper:
                 if not tracked:
                     self._event.clear()
                     continue
-            refs = [t[0] for t in tracked]
-            try:
-                done, _ = api.wait(refs, num_returns=1, timeout=0.1)
-            except BaseException:
-                done = []
-            if done:
-                done_set = set(done)
+            # streams complete on their own flag; plain refs via api.wait
+            done_set = set()
+            refs = []
+            for ref, _, _ in tracked:
+                if isinstance(ref, ObjectRefGenerator):
+                    if ref.completed():
+                        done_set.add(id(ref))
+                else:
+                    refs.append(ref)
+            if refs:
+                try:
+                    done, _ = api.wait(refs, num_returns=1, timeout=0.1)
+                    done_set.update(id(r) for r in done)
+                except BaseException:
+                    pass
+            else:
+                import time as _time
+
+                _time.sleep(0.05)  # stream polling cadence
+            if done_set:
                 with self._lock:
                     remaining = []
                     for ref, rset, replica in self._tracked:
-                        if ref in done_set:
+                        if id(ref) in done_set:
                             rset.release(replica)
                         else:
                             remaining.append((ref, rset, replica))

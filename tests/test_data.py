@@ -154,3 +154,58 @@ def test_lm_pipeline_feeds_trainer():
     metrics = trainer.train(batches, num_steps=5, report_every=5)
     assert metrics["step"] == 5
     assert np.isfinite(metrics["loss"])
+
+
+def test_read_csv_and_json(tmp_path, runtime):
+    csv_path = tmp_path / "t.csv"
+    csv_path.write_text("a,b,name\n1,2.5,x\n3,4.5,y\n")
+    ds = ray_tpu.data.read_csv(str(csv_path))
+    rows = ds.take(10)
+    assert rows[0]["a"] == 1 and rows[1]["b"] == 4.5 and rows[0]["name"] == "x"
+
+    jl = tmp_path / "t.jsonl"
+    jl.write_text('{"x": 1, "y": "p"}\n{"x": 2, "y": "q"}\n')
+    ds = ray_tpu.data.read_json(str(jl))
+    assert ds.count() == 2
+    assert ds.map(lambda r: r["x"] * 10).take(2) == [10, 20]
+
+
+def test_actor_pool_map_batches(runtime):
+    from ray_tpu.data import ActorPoolStrategy
+
+    class AddOffset:
+        """Stateful udf: __init__ once per actor."""
+
+        def __init__(self, offset):
+            self.offset = offset
+            self.inits = 1
+
+        def __call__(self, block):
+            return {"item": block["item"] + self.offset}
+
+    ds = ray_tpu.data.range(64, num_blocks=8).map_batches(
+        AddOffset, compute=ActorPoolStrategy(size=2),
+        fn_constructor_args=(1000,),
+    )
+    out = sorted(ds.iter_rows())
+    assert out == list(__import__("builtins").range(1000, 1064))
+
+    with pytest.raises(ValueError, match="ActorPoolStrategy"):
+        ray_tpu.data.range(4).map_batches(AddOffset)
+
+
+def test_from_generator_streams_blocks(runtime):
+    import numpy as np
+
+    def gen():
+        for i in __import__("builtins").range(5):
+            yield {"v": np.arange(4) + i * 4}  # unknown cardinality upstream
+
+    ds = ray_tpu.data.from_generator(gen)
+    assert ds.count() == 20
+    # transforms compose on top of the streaming read
+    doubled = ray_tpu.data.from_generator(gen).map_batches(
+        lambda b: {"v": b["v"] * 2}
+    )
+    vals = sorted(r["v"] for r in doubled.iter_rows())
+    assert vals == [v * 2 for v in __import__("builtins").range(20)]

@@ -1,0 +1,135 @@
+"""Serve: streaming responses, deployment composition, model multiplexing
+(reference: Serve streaming over ASGI, deployment graphs,
+serve/multiplex.py + LoRA multiplexing)."""
+
+import json
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.core.streaming import ObjectRefGenerator
+
+
+@pytest.fixture(autouse=True)
+def _cluster():
+    ray_tpu.init(num_cpus=8, detect_accelerators=False)
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+# ---------------------------------------------------------------- streaming
+
+
+@serve.deployment
+class Tokens:
+    def generate(self, payload):
+        for i in range(payload["n"]):
+            yield {"token": i * 10}
+
+    def __call__(self, payload):
+        return {"ok": True}
+
+
+def test_handle_streaming():
+    handle = serve.run(Tokens.bind())
+    stream = handle.options(stream=True).generate.remote({"n": 4})
+    assert isinstance(stream, ObjectRefGenerator)
+    items = [ray_tpu.get(r) for r in stream]
+    assert items == [{"token": 0}, {"token": 10}, {"token": 20}, {"token": 30}]
+
+
+def test_http_streaming_chunked():
+    serve.run(Tokens.bind())
+    port = serve.start_http()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/Tokens/generate?stream=1",
+        data=json.dumps({"n": 3}).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        assert resp.headers["Content-Type"] == "application/jsonl"
+        lines = [json.loads(l) for l in resp.read().decode().splitlines() if l]
+    assert lines == [{"result": {"token": i * 10}} for i in range(3)]
+
+
+# -------------------------------------------------------------- composition
+
+
+@serve.deployment
+class Preprocess:
+    def __call__(self, payload):
+        return {"text": payload["text"].strip().lower()}
+
+
+@serve.deployment
+class Classify:
+    def __init__(self, preproc):
+        self.preproc = preproc  # a DeploymentHandle (deployed child app)
+
+    def __call__(self, payload):
+        clean = ray_tpu.get(self.preproc.remote(payload))
+        return {"label": "greeting" if "hello" in clean["text"] else "other"}
+
+
+def test_deployment_composition():
+    handle = serve.run(Classify.bind(Preprocess.bind()))
+    out = ray_tpu.get(handle.remote({"text": "  HELLO world "}))
+    assert out == {"label": "greeting"}
+    # the child deployed as its own deployment with its own replicas
+    st = serve.status()
+    assert "Preprocess" in st and "Classify" in st
+    assert st["Preprocess"]["live_replicas"] >= 1
+
+
+# ------------------------------------------------------------- multiplexing
+
+
+@serve.deployment
+class Adapters:
+    def __init__(self):
+        self.loads = []
+
+    @serve.multiplexed(max_num_models_per_replica=2)
+    def get_model(self, model_id):
+        self.loads.append(model_id)
+        return {"id": model_id, "weights": f"w-{model_id}"}
+
+    def __call__(self, payload):
+        model_id = serve.get_multiplexed_model_id()
+        model = self.get_model(model_id)
+        return {"model": model["id"], "loads": list(self.loads)}
+
+
+def test_multiplexing_lru_and_affinity():
+    handle = serve.run(Adapters.bind())
+    h_a = handle.options(multiplexed_model_id="m-a")
+    h_b = handle.options(multiplexed_model_id="m-b")
+
+    out1 = ray_tpu.get(h_a.remote({}))
+    assert out1["model"] == "m-a" and out1["loads"] == ["m-a"]
+    # same model again: cached, no second load
+    out2 = ray_tpu.get(h_a.remote({}))
+    assert out2["loads"] == ["m-a"]
+    # second model loads alongside (cap 2)
+    out3 = ray_tpu.get(h_b.remote({}))
+    assert out3["loads"] == ["m-a", "m-b"]
+    # third model evicts the LRU (m-a); re-requesting m-a reloads
+    ray_tpu.get(handle.options(multiplexed_model_id="m-c").remote({}))
+    out5 = ray_tpu.get(h_a.remote({}))
+    assert out5["loads"].count("m-a") == 2
+
+
+def test_multiplex_affinity_prefers_loaded_replica():
+    dep = Adapters.options(name="Adapters2", num_replicas=3)
+    handle = serve.run(dep.bind())
+    h = handle.options(multiplexed_model_id="hot")
+    outs = [ray_tpu.get(h.remote({})) for _ in range(8)]
+    # affinity keeps the hot model on at most 2 replicas: total loads of
+    # "hot" across the fleet stay <= 2 despite 8 requests over 3 replicas
+    all_loads = outs[-1]["loads"]
+    assert sum(1 for x in all_loads if x == "hot") <= 1  # per-replica view
+    total_loads = {tuple(o["loads"]) for o in outs}
+    assert len(total_loads) <= 2  # at most 2 distinct replicas ever served it
