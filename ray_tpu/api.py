@@ -118,6 +118,7 @@ _DEFAULT_TASK_OPTIONS: Dict[str, Any] = dict(
     name=None,
     runtime_env=None,
     executor="thread",  # "process" → pooled OS worker (GIL-free CPU work)
+    stream_max_backlog=None,  # streaming producers: block when consumer lags
 )
 
 _DEFAULT_ACTOR_OPTIONS: Dict[str, Any] = dict(
@@ -175,6 +176,7 @@ class RemoteFunction:
             scheduling_strategy=opts["scheduling_strategy"],
             runtime_env=opts.get("runtime_env"),
             executor=opts.get("executor", "thread"),
+            stream_max_backlog=opts.get("stream_max_backlog"),
         )
 
     def __call__(self, *args, **kwargs):

@@ -1,0 +1,96 @@
+"""GCS as a service: the control plane over RPC for multi-process jobs.
+
+Reference parity: gcs_server + gcs_client
+(/root/reference/src/ray/gcs/gcs_server/gcs_server.h:90 composes the
+managers behind 13 gRPC services; gcs_client/gcs_client.h:97 with typed
+accessors). Here one process (the driver / head) serves its
+GlobalControlStore; job drivers and multihost gang members connect with
+GcsClient and share the KV namespace, pub/sub channels, and the
+named-actor NAME registry. Live actor handles cannot cross process
+boundaries (actors execute in their owner's process) — remote lookups
+return existence, exactly what a peer needs for coordination.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from .gcs import GlobalControlStore
+from .rpc import RpcClient, RpcServer
+
+
+def serve_gcs(gcs: GlobalControlStore, host: str = "127.0.0.1", port: int = 0) -> RpcServer:
+    """Expose a GlobalControlStore; returns the RpcServer (''host:port''
+    in .url — hand that to GcsClient in other processes)."""
+    handlers = {
+        "ping": lambda: "ok",
+        "kv_put": gcs.kv.put,
+        "kv_get": gcs.kv.get,
+        "kv_delete": gcs.kv.delete,
+        "kv_keys": gcs.kv.keys,
+        "publish": gcs.pubsub.publish,
+        "poll": gcs.pubsub.poll,
+        "list_named_actors": gcs.list_named_actors,
+        "has_named_actor": lambda name, namespace="default": (
+            gcs.get_named_actor(name, namespace) is not None
+        ),
+    }
+    return RpcServer(handlers, host=host, port=port)
+
+
+class GcsClient:
+    """Typed accessor over the wire (reference gcs_client.h accessors).
+    The surface mirrors the in-process KVStore/PubSub shapes so code can
+    take either."""
+
+    def __init__(self, address: str, *, timeout: float = 30.0):
+        self._rpc = RpcClient(address, timeout=timeout)
+
+    # ------------------------------------------------------------------- kv
+
+    def kv_put(self, key: str, value: Any, namespace: str = "default",
+               overwrite: bool = True) -> bool:
+        return self._rpc.call("kv_put", key, value, namespace, overwrite)
+
+    def kv_get(self, key: str, namespace: str = "default", default: Any = None) -> Any:
+        return self._rpc.call("kv_get", key, namespace, default)
+
+    def kv_delete(self, key: str, namespace: str = "default") -> bool:
+        return self._rpc.call("kv_delete", key, namespace)
+
+    def kv_keys(self, pattern: str = "*", namespace: str = "default") -> List[str]:
+        return self._rpc.call("kv_keys", pattern, namespace)
+
+    # --------------------------------------------------------------- pubsub
+
+    def publish(self, channel: str, message: Any) -> None:
+        self._rpc.call("publish", channel, message)
+
+    def poll(self, channel: str, since: float = 0.0) -> List[Tuple[float, Any]]:
+        return self._rpc.call("poll", channel, since)
+
+    def subscribe_poll_loop(self, channel: str, callback, *, period_s: float = 0.2,
+                            stop_event=None) -> None:
+        """Long-poll subscription (reference pubsub long-poll): invoke
+        callback(message) for every message until stop_event is set."""
+        since = 0.0
+        while stop_event is None or not stop_event.is_set():
+            for ts, msg in self.poll(channel, since):
+                since = max(since, ts)
+                callback(msg)
+            time.sleep(period_s)
+
+    # --------------------------------------------------------------- actors
+
+    def list_named_actors(self, namespace: str = "default") -> List[str]:
+        return self._rpc.call("list_named_actors", namespace)
+
+    def has_named_actor(self, name: str, namespace: str = "default") -> bool:
+        return self._rpc.call("has_named_actor", name, namespace)
+
+    def ping(self) -> bool:
+        return self._rpc.call("ping") == "ok"
+
+    def close(self) -> None:
+        self._rpc.close()

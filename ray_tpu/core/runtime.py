@@ -252,6 +252,7 @@ class Runtime:
         scheduling_strategy: Any = "DEFAULT",
         runtime_env: Any = None,
         executor: str = "thread",
+        stream_max_backlog: Optional[int] = None,
     ) -> Union[ObjectRef, List[ObjectRef], "ObjectRefGenerator"]:
         from . import runtime_env as _renv
 
@@ -279,6 +280,7 @@ class Runtime:
             runtime_env=_renv.normalize(runtime_env),
             executor=executor,
             streaming=streaming,
+            stream_max_backlog=stream_max_backlog,
         )
         if streaming:
             spec.stream = ObjectRefGenerator(task_id, self)

@@ -96,6 +96,9 @@ class TaskSpec:
     # (reference: ObjectRefStream, core_worker.h:273)
     streaming: bool = False
     stream: Any = None  # ObjectRefGenerator (producer half)
+    # producer flow control: block when the consumer lags this many items
+    # behind (None = unbounded, the reference's default)
+    stream_max_backlog: Optional[int] = None
     # internal
     attempt: int = 0
     cancelled: bool = False
@@ -615,6 +618,8 @@ class ClusterScheduler:
         stream = spec.stream
         already = stream._appended if stream is not None else 0
         for idx, item in enumerate(result):
+            if stream is not None and spec.stream_max_backlog:
+                stream._wait_backlog(spec.stream_max_backlog)
             oid = ObjectID.for_task_return(spec.task_id, idx)
             self._store.create(oid, owner_task=spec)
             self._store.seal(oid, item)

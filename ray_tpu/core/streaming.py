@@ -43,6 +43,16 @@ class ObjectRefGenerator:
         self._read = 0
         self._done = False
         self._error: Optional[BaseException] = None
+        self._abandoned = False
+
+    def __del__(self):
+        # a dropped consumer must unblock a backpressured producer
+        try:
+            with self._cond:
+                self._abandoned = True
+                self._cond.notify_all()
+        except Exception:
+            pass
 
     # ---------------------------------------------------------------- producer
 
@@ -66,6 +76,26 @@ class ObjectRefGenerator:
             self._done = True
             self._error = error
             self._cond.notify_all()
+
+    def _wait_backlog(self, max_backlog: int, timeout: Optional[float] = None) -> None:
+        """Producer-side flow control: block until the consumer has fewer
+        than max_backlog unread items (the streaming analogue of the
+        bounded in-flight window). Raises if the consumer abandoned the
+        stream, so a backpressured producer never blocks forever."""
+        with self._cond:
+            ok = self._cond.wait_for(
+                lambda: (len(self._refs) - self._read) < max_backlog
+                or self._abandoned,
+                timeout,
+            )
+            if self._abandoned:
+                raise RuntimeError(
+                    "stream consumer abandoned the generator; stopping producer"
+                )
+            if not ok:
+                raise TimeoutError(
+                    f"stream backlog stayed at {max_backlog} for {timeout}s"
+                )
 
     # ---------------------------------------------------------------- consumer
 
@@ -94,6 +124,7 @@ class ObjectRefGenerator:
                 # TryReadObjectRefStream). The consumer now owns the ref.
                 self._refs[self._read] = None
                 self._read += 1
+                self._cond.notify_all()  # wake a backpressured producer
                 return ref
             if self._error is not None:
                 raise self._error

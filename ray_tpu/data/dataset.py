@@ -134,11 +134,17 @@ def _actor_pool_stream(
         actor_cls.options(num_cpus=1).remote(op.fn, op.fn_args, op.fn_kwargs)
         for _ in builtins.range(op.compute.size)  # module range() is a Dataset
     ]
-    produced: List[Any] = []
+    produced: deque = deque()
 
     def submit(ref):
         out = pool[next(counter) % len(pool)].apply.remote(ref)
         produced.append(out)
+        # keep only a bounded completion tail: pinning EVERY output ref
+        # for the stage's lifetime would defeat store GC on large datasets
+        while len(produced) > 4 * max(ctx.prefetch_blocks, len(pool)):
+            oldest = produced[0]
+            api.wait([oldest], num_returns=1, timeout=300)
+            produced.popleft()
         return out
 
     try:
@@ -175,7 +181,14 @@ def _plan_iter(ops: List[_Op], ctx: DataContext) -> Iterator[Any]:
                 yield batch if isinstance(batch, dict) else block_from_items(batch)
 
         produce_remote = api.remote(produce)
-        stream = iter(produce_remote.options(num_returns="streaming").remote())
+        stream = iter(
+            produce_remote.options(
+                num_returns="streaming",
+                # consumer-paced: the producer blocks once this many blocks
+                # sit unread (the streaming read path's backpressure window)
+                stream_max_backlog=ctx.prefetch_blocks,
+            ).remote()
+        )
     else:
         read_remote = api.remote(lambda task: task())
         stream = _stream_submit(

@@ -172,10 +172,17 @@ class CsvSource(Datasource):
                 block: Block = {}
                 for name in rows[0]:
                     col = [r[name] for r in rows]
+                    # ints FIRST and directly — a float round trip silently
+                    # corrupts integers above 2^53 (snowflake-style ids)
+                    try:
+                        block[name] = np.asarray(
+                            [int(x) for x in col], dtype=np.int64
+                        )
+                        continue
+                    except (ValueError, OverflowError):
+                        pass
                     try:
                         block[name] = np.asarray([float(x) for x in col])
-                        if all(float(x).is_integer() for x in col):
-                            block[name] = block[name].astype(np.int64)
                     except ValueError:
                         block[name] = np.asarray(col, dtype=object)
                 return block

@@ -114,7 +114,11 @@ class _HttpProxy:
                 (reference: Serve streaming responses over ASGI). Items
                 flow as the replica's generator produces them — backed by
                 num_returns='streaming' on the actor call."""
-                stream = getattr(handle.options(stream=True), method).remote(payload)
+                caller = handle.options(stream=True)
+                stream = (
+                    caller.remote(payload) if method == "__call__"
+                    else getattr(caller, method).remote(payload)
+                )
                 self.send_response(200)
                 self.send_header("Content-Type", "application/jsonl")
                 self.send_header("Transfer-Encoding", "chunked")

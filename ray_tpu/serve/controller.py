@@ -63,9 +63,14 @@ def _with_model_id(gen, model_id: str):
 class _DeploymentState:
     """Per-deployment record in the controller."""
 
-    def __init__(self, deployment: Deployment, app: Application):
+    def __init__(self, deployment: Deployment, app: Application,
+                 source_app: Optional[Application] = None):
         self.deployment = deployment
         self.app = app
+        # the ORIGINAL (unresolved) Application object: child-dedup keys on
+        # its identity so shared children deploy once but a fresh .bind()
+        # redeploys
+        self.source_app = source_app if source_app is not None else app
         self.target_replicas = deployment.config.num_replicas
         if deployment.config.autoscaling:
             self.target_replicas = deployment.config.autoscaling.min_replicas
@@ -94,12 +99,14 @@ class ServeController:
         dep = app.deployment
         with self._lock:
             existing = self._states.get(dep.name)
-        if _is_child and existing is not None:
-            # a child shared by several parents (or bound twice in one
-            # graph) deploys once; later references reuse its replica set
+        if _is_child and existing is not None and existing.source_app is app:
+            # the SAME Application object (shared child: bound twice in one
+            # graph, or across parents) deploys once; a redeploy with a
+            # fresh .bind() is a different object and replaces below
             return DeploymentHandle(existing.replica_set)
         if existing is not None:
-            self.delete(dep.name)  # explicit redeploy: release old replicas
+            self.delete(dep.name)  # redeploy: release old replicas
+        source_app = app
         init_args = tuple(
             self.deploy(a, _is_child=True) if isinstance(a, Application) else a
             for a in app.init_args
@@ -110,7 +117,7 @@ class ServeController:
         }
         app = Application(app.deployment, init_args, init_kwargs)
         with self._lock:
-            state = _DeploymentState(dep, app)
+            state = _DeploymentState(dep, app, source_app=source_app)
             self._states[dep.name] = state
         self._reconcile_one(state)  # synchronous first bring-up
         self._ensure_thread()
