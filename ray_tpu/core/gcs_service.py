@@ -20,9 +20,42 @@ from .gcs import GlobalControlStore
 from .rpc import RpcClient, RpcServer
 
 
+class _ResourceSync:
+    """Periodic resource-usage broadcast, aggregated at the head
+    (reference ray_syncer: common/ray_syncer/ray_syncer.h:83 — raylets
+    stream resource views to the GCS). Peers report
+    {resource: available}; views older than `stale_s` drop out of the
+    cluster aggregate, which doubles as liveness."""
+
+    def __init__(self, stale_s: float = 10.0):
+        self._views: dict = {}  # node_id -> (monotonic_ts, resources)
+        self.stale_s = stale_s
+
+    def report(self, node_id: str, resources: dict) -> None:
+        # monotonic: wall-clock steps (NTP) must not flip liveness
+        self._views[node_id] = (time.monotonic(), dict(resources))
+
+    def cluster_view(self) -> dict:
+        now = time.monotonic()
+        total: dict = {}
+        nodes = {}
+        for node_id, (ts, res) in list(self._views.items()):
+            if now - ts > self.stale_s:
+                # evict: under node-id churn the dead set would otherwise
+                # grow (and be rescanned) forever
+                self._views.pop(node_id, None)
+                continue
+            nodes[node_id] = {"age_s": round(now - ts, 3), "resources": res}
+            for k, v in res.items():
+                total[k] = total.get(k, 0.0) + v
+        return {"total": total, "nodes": nodes}
+
+
 def serve_gcs(gcs: GlobalControlStore, host: str = "127.0.0.1", port: int = 0) -> RpcServer:
     """Expose a GlobalControlStore; returns the RpcServer (''host:port''
     in .url — hand that to GcsClient in other processes)."""
+    syncer = _ResourceSync()
+
     handlers = {
         "ping": lambda: "ok",
         "kv_put": gcs.kv.put,
@@ -35,8 +68,12 @@ def serve_gcs(gcs: GlobalControlStore, host: str = "127.0.0.1", port: int = 0) -
         "has_named_actor": lambda name, namespace="default": (
             gcs.get_named_actor(name, namespace) is not None
         ),
+        "report_resources": syncer.report,
+        "cluster_view": syncer.cluster_view,
     }
-    return RpcServer(handlers, host=host, port=port)
+    server = RpcServer(handlers, host=host, port=port)
+    server.syncer = syncer
+    return server
 
 
 class GcsClient:
@@ -88,6 +125,34 @@ class GcsClient:
 
     def has_named_actor(self, name: str, namespace: str = "default") -> bool:
         return self._rpc.call("has_named_actor", name, namespace)
+
+    # ------------------------------------------------------- resource sync
+
+    def report_resources(self, node_id: str, resources: Dict[str, float]) -> None:
+        """Broadcast this node's available resources (reference
+        ray_syncer); call periodically — stale views age out at the head."""
+        self._rpc.call("report_resources", node_id, resources)
+
+    def cluster_view(self) -> Dict[str, Any]:
+        """Aggregated live-node resource view."""
+        return self._rpc.call("cluster_view")
+
+    # ----------------------------------------------------- function export
+
+    def register_function(self, name: str, fn) -> None:
+        """Publish a function by value (reference function_manager:
+        drivers export pickled functions through GCS KV — literally the
+        KV surface with a reserved namespace)."""
+        import cloudpickle
+
+        self.kv_put(name, cloudpickle.dumps(fn), namespace="_funcs")
+
+    def fetch_function(self, name: str):
+        """Resolve a published function; None if absent."""
+        import cloudpickle
+
+        blob = self.kv_get(name, namespace="_funcs")
+        return None if blob is None else cloudpickle.loads(blob)
 
     def ping(self) -> bool:
         return self._rpc.call("ping") == "ok"

@@ -193,3 +193,55 @@ def test_gcs_client_poll_subscription():
         client.close()
     finally:
         server.stop()
+
+
+def test_resource_sync_and_staleness():
+    gcs = GlobalControlStore()
+    server = serve_gcs(gcs)
+    try:
+        c = GcsClient(server.url)
+        c.report_resources("node-a", {"CPU": 8, "TPU": 4})
+        c.report_resources("node-b", {"CPU": 8})
+        view = c.cluster_view()
+        assert view["total"] == {"CPU": 16.0, "TPU": 4.0}
+        assert set(view["nodes"]) == {"node-a", "node-b"}
+        # a stale node ages out of the aggregate (liveness by silence)
+        server.syncer._views["node-b"] = (0.0, {"CPU": 8})
+        view = c.cluster_view()
+        assert view["total"] == {"CPU": 8.0, "TPU": 4.0}
+        assert set(view["nodes"]) == {"node-a"}
+        c.close()
+    finally:
+        server.stop()
+
+
+def test_function_export_cross_process():
+    """Driver exports a function by value; a separate process fetches and
+    runs it (reference function_manager via GCS KV)."""
+    gcs = GlobalControlStore()
+    server = serve_gcs(gcs)
+    try:
+        client = GcsClient(server.url)
+        factor = 7
+
+        def scale(x):
+            return x * factor  # closure travels by value
+
+        client.register_function("scale", scale)
+        code = textwrap.dedent(f"""
+            from ray_tpu.core.gcs_service import GcsClient
+
+            c = GcsClient("{server.url}")
+            fn = c.fetch_function("scale")
+            assert fn(6) == 42, fn(6)
+            assert c.fetch_function("missing") is None
+            print("FUNC-OK")
+        """)
+        out = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            timeout=60,
+        )
+        assert "FUNC-OK" in out.stdout, out.stderr
+        client.close()
+    finally:
+        server.stop()
