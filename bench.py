@@ -76,6 +76,12 @@ def main() -> None:
                 "value": round(tokens_per_sec, 1),
                 "unit": "tokens/s",
                 "vs_baseline": round(mfu / 0.40, 3),
+                # auditability: which chip the peak-FLOPs attribution used
+                "device_kind": device_kind,
+                "peak_flops": peak,
+                "mfu": round(mfu, 4),
+                "batch": BATCH,
+                "seq": SEQ,
             }
         )
     )

@@ -64,6 +64,40 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, *args):  # quiet
         pass
 
+    def do_POST(self):  # noqa: N802 - http.server API
+        """REST job submission (reference: dashboard job module behind
+        `ray job submit`): POST /api/jobs {"entrypoint": "...", ...}."""
+        try:
+            if self.path != "/api/jobs":
+                self._send(404, "not found", "text/plain")
+                return
+            # Require a JSON content type: cross-origin form POSTs (CSRF
+            # "simple requests") cannot set it without a CORS preflight,
+            # so a drive-by page cannot exec commands through this
+            # endpoint (the real Ray dashboard's CVE-2023-48022 class).
+            ctype = (self.headers.get("Content-Type") or "").split(";")[0].strip()
+            if ctype != "application/json":
+                self._send(
+                    415,
+                    json.dumps({"error": "Content-Type must be application/json"}),
+                    "application/json",
+                )
+                return
+            length = int(self.headers.get("Content-Length", 0))
+            payload = json.loads(self.rfile.read(length) or b"{}")
+            from .jobs import default_job_manager
+
+            job_id = default_job_manager().submit(
+                payload["entrypoint"],
+                job_id=payload.get("job_id"),
+                env_vars=payload.get("env_vars"),
+                working_dir=payload.get("working_dir"),
+                metadata=payload.get("metadata"),
+            )
+            self._send(200, json.dumps({"job_id": job_id}), "application/json")
+        except Exception as e:  # noqa: BLE001 - handler must answer something
+            self._send(400, json.dumps({"error": repr(e)}), "application/json")
+
     def do_GET(self):  # noqa: N802 - http.server API
         try:
             if self.path == "/" or self.path == "/index.html":

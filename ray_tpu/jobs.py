@@ -60,6 +60,18 @@ class JobManager:
         working_dir: Optional[str] = None,
         metadata: Optional[Dict[str, str]] = None,
     ) -> str:
+        # Validate BEFORE registering: a late Popen TypeError must not
+        # leave a phantom PENDING job in the table (REST payloads can
+        # carry arbitrary JSON types).
+        if not isinstance(entrypoint, str) or not entrypoint.strip():
+            raise TypeError("entrypoint must be a non-empty string")
+        if env_vars is not None and not all(
+            isinstance(k, str) and isinstance(v, str)
+            for k, v in env_vars.items()
+        ):
+            raise TypeError("env_vars must map str -> str")
+        if job_id is not None and not isinstance(job_id, str):
+            raise TypeError("job_id must be a string")
         job_id = job_id or f"raytpu-job-{uuid.uuid4().hex[:8]}"
         with self._lock:
             if job_id in self._jobs:

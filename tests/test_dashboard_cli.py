@@ -4,6 +4,7 @@ dashboard/, scripts/scripts.py)."""
 import json
 import subprocess
 import sys
+import urllib.error
 import urllib.request
 
 import pytest
@@ -102,3 +103,50 @@ def test_cli_job_submit_wait_and_logs():
     )
     assert failing.returncode == 1
     assert "FAILED" in failing.stdout
+
+
+def test_rest_job_submission():
+    """POST /api/jobs submits a real subprocess job (reference: dashboard
+    job module behind `ray job submit`)."""
+    from ray_tpu.jobs import default_job_manager
+
+    url = start_dashboard(port=0)
+    req = urllib.request.Request(
+        url + "/api/jobs",
+        data=json.dumps({
+            "entrypoint": "python -c 'print(40+2)'",
+            "job_id": "rest-job-1",
+        }).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=30) as r:
+        assert json.loads(r.read())["job_id"] == "rest-job-1"
+    mgr = default_job_manager()
+    assert mgr.wait("rest-job-1", timeout=60).value == "SUCCEEDED"
+    assert "42" in mgr.logs("rest-job-1")
+    # listed through the read API too
+    status, body = _get(url + "/api/jobs")
+    assert any(j["job_id"] == "rest-job-1" for j in json.loads(body))
+    # bad payloads answer 400 without registering a phantom job
+    bad = urllib.request.Request(
+        url + "/api/jobs",
+        data=json.dumps({"entrypoint": ["not", "a", "string"]}).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        urllib.request.urlopen(bad, timeout=10)
+        raise AssertionError("expected HTTP 400")
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
+    assert all(j.entrypoint != ["not", "a", "string"] for j in mgr.list())
+    # CSRF guard: form posts without a JSON content type are rejected
+    form = urllib.request.Request(
+        url + "/api/jobs",
+        data=json.dumps({"entrypoint": "python -c 'print(1)'"}).encode(),
+        headers={"Content-Type": "application/x-www-form-urlencoded"},
+    )
+    try:
+        urllib.request.urlopen(form, timeout=10)
+        raise AssertionError("expected HTTP 415")
+    except urllib.error.HTTPError as e:
+        assert e.code == 415
