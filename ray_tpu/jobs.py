@@ -102,8 +102,10 @@ class JobManager:
                 cwd=working_dir,
                 start_new_session=True,  # own process group: stop kills children
             )
-        except OSError as e:
-            log_file.write(f"failed to launch: {e}\n".encode())
+        except Exception as e:  # noqa: BLE001 - ANY launch failure (OSError,
+            # shlex ValueError, bad working_dir TypeError, ...) must land the
+            # registered job in FAILED — never a phantom PENDING entry
+            log_file.write(f"failed to launch: {e!r}\n".encode())
             log_file.close()
             info.status = JobStatus.FAILED
             info.finished_at = time.time()

@@ -63,6 +63,10 @@ class _Request:
     out: "queue.Queue[Optional[int]]"
     submitted_at: float = dataclasses.field(default_factory=time.perf_counter)
     first_token_at: Optional[float] = None
+    # sampling params (vLLM SamplingParams parity; paged engine honors all)
+    top_k: int = 0          # 0 = disabled
+    top_p: float = 1.0      # 1.0 = disabled
+    stop_token_ids: tuple = ()
 
 
 class ResponseStream:

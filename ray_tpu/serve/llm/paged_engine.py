@@ -149,13 +149,35 @@ class PagedLLMEngine:
         ps = pc.page_size
         K = self.config.decode_block_steps
 
-        def _sample_logits(logits, key, temps):
+        def _sample_logits(logits, key, temps, top_ks, top_ps):
+            """Per-lane temperature + top-k + top-p (nucleus) sampling —
+            vLLM SamplingParams parity, fully vectorized (static shapes:
+            disabled lanes use k=V / p=1.0, which are no-ops)."""
+            vocab = logits.shape[-1]
             greedy = jnp.argmax(logits, axis=-1)
             scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
-            sampled = jax.random.categorical(key, scaled, axis=-1)
+            # ONE full-vocab sort; top-k masks positionally on the sorted
+            # view, and softmax preserves order so the nucleus cumsum runs
+            # on the same view — no second sort in the decode hot loop.
+            desc = jnp.sort(scaled, axis=-1)[:, ::-1]
+            k_idx = jnp.where(top_ks > 0, top_ks, vocab)
+            positions = jnp.arange(vocab)[None, :]
+            masked_desc = jnp.where(positions >= k_idx[:, None], -jnp.inf, desc)
+            p_desc = jax.nn.softmax(masked_desc, axis=-1)
+            cum = jnp.cumsum(p_desc, axis=-1)
+            # keep a token if the cumulative mass BEFORE it is < top_p (the
+            # top token always survives); -inf (top-k-cut) entries never
+            # count as kept or the threshold would collapse to -inf
+            keep = ((cum - p_desc) < top_ps[:, None]) & jnp.isfinite(masked_desc)
+            thresh = jnp.min(
+                jnp.where(keep, masked_desc, jnp.inf), axis=-1, keepdims=True
+            )
+            final = jnp.where(scaled < thresh, -jnp.inf, scaled)
+            sampled = jax.random.categorical(key, final, axis=-1)
             return jnp.where(temps <= 0.0, greedy, sampled).astype(jnp.int32)
 
-        def _decode_block(params, cache, block_tables, tokens, positions, key, temps):
+        def _decode_block(params, cache, block_tables, tokens, positions, key,
+                          temps, top_ks, top_ps):
             """K fused decode+sample steps; tokens never leave the device.
             Output row 0 is the INPUT token vector — a freshly prefilled
             lane's first sampled token rides along with its first block,
@@ -169,7 +191,7 @@ class PagedLLMEngine:
                     page_size=ps,
                 )
                 key_c, sub = jax.random.split(key_c)
-                nxt = _sample_logits(logits, sub, temps)
+                nxt = _sample_logits(logits, sub, temps, top_ks, top_ps)
                 return (cache, nxt, pos_c + 1, key_c), nxt
 
             (cache, final, _, _), toks = jax.lax.scan(
@@ -218,6 +240,10 @@ class PagedLLMEngine:
         prompt_tokens: List[int],
         max_tokens: int = 64,
         temperature: float = 0.0,
+        *,
+        top_k: int = 0,
+        top_p: float = 1.0,
+        stop_token_ids: Optional[List[int]] = None,
     ) -> ResponseStream:
         limit = self.paged.max_slot_tokens
         if len(prompt_tokens) + max_tokens > limit:
@@ -227,12 +253,17 @@ class PagedLLMEngine:
             )
         if not prompt_tokens:
             raise ValueError("empty prompt")
+        if not 0.0 < top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
         request = _Request(
             rid=next(self._rid),
             prompt=list(prompt_tokens),
             max_tokens=max_tokens,
             temperature=temperature,
             out=queue.Queue(),
+            top_k=int(top_k),
+            top_p=float(top_p),
+            stop_token_ids=tuple(stop_token_ids or ()),
         )
         self._queue.put(request)
         _reject_if_dead(self, request)
@@ -330,7 +361,11 @@ class PagedLLMEngine:
                 # async fetch for emission — no host read here.
                 self._key, sub = jax.random.split(self._key)
                 temps = jnp.asarray([request.temperature], dtype=jnp.float32)
-                first_dev = self._sample(logits, sub, temps)
+                first_dev = self._sample(
+                    logits, sub, temps,
+                    jnp.asarray([request.top_k], dtype=jnp.int32),
+                    jnp.asarray([request.top_p], dtype=jnp.float32),
+                )
                 self._tokens_dev = self._set_token(
                     self._tokens_dev, idx, first_dev
                 )
@@ -358,6 +393,8 @@ class PagedLLMEngine:
         bt = np.zeros_like(self.block_tables)  # inactive lanes → scratch
         positions = np.zeros(len(self.slots), dtype=np.int32)
         temps = np.zeros(len(self.slots), dtype=np.float32)
+        top_ks = np.zeros(len(self.slots), dtype=np.int32)
+        top_ps = np.ones(len(self.slots), dtype=np.float32)
         lanes: List[Tuple[int, _Request]] = []
         useful_steps: Dict[int, int] = {}
         for i, slot in enumerate(self.slots):
@@ -388,6 +425,8 @@ class PagedLLMEngine:
             bt[i] = self.block_tables[i]
             positions[i] = slot.position
             temps[i] = slot.request.temperature
+            top_ks[i] = slot.request.top_k
+            top_ps[i] = slot.request.top_p
             useful_steps[i] = useful
             lanes.append((i, slot.request, slot.awaiting_first))
             slot.awaiting_first = False
@@ -402,6 +441,8 @@ class PagedLLMEngine:
             jnp.asarray(positions),
             sub,
             jnp.asarray(temps),
+            jnp.asarray(top_ks),
+            jnp.asarray(top_ps),
         )
         _async_fetch(toks)
         for i, _, _ in lanes:
@@ -516,7 +557,11 @@ class PagedLLMEngine:
         request.out.put(token)
         slot.emit_remaining -= 1
         self.metrics["generated_tokens"] += 1
-        if token == self.config.eos_id or slot.emit_remaining <= 0:
+        if (
+            token == self.config.eos_id
+            or token in request.stop_token_ids
+            or slot.emit_remaining <= 0
+        ):
             slot.finished_emit = True
 
     def _maybe_retire(self, idx: int, request: _Request) -> None:

@@ -208,3 +208,30 @@ def test_engine_death_fails_streams_not_hangs():
             s.result(timeout=30)
     finally:
         engine.shutdown()
+
+
+def test_sampling_params_topk_topp_and_stop():
+    config, params, engine = _tiny_engine()
+    try:
+        prompt = [5, 17, 42, 7]
+        greedy = _greedy_reference(config, params, prompt, 6)
+        # top_k=1 forces greedy even at high temperature
+        got = engine.submit(
+            prompt, max_tokens=6, temperature=5.0, top_k=1
+        ).result(timeout=60)
+        assert got == greedy, (got, greedy)
+        # a vanishingly small nucleus keeps only the argmax token
+        got = engine.submit(
+            prompt, max_tokens=6, temperature=5.0, top_p=1e-6
+        ).result(timeout=60)
+        assert got == greedy, (got, greedy)
+        # per-request stop token ends the stream early
+        stop = greedy[2]
+        got = engine.submit(
+            prompt, max_tokens=6, stop_token_ids=[stop]
+        ).result(timeout=60)
+        assert got == greedy[:3], (got, greedy)
+        with pytest.raises(ValueError, match="top_p"):
+            engine.submit(prompt, max_tokens=2, top_p=0.0)
+    finally:
+        engine.shutdown()
