@@ -169,11 +169,20 @@ class LLMEngine:
         prompt_tokens: List[int],
         max_tokens: int = 64,
         temperature: float = 0.0,
+        *,
+        stop_token_ids: Optional[List[int]] = None,
+        top_k: int = 0,
+        top_p: float = 1.0,
     ) -> ResponseStream:
         if len(prompt_tokens) + max_tokens > self.max_seq:
             raise ValueError(
                 f"prompt({len(prompt_tokens)}) + max_tokens({max_tokens}) exceeds "
                 f"engine max_seq {self.max_seq}"
+            )
+        if top_k or top_p != 1.0:
+            raise ValueError(
+                "top_k/top_p sampling lives in PagedLLMEngine (the dense "
+                "engine samples temperature-only); use PagedEngineConfig"
             )
         request = _Request(
             rid=next(self._rid),
@@ -181,6 +190,7 @@ class LLMEngine:
             max_tokens=max_tokens,
             temperature=temperature,
             out=queue.Queue(),
+            stop_token_ids=tuple(stop_token_ids or ()),
         )
         self._queue.put(request)
         _reject_if_dead(self, request)
@@ -240,7 +250,11 @@ class LLMEngine:
         slot.last_token = first
         self.metrics["prefills"] += 1
         self.metrics["generated_tokens"] += 1
-        if slot.remaining <= 0 or first == self.config.eos_id:
+        if (
+            slot.remaining <= 0
+            or first == self.config.eos_id
+            or first in request.stop_token_ids
+        ):
             self._finish(slot)
 
     def _finish(self, slot: _Slot) -> None:
@@ -276,6 +290,7 @@ class LLMEngine:
             self.metrics["generated_tokens"] += 1
             if (
                 token == self.config.eos_id
+                or token in slot.request.stop_token_ids
                 or slot.remaining <= 0
                 or slot.position >= self.max_seq - 1
             ):

@@ -149,56 +149,69 @@ class PagedLLMEngine:
         ps = pc.page_size
         K = self.config.decode_block_steps
 
-        def _sample_logits(logits, key, temps, top_ks, top_ps):
-            """Per-lane temperature + top-k + top-p (nucleus) sampling —
-            vLLM SamplingParams parity, fully vectorized (static shapes:
-            disabled lanes use k=V / p=1.0, which are no-ops)."""
-            vocab = logits.shape[-1]
+        def _sample_plain(logits, key, temps):
+            """temperature-only / greedy sampling — the common fast path."""
             greedy = jnp.argmax(logits, axis=-1)
             scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
-            # ONE full-vocab sort; top-k masks positionally on the sorted
-            # view, and softmax preserves order so the nucleus cumsum runs
-            # on the same view — no second sort in the decode hot loop.
-            desc = jnp.sort(scaled, axis=-1)[:, ::-1]
+            sampled = jax.random.categorical(key, scaled, axis=-1)
+            return jnp.where(temps <= 0.0, greedy, sampled).astype(jnp.int32)
+
+        def _sample_logits(logits, key, temps, top_ks, top_ps):
+            """Per-lane temperature + top-k + top-p (nucleus) sampling —
+            vLLM SamplingParams parity. POSITIONAL filtering over one
+            argsort: exactly top_k tokens survive even under logit ties,
+            and the nucleus keep-mask scatters back through the sort
+            order (disabled lanes use k=V / p=1.0, which keep all)."""
+            b, vocab = logits.shape
+            greedy = jnp.argmax(logits, axis=-1)
+            scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+            order = jnp.argsort(scaled, axis=-1)[:, ::-1]  # desc indices
+            desc = jnp.take_along_axis(scaled, order, axis=-1)
             k_idx = jnp.where(top_ks > 0, top_ks, vocab)
             positions = jnp.arange(vocab)[None, :]
-            masked_desc = jnp.where(positions >= k_idx[:, None], -jnp.inf, desc)
-            p_desc = jax.nn.softmax(masked_desc, axis=-1)
-            cum = jnp.cumsum(p_desc, axis=-1)
-            # keep a token if the cumulative mass BEFORE it is < top_p (the
-            # top token always survives); -inf (top-k-cut) entries never
-            # count as kept or the threshold would collapse to -inf
-            keep = ((cum - p_desc) < top_ps[:, None]) & jnp.isfinite(masked_desc)
-            thresh = jnp.min(
-                jnp.where(keep, masked_desc, jnp.inf), axis=-1, keepdims=True
+            in_topk = positions < k_idx[:, None]
+            p_desc = jax.nn.softmax(
+                jnp.where(in_topk, desc, -jnp.inf), axis=-1
             )
-            final = jnp.where(scaled < thresh, -jnp.inf, scaled)
+            cum = jnp.cumsum(p_desc, axis=-1)
+            # keep a token if the cumulative mass BEFORE it is < top_p
+            # (the top token always survives: cum - p == 0 there)
+            keep_sorted = in_topk & ((cum - p_desc) < top_ps[:, None])
+            keep = jnp.zeros_like(keep_sorted).at[
+                jnp.arange(b)[:, None], order
+            ].set(keep_sorted)
+            final = jnp.where(keep, scaled, -jnp.inf)
             sampled = jax.random.categorical(key, final, axis=-1)
             return jnp.where(temps <= 0.0, greedy, sampled).astype(jnp.int32)
 
-        def _decode_block(params, cache, block_tables, tokens, positions, key,
-                          temps, top_ks, top_ps):
+        def _make_decode_block(sample_fn):
             """K fused decode+sample steps; tokens never leave the device.
             Output row 0 is the INPUT token vector — a freshly prefilled
             lane's first sampled token rides along with its first block,
             so it never needs a fetch of its own (every materialization
-            costs a full round trip on tunneled TPUs)."""
+            costs a full round trip on tunneled TPUs). Two variants are
+            compiled: plain (temperature only — no per-step vocab sort)
+            and filtered (top-k/top-p); the dispatcher picks per block."""
 
-            def body(carry, _):
-                cache, toks_c, pos_c, key_c = carry
-                logits, cache = paged_decode_step(
-                    params, cache, block_tables, toks_c, pos_c, mc,
-                    page_size=ps,
+            def _decode_block(params, cache, block_tables, tokens, positions,
+                              key, temps, *filters):
+                def body(carry, _):
+                    cache, toks_c, pos_c, key_c = carry
+                    logits, cache = paged_decode_step(
+                        params, cache, block_tables, toks_c, pos_c, mc,
+                        page_size=ps,
+                    )
+                    key_c, sub = jax.random.split(key_c)
+                    nxt = sample_fn(logits, sub, temps, *filters)
+                    return (cache, nxt, pos_c + 1, key_c), nxt
+
+                (cache, final, _, _), toks = jax.lax.scan(
+                    body, (cache, tokens, positions, key), None, length=K
                 )
-                key_c, sub = jax.random.split(key_c)
-                nxt = _sample_logits(logits, sub, temps, top_ks, top_ps)
-                return (cache, nxt, pos_c + 1, key_c), nxt
+                toks = jnp.concatenate([tokens[None], toks], axis=0)  # (K+1, B)
+                return toks, final, cache
 
-            (cache, final, _, _), toks = jax.lax.scan(
-                body, (cache, tokens, positions, key), None, length=K
-            )
-            toks = jnp.concatenate([tokens[None], toks], axis=0)  # (K+1, B)
-            return toks, final, cache
+            return _decode_block
 
         def _chunk(params, cache, page_row, chunk_page_ids, tokens, offset, total):
             return chunk_prefill_step(
@@ -209,7 +222,12 @@ class PagedLLMEngine:
         def _set_token(tokens, idx, value):
             return tokens.at[idx].set(value[0])
 
-        self._decode_block = jax.jit(_decode_block, donate_argnums=(1,))
+        self._decode_block_plain = jax.jit(
+            _make_decode_block(_sample_plain), donate_argnums=(1,)
+        )
+        self._decode_block_filtered = jax.jit(
+            _make_decode_block(_sample_logits), donate_argnums=(1,)
+        )
         self._chunk = jax.jit(_chunk, donate_argnums=(1,))
         self._sample = jax.jit(_sample_logits)
         self._set_token = jax.jit(_set_token, donate_argnums=(0,))
@@ -271,9 +289,12 @@ class PagedLLMEngine:
         return ResponseStream(request)
 
     def generate(
-        self, prompt_tokens: List[int], max_tokens: int = 64, temperature: float = 0.0
+        self, prompt_tokens: List[int], max_tokens: int = 64,
+        temperature: float = 0.0, **sampling,
     ) -> List[int]:
-        return self.submit(prompt_tokens, max_tokens, temperature).result()
+        return self.submit(
+            prompt_tokens, max_tokens, temperature, **sampling
+        ).result()
 
     def shutdown(self) -> None:
         self._stop.set()
@@ -433,7 +454,7 @@ class PagedLLMEngine:
         if not lanes:
             return False
         self._key, sub = jax.random.split(self._key)
-        toks, self._tokens_dev, self.cache = self._decode_block(
+        common = (
             self.params,
             self.cache,
             jnp.asarray(bt),
@@ -441,9 +462,14 @@ class PagedLLMEngine:
             jnp.asarray(positions),
             sub,
             jnp.asarray(temps),
-            jnp.asarray(top_ks),
-            jnp.asarray(top_ps),
         )
+        # all-plain batches (the common case) skip the per-step vocab sort
+        if (top_ks > 0).any() or (top_ps < 1.0).any():
+            toks, self._tokens_dev, self.cache = self._decode_block_filtered(
+                *common, jnp.asarray(top_ks), jnp.asarray(top_ps)
+            )
+        else:
+            toks, self._tokens_dev, self.cache = self._decode_block_plain(*common)
         _async_fetch(toks)
         for i, _, _ in lanes:
             slot = self.slots[i]

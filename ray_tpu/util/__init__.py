@@ -16,3 +16,5 @@ from .state import (  # noqa: F401
     list_tasks,
     summary,
 )
+from .actor_pool import ActorPool  # noqa: F401
+from .queue import Empty, Full, Queue  # noqa: F401
