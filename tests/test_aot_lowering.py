@@ -103,3 +103,44 @@ def test_llama3_8b_state_bytes_scale_with_shards():
     assert total > 25e9  # ~8B fp32 params
     # per-device slice must be well under 1/4 of the total (fsdp=8)
     assert sharded < total / 4, (sharded, total)
+
+
+def test_mixtral_8x7b_moe_lowers_expert_parallel():
+    """BASELINE config 3: the REAL Mixtral 8x7B shapes (8 experts, 32
+    layers, d_ff 14336) lower through the partitioner on a dp2 x ep4
+    mesh with expert-stacked weights sharded on the ep axis."""
+    from ray_tpu.models import moe
+
+    config = moe.mixtral_8x7b()
+    assert config.n_experts == 8 and config.d_ff == 14336
+    mesh = build_mesh(MeshSpec(dp=2, ep=4))
+    rules = default_rules()
+    param_specs = tree_specs(moe.logical_axes(config), rules)
+    abstract = jax.eval_shape(
+        lambda key: moe.init_params(config, key), jax.random.PRNGKey(0)
+    )
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    shardings = jax.tree.map(
+        lambda s: NamedSharding(mesh, s), param_specs
+    )
+    abs_params = jax.tree.map(
+        lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s),
+        abstract, shardings,
+    )
+    batch_sharding = NamedSharding(mesh, PartitionSpec(("dp",), None))
+    abs_tokens = jax.ShapeDtypeStruct(
+        (8, 1024 + 1), jax.numpy.int32, sharding=batch_sharding
+    )
+
+    loss_fn = jax.jit(lambda p, t: moe.moe_loss(p, t, config)[0])
+    hlo = loss_fn.lower(abs_params, abs_tokens).as_text()
+    assert "mhlo.num_partitions = 8" in hlo
+    assert '{"ep"}' in hlo, "no expert-stacked weight is ep-sharded"
+    # the expert-parallel property: per-device expert bytes shrink by ep
+    import numpy as np
+
+    expert_leaf = abstract["blocks"]["we_up"]
+    sh = shardings["blocks"]["we_up"]
+    shard = np.prod(sh.shard_shape(expert_leaf.shape))
+    assert shard * 4 <= np.prod(expert_leaf.shape), "experts not sharded"
