@@ -14,9 +14,14 @@ import time
 from typing import Any, Dict, List, Optional
 
 from .. import api
-from ..core.exceptions import ActorDiedError, RayTpuError
+from ..core.actors import ActorState
 from .deployment import Application, Deployment
 from .router import DeploymentHandle, ReplicaSet
+
+
+def _rkey(replica) -> str:
+    """Stable identity for controller bookkeeping (id() recycles)."""
+    return replica._actor_id.hex()
 
 logger = logging.getLogger("ray_tpu.serve")
 
@@ -77,6 +82,16 @@ class _DeploymentState:
         self.replicas: List[Any] = []
         self.replica_set = ReplicaSet(deployment.name)
         self.last_scale_down = time.time()
+        # readiness/probe tracking for the health pruner (keyed by actor
+        # id hex — stable, unlike id() which recycles addresses)
+        self.started_at: Dict[str, float] = {}
+        self.ready_at: Dict[str, float] = {}
+        self.probe_refs: Dict[str, Any] = {}   # key -> (ref, sent_at)
+        self.last_probe: Dict[str, float] = {}
+
+    def forget(self, key: str) -> None:
+        for d in (self.started_at, self.ready_at, self.probe_refs, self.last_probe):
+            d.pop(key, None)
 
 
 class ServeController:
@@ -179,17 +194,25 @@ class ServeController:
                     logger.exception("reconcile failed for %s", state.deployment.name)
 
     def _reconcile_one(self, state: _DeploymentState) -> None:
-        # prune dead replicas
+        dep = state.deployment
+        # Health/readiness pruning. Probes are NON-BLOCKING (fired on the
+        # health_check_period_s cadence, harvested next rounds) so one
+        # slow replica can never stall reconciliation of every deployment.
+        # A replica still STARTING (its __init__ may legitimately spend
+        # minutes compiling/loading on the chip) is not unhealthy until
+        # startup_grace_s expires — readiness vs liveness, like the
+        # reference's deployment FSM.
         live = []
+        now = time.monotonic()
         for r in state.replicas:
-            try:
-                api.get(r.health.remote(), timeout=10)
+            key = _rkey(r)
+            if self._probe_ok(state, dep, r, key, now):
                 live.append(r)
-            except (ActorDiedError, RayTpuError, Exception):
+            else:
                 _kill_quietly(r)
+                state.forget(key)
         state.replicas = live
         # scale up
-        dep = state.deployment
         while len(state.replicas) < state.target_replicas:
             actor_cls = api.remote(_ReplicaWrapper).options(
                 max_concurrency=dep.config.max_ongoing_requests,
@@ -198,11 +221,54 @@ class ServeController:
                 name=f"serve:{dep.name}#{len(state.replicas)}-{time.monotonic_ns()}",
             )
             replica = actor_cls.remote(dep.cls, state.app.init_args, state.app.init_kwargs)
+            state.started_at[_rkey(replica)] = time.monotonic()
             state.replicas.append(replica)
         # scale down (newest first)
         while len(state.replicas) > state.target_replicas:
-            _kill_quietly(state.replicas.pop())
-        state.replica_set.set_replicas(state.replicas)
+            victim = state.replicas.pop()
+            _kill_quietly(victim)
+            state.forget(_rkey(victim))
+        # route only to READY replicas so requests never queue behind a
+        # replica's __init__; fall back to all replicas during initial
+        # bring-up (an empty set would hard-fail callers instead of
+        # letting the first requests wait out the first compile)
+        ready = [r for r in state.replicas if _rkey(r) in state.ready_at]
+        state.replica_set.set_replicas(ready if ready else state.replicas)
+
+    def _probe_ok(self, state: _DeploymentState, dep, r, key: str, now: float) -> bool:
+        """Advance this replica's probe state machine; False = prune it."""
+        cfg = dep.config
+        pending = state.probe_refs.get(key)
+        if pending is None:
+            last = state.last_probe.get(key, 0.0)
+            if now - last >= cfg.health_check_period_s:
+                state.probe_refs[key] = (r.health.remote(), now)
+                state.last_probe[key] = now
+            return True
+        ref, sent = pending
+        failed = False
+        if ref.is_ready():
+            state.probe_refs.pop(key, None)
+            try:
+                api.get(ref, timeout=1)
+                state.ready_at.setdefault(key, now)
+                return True
+            except Exception:
+                failed = True
+        elif now - sent > cfg.health_check_timeout_s:
+            failed = True  # probe overdue (leave it pending: it completes
+            # the moment a starting replica finishes __init__)
+        if not failed:
+            return True  # probe in flight, within budget
+        still_starting = (
+            key not in state.ready_at
+            and now - state.started_at.get(key, now) < cfg.startup_grace_s
+        )
+        try:
+            hard_dead = r.state() == ActorState.DEAD
+        except Exception:
+            hard_dead = True
+        return still_starting and not hard_dead
 
     def _autoscale(self, state: _DeploymentState) -> None:
         auto = state.deployment.config.autoscaling

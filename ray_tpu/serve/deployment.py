@@ -27,6 +27,13 @@ class DeploymentConfig:
     max_ongoing_requests: int = 8
     autoscaling: Optional[AutoscalingConfig] = None
     health_check_period_s: float = 1.0
+    # probe budget for a RUNNING replica (reference
+    # health_check_timeout_s); slow first-compile models need headroom
+    health_check_timeout_s: float = 30.0
+    # a replica whose __init__ is still running (e.g. compiling / loading
+    # weights on the chip) is NOT unhealthy: give it this long before
+    # health probes can prune it (readiness vs liveness)
+    startup_grace_s: float = 180.0
     resources_per_replica: Optional[Dict[str, float]] = None
     max_restarts: int = 3
 

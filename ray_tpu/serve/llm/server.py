@@ -43,21 +43,55 @@ class LLMServer:
         else:
             self.engine = LLMEngine(config, params, engine_config)
 
+    def _submit(self, payload: Dict[str, Any]):
+        """One place parses the OpenAI-ish payload for both entry points
+        (sampling params flow to the paged engine)."""
+        prompt = payload["prompt_tokens"]
+        kwargs = {}
+        for name, cast in (("top_k", int), ("top_p", float),
+                           ("stop_token_ids", list)):
+            if name in payload:
+                kwargs[name] = cast(payload[name])
+        stream = self.engine.submit(
+            prompt,
+            int(payload.get("max_tokens", 64)),
+            float(payload.get("temperature", 0.0)),
+            **kwargs,
+        )
+        return prompt, stream
+
+    @staticmethod
+    def _usage(prompt, n: int) -> Dict[str, int]:
+        return {
+            "prompt_tokens": len(prompt),
+            "completion_tokens": n,
+            "total_tokens": len(prompt) + n,
+        }
+
     def generate(self, payload: Dict[str, Any]) -> Dict[str, Any]:
         """{"prompt_tokens": [...], "max_tokens": n, "temperature": t} →
         {"tokens": [...], "usage": {...}} (OpenAI-completions shaped)."""
-        prompt = payload["prompt_tokens"]
-        max_tokens = int(payload.get("max_tokens", 64))
-        temperature = float(payload.get("temperature", 0.0))
-        stream = self.engine.submit(prompt, max_tokens, temperature)
+        prompt, stream = self._submit(payload)
         tokens = stream.result()
         return {
             "tokens": tokens,
-            "usage": {
-                "prompt_tokens": len(prompt),
-                "completion_tokens": len(tokens),
-                "total_tokens": len(prompt) + len(tokens),
-            },
+            "usage": self._usage(prompt, len(tokens)),
+            "ttft_s": stream.ttft_s,
+        }
+
+    def stream_generate(self, payload: Dict[str, Any]):
+        """Token-streaming variant (OpenAI stream=true shape): yields one
+        {"token": id} per generated token as the engine produces it, then
+        a final {"done": true, "usage": ...}. Use through a streaming
+        handle (serve streaming) or HTTP ?stream=1."""
+        prompt, stream = self._submit(payload)
+        n = 0
+        for token in stream:
+            n += 1
+            yield {"token": token}
+        yield {
+            "done": True,
+            "usage": self._usage(prompt, n),
             "ttft_s": stream.ttft_s,
         }
 

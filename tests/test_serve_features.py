@@ -133,3 +133,37 @@ def test_multiplex_affinity_prefers_loaded_replica():
     assert sum(1 for x in all_loads if x == "hot") <= 1  # per-replica view
     total_loads = {tuple(o["loads"]) for o in outs}
     assert len(total_loads) <= 2  # at most 2 distinct replicas ever served it
+
+
+def test_llm_token_streaming_over_http():
+    """End-to-end serving story: paged engine -> serve streaming handle ->
+    chunked HTTP, one JSON line per token (OpenAI stream=true shape)."""
+    from ray_tpu.serve.llm import PagedConfig, PagedEngineConfig, build_llm_app
+
+    app = build_llm_app("llama-tiny", name="llm-stream", max_slots=2, paged=True)
+    # shrink the page pool for the tiny model
+    app.init_args = (
+        app.init_args[0], app.init_args[1],
+        PagedEngineConfig(max_slots=2, paged=PagedConfig(
+            page_size=8, num_pages=32, max_pages_per_slot=8, chunk_pages=2)),
+    )
+    handle = serve.run(app)
+    # via the streaming handle
+    stream = handle.options(stream=True).stream_generate.remote(
+        {"prompt_tokens": [5, 6, 7], "max_tokens": 4}
+    )
+    items = [ray_tpu.get(r) for r in stream]
+    assert len(items) == 5  # 4 tokens + final usage record
+    assert all("token" in it for it in items[:4])
+    assert items[-1]["done"] and items[-1]["usage"]["completion_tokens"] == 4
+    # via HTTP chunked
+    port = serve.start_http()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/llm-stream/stream_generate?stream=1",
+        data=json.dumps({"prompt_tokens": [5, 6, 7], "max_tokens": 3}).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=120) as resp:
+        lines = [json.loads(l) for l in resp.read().decode().splitlines() if l]
+    assert len(lines) == 4
+    assert lines[-1]["result"]["done"] is True
