@@ -262,6 +262,12 @@ class Runtime:
                 'num_returns="streaming" requires the thread executor: a '
                 "process worker returns one pickled result, not a live stream"
             )
+        renv = _renv.normalize(runtime_env)
+        if renv and renv.get("working_dir") and executor != "process":
+            raise ValueError(
+                'runtime_env["working_dir"] requires executor="process": a '
+                "thread task cannot change the process-global cwd safely"
+            )
         task_id = TaskID.of(self.job_id)
         n_static = 0 if streaming else num_returns
         return_ids = [ObjectID.for_task_return(task_id, i) for i in range(n_static)]
@@ -277,7 +283,7 @@ class Runtime:
             retry_exceptions=retry_exceptions,
             scheduling_strategy=scheduling_strategy,
             return_ids=return_ids,
-            runtime_env=_renv.normalize(runtime_env),
+            runtime_env=renv,
             executor=executor,
             streaming=streaming,
             stream_max_backlog=stream_max_backlog,

@@ -30,7 +30,7 @@ _env_lock = threading.RLock()
 def normalize(runtime_env: Optional[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
     if not runtime_env:
         return None
-    known = {"env_vars", "py_modules"}
+    known = {"env_vars", "py_modules", "working_dir"}
     unknown = set(runtime_env) - known
     if unknown:
         raise ValueError(
@@ -39,9 +39,15 @@ def normalize(runtime_env: Optional[Dict[str, Any]]) -> Optional[Dict[str, Any]]
     env_vars = runtime_env.get("env_vars") or {}
     if not all(isinstance(k, str) and isinstance(v, str) for k, v in env_vars.items()):
         raise TypeError("env_vars must be Dict[str, str]")
+    working_dir = runtime_env.get("working_dir")
+    if working_dir is not None:
+        working_dir = os.path.abspath(os.fspath(working_dir))
+        if not os.path.isdir(working_dir):
+            raise ValueError(f"working_dir {working_dir!r} is not a directory")
     return {
         "env_vars": dict(env_vars),
         "py_modules": [os.fspath(p) for p in runtime_env.get("py_modules") or []],
+        "working_dir": working_dir,
     }
 
 

@@ -556,7 +556,8 @@ class ClusterScheduler:
                         list(py_modules) + ([existing] if existing else [])
                     )
                 result = get_worker_pool().execute(
-                    spec.func, args, kwargs, env_vars=env_vars
+                    spec.func, args, kwargs, env_vars=env_vars,
+                    working_dir=(spec.runtime_env or {}).get("working_dir"),
                 )
             else:
                 with _renv.applied(spec.runtime_env):

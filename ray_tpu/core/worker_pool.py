@@ -54,6 +54,10 @@ def _worker_main(conn, env_vars: Dict[str, str]) -> None:
     (reference default_worker.py ends in RunTaskExecutionLoop;
     core_worker.h:216)."""
     os.environ.update(env_vars or {})
+    # The configured cwd (working_dir or inherited driver cwd) is part of
+    # the pool's reuse contract: re-assert it per frame so one task's
+    # os.chdir cannot leak into the next task on a reused worker.
+    home_cwd = os.getcwd()
     actor = None  # set by actor_create; then actor_call dispatches onto it
     while True:
         try:
@@ -67,6 +71,11 @@ def _worker_main(conn, env_vars: Dict[str, str]) -> None:
         if kind == "ping":
             conn.send(("ok", cloudpickle.dumps(os.getpid())))
             continue
+        try:
+            if os.getcwd() != home_cwd:
+                os.chdir(home_cwd)
+        except OSError:
+            pass
         try:
             if kind == "task":
                 func, args, kwargs = cloudpickle.loads(msg[1])
@@ -105,27 +114,35 @@ class WorkerProcess:
     it breaks for stdin/REPL drivers and re-executes unguarded user code).
     """
 
-    def __init__(self, env_vars: Optional[Dict[str, str]] = None):
+    def __init__(self, env_vars: Optional[Dict[str, str]] = None,
+                 working_dir: Optional[str] = None):
         import socket
         import subprocess
         import sys
         from multiprocessing.connection import Connection
 
         parent_sock, child_sock = socket.socketpair()
-        self.env_key = _env_key(env_vars)
+        self.env_key = _env_key(env_vars, working_dir)
         env = dict(os.environ)
         env.update(env_vars or {})
         # The child must resolve by-reference pickles (module-level
-        # functions/classes) against the same import universe.
-        paths = [p for p in sys.path if p] + (
+        # functions/classes) against the same import universe; a
+        # working_dir leads the path (reference working_dir semantics:
+        # the job's files are importable AND cwd). sys.path's '' entry
+        # means "driver cwd" — materialize it, or a working_dir child
+        # (whose cwd differs) loses modules importable from the driver.
+        paths = [p or os.getcwd() for p in sys.path] + (
             [env["PYTHONPATH"]] if env.get("PYTHONPATH") else []
         )
+        if working_dir:
+            paths.insert(0, working_dir)
         env["PYTHONPATH"] = os.pathsep.join(paths)
         child_fd = child_sock.fileno()
         self.proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu.core.worker_main", str(child_fd)],
             pass_fds=[child_fd],
             env=env,
+            cwd=working_dir,
             close_fds=True,
         )
         child_sock.close()
@@ -226,8 +243,9 @@ class WorkerProcess:
             self.kill()
 
 
-def _env_key(env_vars: Optional[Dict[str, str]]) -> Tuple[Tuple[str, str], ...]:
-    return tuple(sorted((env_vars or {}).items()))
+def _env_key(env_vars: Optional[Dict[str, str]],
+             working_dir: Optional[str] = None):
+    return (tuple(sorted((env_vars or {}).items())), working_dir)
 
 
 class ProcessWorkerPool:
@@ -261,8 +279,9 @@ class ProcessWorkerPool:
                          name="ray_tpu-worker-reaper").start()
 
     def acquire(self, env_vars: Optional[Dict[str, str]] = None,
-                timeout: Optional[float] = None) -> WorkerProcess:
-        key = _env_key(env_vars)
+                timeout: Optional[float] = None,
+                working_dir: Optional[str] = None) -> WorkerProcess:
+        key = _env_key(env_vars, working_dir)
         deadline = None if timeout is None else time.monotonic() + timeout
         with self._free:
             while True:
@@ -289,7 +308,7 @@ class ProcessWorkerPool:
                     raise TimeoutError("no process worker available")
                 self._free.wait(timeout=0.2 if remaining is None else min(0.2, remaining))
         try:
-            worker = WorkerProcess(dict(env_vars or {}))
+            worker = WorkerProcess(dict(env_vars or {}), working_dir=working_dir)
         except BaseException:
             with self._free:
                 self._spawning -= 1
@@ -326,10 +345,11 @@ class ProcessWorkerPool:
         self._idle[:] = keep
 
     def execute(self, func, args, kwargs,
-                env_vars: Optional[Dict[str, str]] = None) -> Any:
+                env_vars: Optional[Dict[str, str]] = None,
+                working_dir: Optional[str] = None) -> Any:
         """Run one task on a pooled worker (blocking). Crash → retriable
         WorkerCrashedError; user exception → TaskError with remote tb."""
-        worker = self.acquire(env_vars)
+        worker = self.acquire(env_vars, working_dir=working_dir)
         crashed = False
         try:
             return worker.request("task", (func, args, kwargs))

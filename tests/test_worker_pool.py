@@ -191,3 +191,72 @@ def test_process_actor_crash_restarts(runtime):
             time.sleep(0.1)
     else:
         raise AssertionError("actor did not restart")
+
+
+def test_working_dir_runtime_env(tmp_path, runtime):
+    """runtime_env working_dir (reference runtime_env plugin): the process
+    worker runs with cwd = working_dir and can import files there."""
+    (tmp_path / "localmod.py").write_text("MAGIC = 'from-working-dir'\n")
+
+    @api.remote(executor="process", runtime_env={"working_dir": str(tmp_path)})
+    def probe():
+        import os
+
+        import localmod  # resolvable only via the working_dir
+
+        return os.getcwd(), localmod.MAGIC
+
+    cwd, magic = api.get(probe.remote(), timeout=60)
+    assert cwd == str(tmp_path)
+    assert magic == "from-working-dir"
+
+    # workers are keyed by working_dir: a different dir gets a fresh worker
+    other = tmp_path / "other"
+    other.mkdir()
+
+    @api.remote(executor="process", runtime_env={"working_dir": str(other)})
+    def where():
+        import os
+
+        return os.getcwd()
+
+    assert api.get(where.remote(), timeout=60) == str(other)
+
+    # thread tasks must reject working_dir loudly (process-global cwd)
+    @api.remote(runtime_env={"working_dir": str(tmp_path)})
+    def threaded():
+        return 1
+
+    with pytest.raises(ValueError, match="process"):
+        threaded.remote()
+
+    with pytest.raises(ValueError, match="not a directory"):
+        @api.remote(executor="process",
+                        runtime_env={"working_dir": "/definitely/missing"})
+        def bad():
+            return 1
+
+        bad.remote()
+
+
+def test_working_dir_reasserted_on_reuse(tmp_path, runtime):
+    """A task's os.chdir must not leak into the next task on a reused
+    worker — cwd is part of the pool's reuse contract."""
+    wd = tmp_path / "wd"
+    wd.mkdir()
+
+    @api.remote(executor="process", runtime_env={"working_dir": str(wd)})
+    def chdir_away():
+        import os
+
+        os.chdir("/tmp")
+        return os.getcwd()
+
+    @api.remote(executor="process", runtime_env={"working_dir": str(wd)})
+    def where():
+        import os
+
+        return os.getcwd()
+
+    assert api.get(chdir_away.remote(), timeout=60) == "/tmp"
+    assert api.get(where.remote(), timeout=60) == str(wd)
