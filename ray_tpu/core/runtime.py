@@ -215,6 +215,11 @@ class Runtime:
     ) -> Tuple[List[ObjectRef], List[ObjectRef]]:
         if num_returns > len(refs):
             raise ValueError("num_returns exceeds number of refs")
+        if num_returns == 0:
+            # nothing to wait for: the per-ref ready callbacks below are
+            # the only thing that sets the event, so an empty wait would
+            # otherwise block forever
+            return [], list(refs)
         done_event = threading.Event()
         ready_count = [0]
         lock = threading.Lock()

@@ -381,6 +381,27 @@ class Dataset:
         while window:
             yield window.popleft()
 
+    def iter_torch_batches(
+        self,
+        batch_size: int,
+        *,
+        drop_last: bool = True,
+        columns: Optional[List[str]] = None,
+        dtypes: Optional[Dict[str, Any]] = None,
+    ) -> Iterator[Dict[str, Any]]:
+        """Batches as torch tensors (reference Dataset.iter_torch_batches,
+        dataset.py:4516) — CPU tensors here; move to device in the loop."""
+        import torch
+
+        for batch in self.iter_batches(batch_size, drop_last=drop_last):
+            out = {}
+            for k in (columns or batch.keys()):
+                t = torch.as_tensor(np.ascontiguousarray(batch[k]))
+                if dtypes and k in dtypes:
+                    t = t.to(dtypes[k])
+                out[k] = t
+            yield out
+
     def take(self, n: int = 20) -> List[Any]:
         out: List[Any] = []
         for row in self.iter_rows():

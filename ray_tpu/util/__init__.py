@@ -18,3 +18,4 @@ from .state import (  # noqa: F401
 )
 from .actor_pool import ActorPool  # noqa: F401
 from .queue import Empty, Full, Queue  # noqa: F401
+from . import multiprocessing  # noqa: F401

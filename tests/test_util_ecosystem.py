@@ -105,3 +105,57 @@ def test_queue_blocking_get_wakes_on_put():
         assert result == ["wake"]
     finally:
         q.shutdown()
+
+
+def _square(x):
+    return x * x
+
+
+def _addmul(a, b):
+    return a + b, a * b
+
+
+def test_multiprocessing_pool_map():
+    from ray_tpu.util.multiprocessing import Pool
+
+    with Pool(processes=3) as pool:
+        assert pool.map(_square, range(8)) == [x * x for x in range(8)]
+        assert pool.starmap(_addmul, [(1, 2), (3, 4)]) == [(3, 2), (7, 12)]
+        assert pool.apply(_square, (9,)) == 81
+        async_res = pool.map_async(_square, [2, 3])
+        assert async_res.get(timeout=60) == [4, 9]
+        # process executor = real OS processes, not the driver
+        import os
+
+        pids = pool.map(lambda _: os.getpid(), range(3))
+        assert all(p != os.getpid() for p in pids)
+    with pytest.raises(ValueError, match="closed"):
+        pool.map(_square, [1])
+
+
+def test_dataset_iter_torch_batches():
+    import torch
+
+    from ray_tpu import data
+
+    ds = data.range(16, num_blocks=2).map_batches(
+        lambda b: {"x": b["item"], "y": b["item"] * 2.0}
+    )
+    batches = list(ds.iter_torch_batches(4, dtypes={"y": torch.float32}))
+    assert len(batches) == 4
+    assert isinstance(batches[0]["x"], torch.Tensor)
+    assert batches[0]["y"].dtype == torch.float32
+    assert batches[1]["x"].tolist() == [4, 5, 6, 7]
+
+
+def test_empty_waits_do_not_hang():
+    from ray_tpu.util.multiprocessing import Pool
+
+    with Pool(2) as pool:
+        res = pool.map_async(_square, [])
+        res.wait(timeout=5)  # must return immediately, not deadlock
+        assert res.get(timeout=5) == []
+        assert res.ready()
+    # the underlying primitive: wait over zero refs returns at once
+    ready, rest = ray_tpu.wait([], num_returns=0, timeout=5)
+    assert ready == [] and rest == []
