@@ -132,6 +132,7 @@ _DEFAULT_ACTOR_OPTIONS: Dict[str, Any] = dict(
     lifetime=None,
     scheduling_strategy="DEFAULT",
     executor="thread",  # "process" → dedicated OS worker process
+    runtime_env=None,  # env_vars / working_dir for process actors
 )
 
 
@@ -215,6 +216,7 @@ class ActorClass:
             scheduling_strategy=opts["scheduling_strategy"],
             lifetime=opts.get("lifetime"),
             executor=opts.get("executor", "thread"),
+            runtime_env=opts.get("runtime_env"),
         )
 
     def __call__(self, *args, **kwargs):

@@ -92,6 +92,7 @@ class ActorRuntime:
         registered_name: Optional[str] = None,
         registered_namespace: str = "default",
         executor: str = "thread",
+        runtime_env: Optional[Dict[str, Any]] = None,
     ):
         self.actor_id = actor_id
         self.cls = cls
@@ -113,6 +114,7 @@ class ActorRuntime:
         # child; a crash is a restartable actor death). One pipe ⇒ calls
         # serialize even with max_concurrency > 1.
         self.executor = executor
+        self.runtime_env = runtime_env  # normalized; process actors only
         self._worker = None  # WorkerProcess when executor == "process"
         self._incarnation = 0  # bumped on every (re)start; see _RestartSignal
 
@@ -211,7 +213,24 @@ class ActorRuntime:
                 if self.executor == "process":
                     from .worker_pool import WorkerProcess
 
-                    self._worker = WorkerProcess()
+                    import os as _os
+
+                    renv = self.runtime_env or {}
+                    env_vars = dict(renv.get("env_vars") or {})
+                    py_modules = renv.get("py_modules") or []
+                    if py_modules:
+                        # same merge the process-task path does: py_modules
+                        # must be importable in the child
+                        existing = env_vars.get(
+                            "PYTHONPATH", _os.environ.get("PYTHONPATH", "")
+                        )
+                        env_vars["PYTHONPATH"] = _os.pathsep.join(
+                            list(py_modules) + ([existing] if existing else [])
+                        )
+                    self._worker = WorkerProcess(
+                        env_vars,
+                        working_dir=renv.get("working_dir"),
+                    )
                     self._worker.request(
                         "actor_create",
                         (self.cls, self.init_args, self.init_kwargs),

@@ -337,7 +337,16 @@ class Runtime:
         scheduling_strategy: Any = "DEFAULT",
         lifetime: Optional[str] = None,
         executor: str = "thread",
+        runtime_env: Any = None,
     ) -> "ActorHandle":
+        from . import runtime_env as _renv
+
+        renv = _renv.normalize(runtime_env)
+        if renv and executor != "process":
+            raise ValueError(
+                "actor runtime_env requires executor='process' (thread "
+                "actors share the driver's process environment)"
+            )
         actor_id = ActorID.of(self.job_id)
         handle = ActorHandle(actor_id, self)
         # Reserve the name BEFORE spawning the actor so a duplicate name
@@ -371,6 +380,7 @@ class Runtime:
                 registered_name=name,
                 registered_namespace=namespace,
                 executor=executor,
+                runtime_env=renv,
             )
         except BaseException:
             if name:

@@ -385,7 +385,7 @@ class Dataset:
         self,
         batch_size: int,
         *,
-        drop_last: bool = True,
+        drop_last: bool = False,  # reference default: keep the partial tail
         columns: Optional[List[str]] = None,
         dtypes: Optional[Dict[str, Any]] = None,
     ) -> Iterator[Dict[str, Any]]:

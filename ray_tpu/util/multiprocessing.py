@@ -8,7 +8,6 @@ GIL-free parallelism for CPU functions) with bounded in-flight chunks.
 
 from __future__ import annotations
 
-import itertools
 from typing import Any, Callable, Iterable, List, Optional
 
 from .. import api

@@ -260,3 +260,56 @@ def test_working_dir_reasserted_on_reuse(tmp_path, runtime):
 
     assert api.get(chdir_away.remote(), timeout=60) == "/tmp"
     assert api.get(where.remote(), timeout=60) == str(wd)
+
+
+def test_process_actor_runtime_env(tmp_path, runtime):
+    """Process actors get env_vars + working_dir isolation (reference:
+    actor-level runtime_env)."""
+    wd = tmp_path / "actor_wd"
+    wd.mkdir()
+    (wd / "cfgmod.py").write_text("NAME = 'actor-env'\n")
+
+    @api.remote(executor="process", max_restarts=0,
+                runtime_env={"env_vars": {"MY_TOKEN": "s3cr3t"},
+                             "working_dir": str(wd)})
+    class Svc:
+        def probe(self):
+            import os
+
+            import cfgmod
+
+            return os.getcwd(), os.environ["MY_TOKEN"], cfgmod.NAME
+
+    svc = Svc.remote()
+    cwd, token, name = api.get(svc.probe.remote(), timeout=60)
+    assert cwd == str(wd)
+    assert token == "s3cr3t"
+    assert name == "actor-env"
+    # the driver's environment is untouched
+    import os
+
+    assert "MY_TOKEN" not in os.environ
+
+    # thread actors reject runtime_env loudly
+    @api.remote(runtime_env={"env_vars": {"X": "1"}})
+    class Threaded:
+        pass
+
+    with pytest.raises(ValueError, match="process"):
+        Threaded.remote()
+
+
+def test_process_actor_py_modules(tmp_path, runtime):
+    lib = tmp_path / "lib"
+    lib.mkdir()
+    (lib / "shippedmod.py").write_text("VALUE = 123\n")
+
+    @api.remote(executor="process",
+                runtime_env={"py_modules": [str(lib)]})
+    class Uses:
+        def val(self):
+            import shippedmod
+
+            return shippedmod.VALUE
+
+    assert api.get(Uses.remote().val.remote(), timeout=60) == 123
