@@ -235,3 +235,33 @@ def test_sampling_params_topk_topp_and_stop():
             engine.submit(prompt, max_tokens=2, top_p=0.0)
     finally:
         engine.shutdown()
+
+
+def test_plain_decode_path_selected_for_greedy_batches():
+    """Perf guard: all-greedy batches must take the sort-free plain block;
+    a top-k/top-p lane switches the dispatch to the filtered block."""
+    config, params, engine = _tiny_engine()
+    try:
+        counts = {"plain": 0, "filtered": 0}
+        orig_plain = engine._decode_block_plain
+        orig_filtered = engine._decode_block_filtered
+
+        def plain(*a):
+            counts["plain"] += 1
+            return orig_plain(*a)
+
+        def filtered(*a):
+            counts["filtered"] += 1
+            return orig_filtered(*a)
+
+        engine._decode_block_plain = plain
+        engine._decode_block_filtered = filtered
+
+        engine.generate([1, 2, 3], max_tokens=6)  # greedy
+        assert counts["plain"] >= 1 and counts["filtered"] == 0
+
+        engine.submit([1, 2, 3], max_tokens=6, top_k=2,
+                      temperature=1.0).result(timeout=60)
+        assert counts["filtered"] >= 1
+    finally:
+        engine.shutdown()
