@@ -5,23 +5,54 @@ Reference parity: the object manager data plane
 Push/Pull of chunked buffers, object_manager.proto:62, ObjectBufferPool
 chunking, pull_manager.h:57). TPU inversion: device arrays move between
 chips over ICI inside compiled programs, so this plane only carries
-HOST-memory objects between runtime processes (driver ↔ job drivers ↔
-multihost gang members) — pickled values in fixed-size chunks so a large
-object never needs one contiguous 2 GiB frame and progress is incremental
-like the reference's buffer pool.
+HOST-memory objects between runtime processes (driver ↔ node agents ↔
+multihost gang members).
+
+Memory model: values are pickled with protocol 5 and out-of-band
+buffers, so a numpy/bytes payload is never copied into one monolithic
+pickle blob — the sender serves windows directly out of the original
+buffers (zero-copy memoryview slicing, like the reference's
+ObjectBufferPool serving chunks from one mmap), and the receiver
+assembles each buffer into a preallocated bytearray then reconstructs
+with ``pickle.loads(meta, buffers=...)`` — peak memory stays ~1× the
+object on both sides. Transfers a peer abandons mid-flight are swept by
+a TTL so a dead client can never pin gigabytes in the serving process.
 """
 
 from __future__ import annotations
 
 import pickle
 import threading
+import time
 import uuid
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 from .ids import ObjectID
 from .rpc import RpcClient, RpcServer
 
 CHUNK_BYTES = 4 << 20  # 4 MiB, the reference's object-manager chunk scale
+TRANSFER_TTL_S = 120.0  # sweep abandoned transfers after this long
+
+
+def _dumps_oob(value: Any) -> Tuple[bytes, List[memoryview]]:
+    """Pickle with out-of-band buffers: returns (meta, raw buffers)."""
+    buffers: List[pickle.PickleBuffer] = []
+    meta = pickle.dumps(
+        value, protocol=pickle.HIGHEST_PROTOCOL, buffer_callback=buffers.append
+    )
+    return meta, [pb.raw() for pb in buffers]
+
+
+class _Transfer:
+    """One in-flight transfer: the meta pickle plus its raw buffers
+    (outgoing) or preallocated assembly bytearrays (incoming)."""
+
+    __slots__ = ("meta", "buffers", "last_active")
+
+    def __init__(self, meta: Any, buffers: List[Any]):
+        self.meta = meta
+        self.buffers = buffers
+        self.last_active = time.monotonic()
 
 
 class ObjectTransferServer:
@@ -30,107 +61,162 @@ class ObjectTransferServer:
     def __init__(self, object_store, host: str = "127.0.0.1", port: int = 0):
         self._store = object_store
         self._lock = threading.Lock()
-        # transfer_id -> outstanding pickled payload (chunk reads index it)
-        self._outgoing: Dict[str, bytes] = {}
+        self._outgoing: Dict[str, _Transfer] = {}
+        self._incoming: Dict[str, _Transfer] = {}
         self._server = RpcServer(
             {
                 "ping": lambda: "ok",
                 "pull_begin": self._pull_begin,
                 "pull_chunk": self._pull_chunk,
-                "push": self._push,
+                "pull_end": self._pull_end,
+                "push_begin": self._push_begin,
+                "push_chunk": self._push_chunk,
+                "push_end": self._push_end,
             },
             host=host,
             port=port,
         )
         self.address = self._server.url
 
+    def _sweep(self, now: float) -> None:
+        """Drop transfers older than the TTL (caller holds the lock). A
+        client that died mid-pull must not pin its payload forever."""
+        for table in (self._outgoing, self._incoming):
+            stale = [
+                tid for tid, tr in table.items()
+                if now - tr.last_active > TRANSFER_TTL_S
+            ]
+            for tid in stale:
+                del table[tid]
+
     # ----------------------------------------------------------------- pull
 
     def _pull_begin(self, oid_hex: str, timeout: float = 30.0) -> Dict[str, Any]:
         value = self._store.get(ObjectID(oid_hex), timeout=timeout)
-        payload = pickle.dumps(value, protocol=pickle.HIGHEST_PROTOCOL)
+        meta, buffers = _dumps_oob(value)
         transfer_id = uuid.uuid4().hex
+        now = time.monotonic()
         with self._lock:
-            self._outgoing[transfer_id] = payload
-        num_chunks = max(1, -(-len(payload) // CHUNK_BYTES))
+            self._sweep(now)
+            self._outgoing[transfer_id] = _Transfer(meta, buffers)
         return {
             "transfer_id": transfer_id,
-            "nbytes": len(payload),
-            "num_chunks": num_chunks,
+            "meta_nbytes": len(meta),
+            "buffer_nbytes": [len(b) for b in buffers],
         }
 
-    def _pull_chunk(self, transfer_id: str, index: int, last: bool) -> bytes:
+    def _pull_chunk(self, transfer_id: str, buf_index: int, offset: int) -> bytes:
+        """Serve one window. buf_index -1 addresses the meta pickle,
+        0..N-1 the out-of-band buffers. Windows are zero-copy views of
+        the original object's memory until the final bytes() for the
+        wire."""
         with self._lock:
-            payload = self._outgoing.get(transfer_id)
-            if payload is None:
-                raise KeyError(f"unknown transfer {transfer_id!r}")
-            if last:
-                self._outgoing.pop(transfer_id, None)
-        return payload[index * CHUNK_BYTES : (index + 1) * CHUNK_BYTES]
+            tr = self._outgoing.get(transfer_id)
+        if tr is None:
+            raise KeyError(f"unknown transfer {transfer_id!r}")
+        tr.last_active = time.monotonic()  # a slow-but-live pull never expires
+        src = tr.meta if buf_index < 0 else tr.buffers[buf_index]
+        return bytes(memoryview(src)[offset : offset + CHUNK_BYTES])
+
+    def _pull_end(self, transfer_id: str) -> bool:
+        with self._lock:
+            return self._outgoing.pop(transfer_id, None) is not None
 
     # ----------------------------------------------------------------- push
 
-    def _push(self, oid_hex: str, chunk: bytes, index: int, total_chunks: int) -> bool:
-        """Receive one chunk; on the last, unpickle and seal locally
-        (reference HandlePush + buffer pool assembly)."""
-        key = f"_incoming_{oid_hex}"
+    def _push_begin(self, oid_hex: str, meta_nbytes: int,
+                    buffer_nbytes: List[int]) -> str:
+        transfer_id = uuid.uuid4().hex
+        now = time.monotonic()
         with self._lock:
-            buf = self._outgoing.setdefault(key, b"")
-            if index * CHUNK_BYTES != len(buf):
-                raise ValueError(
-                    f"out-of-order push chunk {index} for {oid_hex}"
-                )
-            buf += chunk
-            self._outgoing[key] = buf
-            done = index + 1 >= total_chunks
-            if done:
-                self._outgoing.pop(key, None)
-        if done:
-            value = pickle.loads(buf)
-            oid = ObjectID(oid_hex)
-            self._store.create(oid)
-            self._store.seal(oid, value)
-        return done
+            self._sweep(now)
+            self._incoming[transfer_id] = _Transfer(
+                bytearray(meta_nbytes), [bytearray(n) for n in buffer_nbytes]
+            )
+        return transfer_id
+
+    def _push_chunk(self, transfer_id: str, buf_index: int, offset: int,
+                    chunk: bytes) -> None:
+        with self._lock:
+            tr = self._incoming.get(transfer_id)
+        if tr is None:
+            raise KeyError(f"unknown transfer {transfer_id!r}")
+        tr.last_active = time.monotonic()
+        dst = tr.meta if buf_index < 0 else tr.buffers[buf_index]
+        if offset + len(chunk) > len(dst):
+            # bytearray slice-assign past the end APPENDS; reject instead
+            raise ValueError(
+                f"push chunk [{offset}:{offset + len(chunk)}] exceeds "
+                f"buffer of {len(dst)} bytes"
+            )
+        dst[offset : offset + len(chunk)] = chunk
+
+    def _push_end(self, transfer_id: str, oid_hex: str) -> bool:
+        with self._lock:
+            tr = self._incoming.pop(transfer_id, None)
+        if tr is None:
+            raise KeyError(f"unknown transfer {transfer_id!r}")
+        value = pickle.loads(bytes(tr.meta), buffers=tr.buffers)
+        oid = ObjectID(oid_hex)
+        self._store.create(oid)
+        self._store.seal(oid, value)
+        return True
 
     def stop(self) -> None:
         self._server.stop()
 
 
-def fetch_object(address: str, oid_hex: str, *, timeout: float = 30.0) -> Any:
+def _windows(nbytes: int):
+    offset = 0
+    while offset < nbytes:  # zero-length buffers need no transfer at all
+        yield offset
+        offset += CHUNK_BYTES
+
+
+def fetch_object(address: str, oid_hex: str, *, timeout: float = 30.0,
+                 client: Optional[RpcClient] = None) -> Any:
     """Pull one object from a remote ObjectTransferServer (reference
     PullManager: locate by owner, fetch chunked, reassemble)."""
-    client = RpcClient(address, timeout=timeout)
+    own = client is None
+    client = client or RpcClient(address, timeout=timeout)
     try:
-        meta = client.call("pull_begin", oid_hex, timeout)
-        parts = []
-        for i in range(meta["num_chunks"]):
-            parts.append(
-                client.call(
-                    "pull_chunk", meta["transfer_id"], i,
-                    i + 1 >= meta["num_chunks"],
-                )
-            )
-        payload = b"".join(parts)
-        if len(payload) != meta["nbytes"]:
-            raise RuntimeError(
-                f"short transfer: {len(payload)} of {meta['nbytes']} bytes"
-            )
-        return pickle.loads(payload)
+        info = client.call("pull_begin", oid_hex, timeout)
+        tid = info["transfer_id"]
+        meta = bytearray(info["meta_nbytes"])
+        buffers = [bytearray(n) for n in info["buffer_nbytes"]]
+        for buf_index, dst in [(-1, meta)] + list(enumerate(buffers)):
+            for offset in _windows(len(dst)):
+                chunk = client.call("pull_chunk", tid, buf_index, offset)
+                dst[offset : offset + len(chunk)] = chunk
+        client.call("pull_end", tid)
+        return pickle.loads(bytes(meta), buffers=buffers)
     finally:
-        client.close()
+        if own:
+            client.close()
 
 
-def push_object(address: str, oid_hex: str, value: Any, *, timeout: float = 30.0) -> None:
+def push_object(address: str, oid_hex: str, value: Any, *,
+                timeout: float = 30.0,
+                client: Optional[RpcClient] = None) -> None:
     """Push one object into a remote runtime's store (reference
-    PushManager)."""
-    payload = pickle.dumps(value, protocol=pickle.HIGHEST_PROTOCOL)
-    total = max(1, -(-len(payload) // CHUNK_BYTES))
-    client = RpcClient(address, timeout=timeout)
+    PushManager). Windows slice the original buffers — no monolithic
+    payload copy on the sender."""
+    meta, buffers = _dumps_oob(value)
+    own = client is None
+    client = client or RpcClient(address, timeout=timeout)
     try:
-        for i in range(total):
-            client.call(
-                "push", oid_hex,
-                payload[i * CHUNK_BYTES : (i + 1) * CHUNK_BYTES], i, total,
-            )
+        tid = client.call(
+            "push_begin", oid_hex, len(meta), [len(b) for b in buffers]
+        )
+        for buf_index, src in [(-1, memoryview(meta))] + [
+            (i, memoryview(b)) for i, b in enumerate(buffers)
+        ]:
+            for offset in _windows(len(src)):
+                client.call(
+                    "push_chunk", tid, buf_index, offset,
+                    bytes(src[offset : offset + CHUNK_BYTES]),
+                )
+        client.call("push_end", tid, oid_hex)
     finally:
-        client.close()
+        if own:
+            client.close()

@@ -178,9 +178,6 @@ class RpcClient:
                     _send_frame(self._sock, payload)
                     frame = _recv_frame(self._sock)
                 status, value = pickle.loads(frame)
-                if status == "err":
-                    raise value
-                return value
             except (OSError, RpcError) as exc:
                 last = exc
                 with self._lock:
@@ -192,6 +189,15 @@ class RpcClient:
                         self._sock = None
                 if attempt < self._retries:
                     time.sleep(self._retry_wait * (attempt + 1))
+                continue
+            # Server-side handler errors re-raise OUTSIDE the retried
+            # try: a handler exception that subclasses OSError (e.g.
+            # FileNotFoundError from a working_dir handler) must not be
+            # mistaken for a transport failure — that would tear down a
+            # healthy connection and re-execute non-idempotent handlers.
+            if status == "err":
+                raise value
+            return value
         raise RpcError(f"rpc to {self._addr} failed after retries: {last!r}")
 
     def close(self) -> None:

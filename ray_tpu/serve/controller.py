@@ -16,14 +16,7 @@ from typing import Any, Dict, List, Optional
 from .. import api
 from ..core.actors import ActorState
 from .deployment import Application, Deployment
-from .router import DeploymentHandle, ReplicaSet
-
-
-def _rkey(replica) -> str:
-    """Stable identity for controller bookkeeping (id() recycles)."""
-    return replica._actor_id.hex()
-
-logger = logging.getLogger("ray_tpu.serve")
+from .router import _rkey, DeploymentHandle, ReplicaSet
 
 
 class _ReplicaWrapper:

@@ -222,6 +222,16 @@ class PagedLLMEngine:
         def _set_token(tokens, idx, value):
             return tokens.at[idx].set(value[0])
 
+        def _merge_tokens(old, new, mask):
+            """Merge a decode block's final sampled tokens back into the
+            engine token vector ONLY for lanes that were dispatched in
+            that block. Excluded lanes (page-stalled mid-decode, still
+            prefilling) keep their pending input token — the block
+            sampled garbage for them (attention over the scratch page)
+            and writing it back would silently corrupt their stream when
+            they unstall."""
+            return jnp.where(mask, new, old)
+
         self._decode_block_plain = jax.jit(
             _make_decode_block(_sample_plain), donate_argnums=(1,)
         )
@@ -231,6 +241,7 @@ class PagedLLMEngine:
         self._chunk = jax.jit(_chunk, donate_argnums=(1,))
         self._sample = jax.jit(_sample_logits)
         self._set_token = jax.jit(_set_token, donate_argnums=(0,))
+        self._merge_tokens = jax.jit(_merge_tokens, donate_argnums=(0,))
         self._tokens_dev = jnp.zeros((self.config.max_slots,), jnp.int32)
         self._key = jax.random.PRNGKey(0)
         self.metrics: Dict[str, float] = {
@@ -465,11 +476,19 @@ class PagedLLMEngine:
         )
         # all-plain batches (the common case) skip the per-step vocab sort
         if (top_ks > 0).any() or (top_ps < 1.0).any():
-            toks, self._tokens_dev, self.cache = self._decode_block_filtered(
+            toks, final, self.cache = self._decode_block_filtered(
                 *common, jnp.asarray(top_ks), jnp.asarray(top_ps)
             )
         else:
-            toks, self._tokens_dev, self.cache = self._decode_block_plain(*common)
+            toks, final, self.cache = self._decode_block_plain(*common)
+        # Per-lane merge: lanes excluded from this dispatch keep their
+        # pending token (see _merge_tokens docstring).
+        mask = np.zeros(len(self.slots), dtype=bool)
+        for i, _, _ in lanes:
+            mask[i] = True
+        self._tokens_dev = self._merge_tokens(
+            self._tokens_dev, final, jnp.asarray(mask)
+        )
         _async_fetch(toks)
         for i, _, _ in lanes:
             slot = self.slots[i]

@@ -14,6 +14,13 @@ from typing import Any, Dict, List, Optional
 from .. import api
 
 
+def _rkey(replica: Any) -> str:
+    """Stable replica identity: the actor id. id() recycles once a
+    swapped-out handle is GC'd, which let a new replica inherit false
+    multiplex affinity; the actor id never does."""
+    return replica._actor_id.hex()
+
+
 class ReplicaSet:
     """Live replica handles + ongoing counts, shared router/controller."""
 
@@ -21,18 +28,27 @@ class ReplicaSet:
         self.name = name
         self._lock = threading.Lock()
         self._replicas: List[Any] = []  # ActorHandles
-        self._ongoing: Dict[int, int] = {}  # id(handle) -> count
-        # model-multiplex affinity: model_id -> MRU list of replica ids
+        self._ongoing: Dict[str, int] = {}  # actor-id hex -> count
+        # model-multiplex affinity: model_id -> MRU list of replica keys
         # (reference pow_2_scheduler.py is multiplex-aware the same way)
-        self._affinity: Dict[str, List[int]] = {}
+        self._affinity: Dict[str, List[str]] = {}
+
+    _key = staticmethod(_rkey)
 
     def set_replicas(self, replicas: List[Any]) -> None:
         with self._lock:
             self._replicas = list(replicas)
-            live = {id(r) for r in replicas}
+            live = {self._key(r) for r in replicas}
             self._ongoing = {k: v for k, v in self._ongoing.items() if k in live}
             for r in replicas:
-                self._ongoing.setdefault(id(r), 0)
+                self._ongoing.setdefault(self._key(r), 0)
+            # drop affinity for replicas that were swapped out
+            for model_id in list(self._affinity):
+                kept = [k for k in self._affinity[model_id] if k in live]
+                if kept:
+                    self._affinity[model_id] = kept
+                else:
+                    del self._affinity[model_id]
 
     def replicas(self) -> List[Any]:
         with self._lock:
@@ -48,29 +64,35 @@ class ReplicaSet:
             if model_id:
                 cands = [
                     r for r in self._replicas
-                    if id(r) in self._affinity.get(model_id, ())
+                    if self._key(r) in self._affinity.get(model_id, ())
                 ]
                 if cands:
-                    chosen = min(cands, key=lambda r: self._ongoing[id(r)])
+                    chosen = min(cands, key=lambda r: self._ongoing[self._key(r)])
             if chosen is None:
                 if len(self._replicas) == 1:
                     chosen = self._replicas[0]
                 else:
                     a, b = random.sample(self._replicas, 2)
-                    chosen = a if self._ongoing[id(a)] <= self._ongoing[id(b)] else b
+                    chosen = (
+                        a
+                        if self._ongoing[self._key(a)] <= self._ongoing[self._key(b)]
+                        else b
+                    )
             if model_id:
                 mru = self._affinity.setdefault(model_id, [])
-                if id(chosen) in mru:
-                    mru.remove(id(chosen))
-                mru.insert(0, id(chosen))
+                ck = self._key(chosen)
+                if ck in mru:
+                    mru.remove(ck)
+                mru.insert(0, ck)
                 del mru[2:]  # at most 2 replicas per model keep affinity
-            self._ongoing[id(chosen)] += 1
+            self._ongoing[self._key(chosen)] += 1
             return chosen
 
     def release(self, replica: Any) -> None:
         with self._lock:
-            if id(replica) in self._ongoing and self._ongoing[id(replica)] > 0:
-                self._ongoing[id(replica)] -= 1
+            k = self._key(replica)
+            if self._ongoing.get(k, 0) > 0:
+                self._ongoing[k] -= 1
 
     def total_ongoing(self) -> int:
         with self._lock:

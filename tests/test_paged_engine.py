@@ -201,11 +201,72 @@ def test_engine_death_fails_streams_not_hangs():
         def boom(*a, **k):
             raise RuntimeError("injected engine crash")
 
-        engine._decode = boom
+        engine._decode_block_plain = boom
+        engine._decode_block_filtered = boom
         engine._chunk = boom
         s = engine.submit([1, 2, 3], max_tokens=4)
         with pytest.raises(RuntimeError, match="injected engine crash"):
             s.result(timeout=30)
+    finally:
+        engine.shutdown()
+
+
+def test_engine_death_in_decode_loop_fails_streams():
+    """A crash AFTER prefill (in the decode block dispatch) must also
+    surface on pending streams — the decode-path death boundary."""
+    config, params, engine = _tiny_engine()
+    try:
+        def boom(*a, **k):
+            raise RuntimeError("injected decode crash")
+
+        engine._decode_block_plain = boom
+        engine._decode_block_filtered = boom
+        s = engine.submit([1, 2, 3], max_tokens=4)
+        with pytest.raises(RuntimeError, match="injected decode crash"):
+            s.result(timeout=30)
+    finally:
+        engine.shutdown()
+
+
+def test_stalled_lane_token_survives_other_lanes_dispatch(monkeypatch):
+    """Regression: a lane page-stalled mid-decode keeps its pending input
+    token while other lanes keep dispatching blocks. Before the per-lane
+    merge fix, _dispatch_decode_block replaced the whole on-device token
+    vector with the block's final samples — garbage for excluded lanes
+    (they attend over the scratch page) — so an unstalling lane resumed
+    from a corrupt token and silently produced wrong output.
+
+    Driven without the engine loop so the stall-vs-dispatch interleaving
+    is deterministic: A's next block fits its pages, B needs a page the
+    starved allocator cannot grant."""
+    monkeypatch.setattr(PagedLLMEngine, "_loop", lambda self: None)
+    config, params, engine = _tiny_engine(
+        max_slots=2,
+        decode_block_steps=2,
+        paged=PagedConfig(
+            page_size=4, num_pages=9, max_pages_per_slot=8, chunk_pages=2
+        ),
+    )
+    try:
+        engine.submit([5, 17, 42, 7, 3, 11], max_tokens=2)      # A: slot 0
+        engine.submit([3, 11, 2, 29, 8, 1, 19, 4], max_tokens=4)  # B: slot 1
+        engine._admit()
+        assert not engine.slots[0].free and not engine.slots[1].free
+        while any(s.prefilling for s in engine.slots):
+            assert engine._prefill_tick()
+        # both lanes now hold their first sampled token on device
+        token_b_before = int(engine._tokens_dev[1])
+        # starve the pool so B's mid-decode growth stalls
+        n_free = engine.allocator.available
+        if n_free:
+            assert engine.allocator.alloc(n_free) is not None
+        assert engine._dispatch_decode_block()
+        assert engine.slots[1].stalled, "B should be page-stalled"
+        assert not engine.slots[0].stalled, "A should have dispatched"
+        assert engine.slots[0].position == 7
+        assert int(engine._tokens_dev[1]) == token_b_before, (
+            "stalled lane's pending token was clobbered by the dispatch"
+        )
     finally:
         engine.shutdown()
 

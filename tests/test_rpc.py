@@ -45,6 +45,31 @@ def test_rpc_roundtrip_and_errors():
         server.stop()
 
 
+def test_rpc_oserror_from_handler_is_not_retried():
+    """A handler exception that subclasses OSError (FileNotFoundError,
+    TimeoutError...) must re-raise typed on the client WITHOUT being
+    mistaken for a transport failure — no connection teardown, no
+    re-execution of the (possibly non-idempotent) handler."""
+    calls = {"n": 0}
+
+    def missing():
+        calls["n"] += 1
+        raise FileNotFoundError("no such working_dir")
+
+    server = RpcServer({"missing": missing, "ok": lambda: 1})
+    try:
+        client = RpcClient(server.url, retries=3, retry_wait_s=0.01)
+        with pytest.raises(FileNotFoundError, match="no such working_dir"):
+            client.call("missing")
+        assert calls["n"] == 1, "handler was re-executed by transport retry"
+        # the connection survived: next call reuses it
+        assert client.call("ok") == 1
+        assert client._sock is not None
+        client.close()
+    finally:
+        server.stop()
+
+
 def test_rpc_reconnects_after_server_restart():
     server = RpcServer({"val": lambda: 1}, port=0)
     port = server.address[1]
@@ -112,6 +137,53 @@ def test_pull_and_push_objects_chunked():
         oid2 = ObjectID.for_put(JobID.next())
         push_object(server.address, oid2.hex(), {"nested": [1, 2, 3]})
         assert store.get(oid2, timeout=5) == {"nested": [1, 2, 3]}
+    finally:
+        server.stop()
+
+
+def test_abandoned_transfer_swept_by_ttl(monkeypatch):
+    """A client that begins a pull and dies must not pin the payload in
+    the serving process forever: stale transfers are TTL-swept."""
+    import ray_tpu.core.object_transfer as ot
+
+    store = ObjectStore()
+    server = ObjectTransferServer(store)
+    try:
+        oid = ObjectID.for_put(JobID.next())
+        store.put(oid, np.arange(1000))
+        client = RpcClient(server.address)
+        info = client.call("pull_begin", oid.hex())  # ...then "die"
+        assert info["transfer_id"] in server._outgoing
+        monkeypatch.setattr(ot, "TRANSFER_TTL_S", 0.0)
+        # any later begin sweeps stale entries
+        client.call("pull_begin", oid.hex())
+        assert info["transfer_id"] not in server._outgoing
+        client.close()
+    finally:
+        server.stop()
+
+
+def test_transfer_streams_without_monolithic_copy():
+    """Out-of-band pickle-5 transfer: a numpy payload's buffer is served
+    as windows of the ORIGINAL array memory — the sender never builds a
+    monolithic payload-sized pickle blob (peak ~1x object size)."""
+    store = ObjectStore()
+    server = ObjectTransferServer(store)
+    try:
+        big = np.arange(3 * CHUNK_BYTES // 8, dtype=np.float64)
+        oid = ObjectID.for_put(JobID.next())
+        store.put(oid, big)
+        client = RpcClient(server.address)
+        info = client.call("pull_begin", oid.hex())
+        # the out-of-band buffer IS the array's memory, not a copy
+        tr = server._outgoing[info["transfer_id"]]
+        assert any(
+            mv.obj is big or np.shares_memory(np.frombuffer(mv, np.float64), big)
+            for mv in tr.buffers
+            if len(mv) == big.nbytes
+        )
+        client.call("pull_end", info["transfer_id"])
+        client.close()
     finally:
         server.stop()
 
