@@ -29,6 +29,10 @@ def init(
     spill_dir: Optional[str] = None,
     detect_accelerators: bool = True,
     ignore_reinit_error: bool = True,
+    head: bool = False,
+    address: Optional[str] = None,
+    cluster_token: Optional[str] = None,
+    gcs_port: int = 0,
     _system_config: Optional[Dict[str, Any]] = None,
 ) -> _rt.Runtime:
     """Start (or connect to) the in-process cluster runtime.
@@ -36,6 +40,13 @@ def init(
     `num_nodes > 1` creates multiple logical nodes in one process — the same
     multi-node-without-a-cluster trick the reference uses for testing
     (python/ray/cluster_utils.py:135).
+
+    `head=True` makes this process a real multi-process cluster head: its
+    GCS is served over RPC and other OS processes join with
+    `init(address="host:port")` or `ray_tpu start --address` (reference:
+    `ray start --head`, python/ray/scripts/scripts.py:706). The joined
+    processes' resources appear in `cluster_resources()` and tasks
+    dispatch to them over RPC (core/cluster.py).
 
     `_system_config` overrides central config flags for this process (the
     reference's ray.init(_system_config=...) escape hatch over
@@ -65,6 +76,10 @@ def init(
         object_store_capacity=object_store_capacity,
         spill_dir=spill_dir,
         detect_accelerators=detect_accelerators,
+        head=head,
+        address=address,
+        cluster_token=cluster_token,
+        gcs_port=gcs_port,
     )
 
 

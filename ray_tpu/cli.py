@@ -40,6 +40,42 @@ def _cmd_doctor(args) -> int:
     return 0
 
 
+def _cmd_start(args) -> int:
+    """Start a cluster head or join an existing cluster as a node agent
+    (reference: `ray start --head` / `ray start --address=...`,
+    /root/reference/python/ray/scripts/scripts.py:706). Blocks until
+    SIGTERM/SIGINT or a shutdown_node RPC."""
+    import ray_tpu
+
+    if bool(args.head) == bool(args.address):
+        print("pass exactly one of --head or --address", file=sys.stderr)
+        return 2
+    rt = ray_tpu.init(
+        num_cpus=args.num_cpus,
+        detect_accelerators=not args.no_tpu,
+        head=args.head,
+        address=args.address,
+        cluster_token=args.token,
+        gcs_port=args.port,
+    )
+    ctx = rt.cluster
+    if args.head:
+        print(f"head up: gcs at {ctx.gcs_address}, node agent at {ctx.address}",
+              flush=True)
+        print(f"join with: python -m ray_tpu start --address {ctx.gcs_address}",
+              flush=True)
+    else:
+        print(f"node {ctx.node_id.hex()[:12]} joined {args.address}, "
+              f"agent at {ctx.address}", flush=True)
+    try:
+        while not ctx.shutdown_requested.wait(0.5):
+            pass
+    except KeyboardInterrupt:
+        pass
+    ray_tpu.shutdown()
+    return 0
+
+
 def _cmd_config(args) -> int:
     from .core.config import cfg
 
@@ -128,6 +164,16 @@ def build_parser() -> argparse.ArgumentParser:
     sub.add_parser("config", help="print all config flags")
     sub.add_parser("status", help="start a runtime and print cluster state")
 
+    st = sub.add_parser("start", help="start a cluster head or join one")
+    st.add_argument("--head", action="store_true",
+                    help="serve the GCS and become the head node")
+    st.add_argument("--address", help="head GCS address (host:port) to join")
+    st.add_argument("--port", type=int, default=0,
+                    help="GCS port for --head (0 = ephemeral)")
+    st.add_argument("--num-cpus", type=int, default=None)
+    st.add_argument("--token", default=None,
+                    help="cluster auth token (required off-localhost)")
+
     jp = sub.add_parser("job", help="submit/inspect driver jobs")
     jsub = jp.add_subparsers(dest="job_cmd", required=True)
     js = jsub.add_parser("submit")
@@ -151,6 +197,7 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     handler = {
+        "start": _cmd_start,
         "doctor": _cmd_doctor,
         "config": _cmd_config,
         "status": _cmd_status,

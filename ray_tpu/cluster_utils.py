@@ -1,0 +1,141 @@
+"""Multi-process cluster harness for tests and local experiments.
+
+Reference parity: `ray.cluster_utils.Cluster`
+(/root/reference/python/ray/cluster_utils.py:135), which starts a head
+plus N worker raylets as real processes on one machine so multi-node
+behavior is testable without a cluster. Here the head lives in the
+calling process (`init(head=True)`) and each `add_node` spawns a real
+`python -m ray_tpu start --address=...` OS process that joins it.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+from .core.rpc import RpcClient, RpcError
+
+
+class NodeHandle:
+    """One spawned worker-agent process."""
+
+    def __init__(self, proc: subprocess.Popen, num_cpus: int):
+        self.proc = proc
+        self.num_cpus = num_cpus
+
+    @property
+    def pid(self) -> int:
+        return self.proc.pid
+
+    def alive(self) -> bool:
+        return self.proc.poll() is None
+
+
+class Cluster:
+    """Head in-process + worker agents as subprocesses.
+
+    Usage::
+
+        cluster = Cluster(head_node_args={"num_cpus": 2})
+        cluster.add_node(num_cpus=2)
+        cluster.wait_for_nodes(2)
+        ... use ray_tpu normally; tasks spill onto the worker agents ...
+        cluster.shutdown()
+    """
+
+    def __init__(self, head_node_args: Optional[Dict[str, Any]] = None,
+                 token: Optional[str] = None):
+        import ray_tpu
+
+        args = dict(head_node_args or {})
+        args.setdefault("num_cpus", 2)
+        args.setdefault("detect_accelerators", False)
+        self.token = token
+        self.runtime = ray_tpu.init(head=True, cluster_token=token, **args)
+        self.address: str = self.runtime.cluster.gcs_address
+        self._nodes: List[NodeHandle] = []
+
+    def add_node(self, num_cpus: int = 1, env: Optional[Dict[str, str]] = None,
+                 system_config: Optional[Dict[str, Any]] = None) -> NodeHandle:
+        """Spawn a worker agent that joins this cluster."""
+        cmd = [
+            sys.executable, "-m", "ray_tpu", "--no-tpu", "start",
+            "--address", self.address, "--num-cpus", str(num_cpus),
+        ]
+        if self.token:
+            cmd += ["--token", self.token]
+        child_env = dict(os.environ)
+        # agents in tests must not grab accelerators or another platform
+        child_env.setdefault("JAX_PLATFORMS", "cpu")
+        for key, value in (system_config or {}).items():
+            child_env[f"RAY_TPU_{key.upper()}"] = str(value)
+        child_env.update(env or {})
+        proc = subprocess.Popen(
+            cmd, env=child_env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        handle = NodeHandle(proc, num_cpus)
+        self._nodes.append(handle)
+        return handle
+
+    def wait_for_nodes(self, count: int, timeout: float = 60.0) -> None:
+        """Block until the scheduler's view holds `count` nodes total
+        (head included)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if len(self.runtime.scheduler.nodes()) >= count:
+                return
+            for handle in self._nodes:
+                if not handle.alive():
+                    out = handle.proc.stdout.read() if handle.proc.stdout else ""
+                    raise RuntimeError(
+                        f"worker agent pid={handle.pid} exited "
+                        f"rc={handle.proc.returncode}:\n{out}"
+                    )
+            time.sleep(0.05)
+        raise TimeoutError(
+            f"cluster did not reach {count} nodes in {timeout}s "
+            f"(have {len(self.runtime.scheduler.nodes())})"
+        )
+
+    def remove_node(self, handle: NodeHandle, allow_graceful: bool = True) -> None:
+        """Take a worker down. Graceful asks the agent to stop (clean
+        deregistration); otherwise SIGKILL simulates node failure."""
+        if allow_graceful and handle.alive():
+            try:
+                info = self._agent_info(handle)
+                if info is not None:
+                    RpcClient(info, timeout=5.0, retries=0, token=self.token).call(
+                        "shutdown_node"
+                    )
+            except (RpcError, OSError):
+                pass
+            try:
+                handle.proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                handle.proc.kill()
+        else:
+            handle.proc.kill()
+        handle.proc.wait()
+        if handle in self._nodes:
+            self._nodes.remove(handle)
+
+    def _agent_info(self, handle: NodeHandle) -> Optional[str]:
+        """Find the agent address of a spawned node via the GCS table."""
+        ctx = self.runtime.cluster
+        for info in ctx.nodes():
+            if info.get("pid") == handle.pid:
+                return info["address"]
+        return None
+
+    def shutdown(self) -> None:
+        import ray_tpu
+
+        for handle in list(self._nodes):
+            handle.proc.kill()
+            handle.proc.wait()
+        self._nodes.clear()
+        ray_tpu.shutdown()

@@ -155,12 +155,16 @@ class ActorRuntime:
                 except IndexError:
                     self.death_cause = f"bundle index {idx} out of range"
                     return False
+                bundles = [
+                    b for b in bundles
+                    if b.node is None or not b.node.is_remote  # actors stay local
+                ]
                 if not any(
                     b.reserved is not None and b.reserved.can_ever_fit(self.resources)
                     for b in bundles
                 ):
                     self.death_cause = (
-                        f"no bundle in placement group can ever satisfy {self.resources}"
+                        f"no local bundle in placement group can ever satisfy {self.resources}"
                     )
                     return False
                 for bundle in bundles:
@@ -171,6 +175,17 @@ class ActorRuntime:
                 node = next(
                     (n for n in self._scheduler.nodes() if n.node_id == strategy.node_id), None
                 )
+                if node is not None and node.is_remote:
+                    # Actors execute in their owner's process; remote actor
+                    # placement is a documented cluster gap (core/cluster.py)
+                    if not strategy.soft:
+                        self.death_cause = (
+                            f"actors cannot be placed on remote node {strategy.node_id}"
+                        )
+                        return False
+                    # soft affinity: fall back to default local placement
+                    strategy = "DEFAULT"
+                    continue
                 if node is not None and not node.resources.can_ever_fit(self.resources):
                     self.death_cause = (
                         f"affinity node cannot ever satisfy {self.resources}"
@@ -183,7 +198,10 @@ class ActorRuntime:
                     self.death_cause = f"affinity node {strategy.node_id} not found"
                     return False
             else:
-                nodes = sorted(self._scheduler.nodes(), key=lambda n: n.utilization())
+                nodes = sorted(
+                    (n for n in self._scheduler.nodes() if not n.is_remote),
+                    key=lambda n: n.utilization(),
+                )
                 feasible = [n for n in nodes if n.resources.can_ever_fit(self.resources)]
                 if not feasible and nodes:
                     self.death_cause = (

@@ -1,0 +1,566 @@
+"""Cluster composition: node agents that join one ray_tpu cluster.
+
+This is the layer that turns the tested islands (RPC `rpc.py`, GCS
+service `gcs_service.py`, chunked object transfer `object_transfer.py`,
+process worker pools `worker_pool.py`) into ONE cluster spanning OS
+processes and hosts — the reference's per-node raylet + `ray start`
+composition (/root/reference/src/ray/raylet/main.cc,
+python/ray/_private/node.py:1437, python/ray/scripts/scripts.py:706).
+
+Design, inverted for TPU:
+
+- **Every cluster member is symmetric.** A member = a Runtime + one RPC
+  server (the node's well-known address) carrying BOTH the object
+  transfer plane and the agent control plane (execute_task/task_done/
+  free_object). The head additionally serves the GCS. There is no
+  separate raylet binary: on a TPU pod the natural unit is one Python
+  process per host, and that process IS the agent.
+- **Ownership stays with the submitter.** A task dispatched to a remote
+  node keeps its return ObjectIDs owned by the submitting process (the
+  reference's ownership model, core_worker/reference_count.h:72). Small
+  results are pushed back eagerly; large results stay in the executing
+  node's store, registered in the GCS object directory
+  (ownership_based_object_directory.h:39), and `get()` pulls them
+  through `object_transfer.fetch_object` on first access.
+- **Scheduling is owner-local.** Each driver schedules its own tasks
+  against the cluster view it assembles from GCS heartbeats — the same
+  direct worker-to-worker dispatch the reference uses once a lease is
+  granted. Resource views are optimistic between heartbeats; agents
+  execute whatever arrives.
+- **Liveness is heartbeat staleness.** Nodes report resources every
+  `node_heartbeat_s`; a node absent from the aggregated view for
+  `node_stale_s` is declared dead: its tasks resubmit (system-failure
+  budget), its objects lazily flip LOST on fetch failure and lineage
+  reconstruction re-executes their creating tasks.
+
+Known gaps (tracked for later rounds): actors do not place on remote
+nodes (they execute in their owner's process); streaming generators are
+local-only; cross-process borrowed references beyond the best-effort
+free_object protocol.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import queue
+import socket
+import threading
+import time
+import traceback
+from typing import Any, Dict, List, Optional, Tuple
+
+from .gcs_service import GcsClient
+from .ids import NodeID, ObjectID
+from .object_transfer import ObjectTransferServer, fetch_object, push_object
+from .rpc import RpcClient, RpcError
+from .scheduler import RemoteNode, TaskSpec, _resolve
+from .worker_pool import WorkerCrashedError
+
+logger = logging.getLogger(__name__)
+
+NODE_NS = "_nodes"      # GCS KV: node_id hex -> node info dict
+OBJDIR_NS = "_objdir"   # GCS KV: object id hex -> transfer address
+
+
+class ClusterContext:
+    """Everything one process needs to be a member of a cluster: the
+    node server, the GCS client, the heartbeat/watch loop, the remote
+    dispatcher, and the agent-side task executor."""
+
+    def __init__(self, runtime, gcs_address: str, *, token: Optional[str] = None,
+                 is_head: bool = False, bind_host: Optional[str] = None):
+        from .config import cfg
+
+        self.runtime = runtime
+        self.token = token or None
+        self.is_head = is_head
+        self.gcs_address = gcs_address
+        bind_host = bind_host or cfg.cluster_bind_host
+        if bind_host not in ("127.0.0.1", "localhost") and not self.token:
+            raise ValueError(
+                "binding cluster services off-localhost requires a cluster "
+                "token (RPC peers can execute code; see rpc.py auth)"
+            )
+        store = runtime.object_store
+        # One server, one port: transfer plane + agent control plane.
+        self.server = ObjectTransferServer(store, host=bind_host, token=self.token)
+        self.server.register("execute_task", self._execute_task)
+        self.server.register("task_done", self._task_done)
+        self.server.register("free_object", self._free_object)
+        self.server.register("node_info", self._node_info)
+        self.server.register("shutdown_node", self._shutdown_node)
+        self.address = self.server.address
+
+        self.gcs = GcsClient(gcs_address, token=self.token)
+        local = runtime.scheduler.head_node()
+        self.node_id: NodeID = local.node_id
+        self._local_node = local
+
+        # dispatch bookkeeping: task hex -> (spec, node, pool)
+        self._pending: Dict[str, Tuple[TaskSpec, RemoteNode, Any]] = {}
+        self._lock = threading.Lock()
+        self._remote_nodes: Dict[str, RemoteNode] = {}
+        self._reply_clients: Dict[str, RpcClient] = {}
+        self._free_queue: "queue.Queue[Tuple[str, str]]" = queue.Queue()
+        self._stop = threading.Event()
+        self.shutdown_requested = threading.Event()
+
+        store.set_cluster_hooks(
+            fetch_remote=self._fetch_remote,
+            locate=self._locate,
+            free_remote=self._enqueue_free,
+        )
+        runtime.scheduler.remote_dispatcher = self._dispatch
+
+        self._register()
+        self._watch_thread = threading.Thread(
+            target=self._watch_loop, daemon=True, name="ray_tpu-cluster-watch"
+        )
+        self._watch_thread.start()
+        self._free_thread = threading.Thread(
+            target=self._free_loop, daemon=True, name="ray_tpu-cluster-free"
+        )
+        self._free_thread.start()
+
+    # ------------------------------------------------------------ membership
+
+    def _register(self) -> None:
+        """Heartbeat FIRST, then the table entry: watchers discover nodes
+        from the table but declare death from heartbeat staleness, so the
+        heartbeat must never lag the registration."""
+        self._heartbeat()
+        info = {
+            "node_id": self.node_id.hex(),
+            "address": self.address,
+            "resources": dict(self._local_node.resources.total),
+            "is_head": self.is_head,
+            "pid": os.getpid(),
+            "hostname": socket.gethostname(),
+            "joined_at": time.time(),
+        }
+        self.gcs.kv_put(self.node_id.hex(), info, namespace=NODE_NS)
+        logger.info("node %s joined cluster at %s (gcs %s)",
+                    self.node_id.hex()[:12], self.address, self.gcs_address)
+
+    def _heartbeat(self) -> None:
+        self.gcs.report_resources(
+            self.node_id.hex(), dict(self._local_node.resources.available())
+        )
+
+    def _watch_loop(self) -> None:
+        from .config import cfg
+
+        period = cfg.node_heartbeat_s
+        while not self._stop.wait(period):
+            try:
+                self._heartbeat()
+                self._refresh_nodes()
+            except (RpcError, OSError) as exc:
+                # GCS unreachable: keep trying — if the head died, the user
+                # tears the cluster down; a transient blip must not.
+                logger.warning("cluster heartbeat failed: %r", exc)
+            except Exception:
+                logger.exception("cluster watch loop error")
+
+    def _refresh_nodes(self) -> None:
+        view = self.gcs.cluster_view()
+        live = set(view["nodes"])
+        my_hex = self.node_id.hex()
+        # joins + rejoins
+        for node_hex in live:
+            if node_hex == my_hex:
+                continue
+            with self._lock:
+                known = self._remote_nodes.get(node_hex)
+            if known is not None and known.alive:
+                continue
+            info = self.gcs.kv_get(node_hex, namespace=NODE_NS)
+            if not info:
+                continue
+            # unknown, OR locally quarantined after a dispatch failure but
+            # still heartbeating (the failure was transient): (re)join with
+            # a fresh client
+            node = RemoteNode(
+                NodeID(node_hex), dict(info["resources"]), info["address"],
+                token=self.token,
+            )
+            with self._lock:
+                self._remote_nodes[node_hex] = node
+            if known is not None:
+                known.client.close()  # don't leak the quarantined socket
+            self.runtime.scheduler.add_node(node)
+            logger.info("%s cluster node %s at %s",
+                        "rediscovered" if known is not None else "discovered",
+                        node_hex[:12], info["address"])
+        # deaths: a known node absent from the live view aged out of
+        # heartbeats (reference: GcsHealthCheckManager marking raylets dead)
+        for node_hex in list(self._remote_nodes):
+            if node_hex not in live:
+                self._on_node_dead(node_hex, "missed heartbeats")
+
+    def _on_node_dead(self, node_hex: str, reason: str) -> None:
+        """Heartbeat-confirmed death: deregister cluster-wide and fail over
+        every task in flight there. (Transient dispatch failures do NOT come
+        here — they only quarantine the node locally until heartbeats decide.)"""
+        with self._lock:
+            node = self._remote_nodes.pop(node_hex, None)
+        if node is None:
+            return
+        logger.warning("cluster node %s died (%s)", node_hex[:12], reason)
+        self.runtime.scheduler.remove_node(node.node_id)
+        self.gcs.kv_delete(node_hex, namespace=NODE_NS)
+        node.client.close()
+        # fail over tasks in flight on that node — matched by node id, not
+        # object identity, so tasks dispatched before a rejoin are covered
+        with self._lock:
+            doomed = [
+                (task_hex, rec) for task_hex, rec in self._pending.items()
+                if rec[1].node_id.hex() == node_hex
+            ]
+            for task_hex, _ in doomed:
+                del self._pending[task_hex]
+        for _, (spec, dnode, pool) in doomed:
+            self.runtime.scheduler.finish_remote(
+                spec, dnode, pool,
+                error=WorkerCrashedError(
+                    f"node {node_hex[:12]} executing task {spec.name} died: {reason}"
+                ),
+                system_failure=True,
+            )
+
+    def nodes(self) -> List[Dict[str, Any]]:
+        """Cluster membership as recorded in the GCS node table."""
+        out = []
+        for key in self.gcs.kv_keys(namespace=NODE_NS):
+            info = self.gcs.kv_get(key, namespace=NODE_NS)
+            if info:
+                out.append(info)
+        return out
+
+    # -------------------------------------------------- driver-side dispatch
+
+    def _dispatch(self, spec: TaskSpec, node: RemoteNode, pool) -> None:
+        """Ship one task to a node agent (runs in a dispatch thread; the
+        scheduler already acquired resources on its RemoteNode view).
+        Never raises: every failure path flows through finish_remote."""
+        import cloudpickle
+
+        task_hex = spec.task_id.hex()
+        with self._lock:
+            self._pending[task_hex] = (spec, node, pool)
+        try:
+            # ObjectRef args resolve HERE (the owner), possibly pulling
+            # remote values; the agent receives plain values. Dependencies
+            # are already sealed (the scheduler gates dispatch on them).
+            args = _resolve(spec.args, self.runtime.object_store)
+            kwargs = _resolve(spec.kwargs, self.runtime.object_store)
+            blob = cloudpickle.dumps({
+                "task_hex": task_hex,
+                "name": spec.name,
+                "func": spec.func,
+                "args": args,
+                "kwargs": kwargs,
+                "num_returns": spec.num_returns,
+                "return_oids": [oid.hex() for oid in spec.return_ids],
+                "runtime_env": spec.runtime_env,
+                "executor": spec.executor,
+                "reply_addr": self.address,
+            })
+            reply = node.client.call("execute_task", blob)
+            if reply != "accepted":
+                raise RpcError(f"agent rejected task: {reply!r}")
+        except (RpcError, OSError) as exc:
+            with self._lock:
+                rec = self._pending.pop(task_hex, None)
+            if rec is None:
+                return  # task_done raced us: the task actually completed
+            # Quarantine the node LOCALLY only (no GCS deregistration, no
+            # failover of its other in-flight tasks): one dropped connection
+            # must not shrink the cluster. If the agent is healthy it keeps
+            # heartbeating and _refresh_nodes re-adds it; if it is dead the
+            # staleness watcher declares it and fails the rest over.
+            logger.warning("dispatch to node %s failed; quarantining: %r",
+                           node.node_id.hex()[:12], exc)
+            self.runtime.scheduler.remove_node(node.node_id)
+            self.runtime.scheduler.finish_remote(
+                spec, node, pool,
+                error=WorkerCrashedError(
+                    f"dispatch of {spec.name} to node "
+                    f"{node.node_id.hex()[:12]} failed: {exc!r}"
+                ),
+                system_failure=True,
+            )
+        except BaseException as exc:  # serialization errors etc: user-level
+            with self._lock:
+                rec = self._pending.pop(task_hex, None)
+            if rec is None:
+                return
+            self.runtime.scheduler.finish_remote(
+                spec, node, pool, error=exc, error_tb=traceback.format_exc()
+            )
+
+    def _task_done(self, task_hex: str, statuses: Optional[List[Tuple[str, Any]]],
+                   error_blob: Optional[bytes]) -> str:
+        """Agent callback: the task finished over there. Small results were
+        already pushed (sealed) on this same connection before this call,
+        so seal ordering is guaranteed."""
+        import pickle as _pickle
+
+        with self._lock:
+            rec = self._pending.pop(task_hex, None)
+        if rec is None:
+            return "stale"  # node was declared dead first; task resubmitted
+        spec, node, pool = rec
+        if error_blob is not None:
+            try:
+                error, tb = _pickle.loads(error_blob)
+            except Exception:
+                error, tb = RuntimeError("undecodable remote error"), ""
+            self.runtime.scheduler.finish_remote(
+                spec, node, pool, error=error, error_tb=tb
+            )
+            return "ok"
+        for oid, (kind, addr) in zip(spec.return_ids, statuses or ()):
+            if kind == "remote":
+                self.runtime.object_store.seal_remote(oid, addr)
+            # kind == "pushed": the push RPC already sealed the value
+        self.runtime.scheduler.finish_remote(spec, node, pool)
+        return "ok"
+
+    # ----------------------------------------------------- agent-side execute
+
+    def _execute_task(self, blob: bytes) -> str:
+        import cloudpickle
+
+        msg = cloudpickle.loads(blob)
+        threading.Thread(
+            target=self._run_agent_task, args=(msg,), daemon=True,
+            name=f"ray_tpu-agent-{msg['name']}-{msg['task_hex'][:6]}",
+        ).start()
+        return "accepted"
+
+    def _run_agent_task(self, msg: Dict[str, Any]) -> None:
+        """Execute a remotely submitted task in THIS process (or its
+        worker pool) and report results to the owner. Mirrors the
+        executor arm of ClusterScheduler._run_task."""
+        from .config import cfg
+        from . import runtime_env as _renv
+
+        task_hex = msg["task_hex"]
+        try:
+            renv = msg.get("runtime_env")
+            if msg.get("executor") == "process":
+                from .worker_pool import get_worker_pool
+
+                env_vars = dict((renv or {}).get("env_vars") or {})
+                py_modules = (renv or {}).get("py_modules") or []
+                if py_modules:
+                    existing = env_vars.get("PYTHONPATH", os.environ.get("PYTHONPATH", ""))
+                    env_vars["PYTHONPATH"] = os.pathsep.join(
+                        list(py_modules) + ([existing] if existing else [])
+                    )
+                result = get_worker_pool().execute(
+                    msg["func"], msg["args"], msg["kwargs"], env_vars=env_vars,
+                    working_dir=(renv or {}).get("working_dir"),
+                )
+            else:
+                with _renv.applied(renv):
+                    result = msg["func"](*msg["args"], **msg["kwargs"])
+            if msg["num_returns"] == 1:
+                values = [result]
+            else:
+                values = list(result) if result is not None else []
+                if len(values) != msg["num_returns"]:
+                    raise ValueError(
+                        f"Task {msg['name']} declared num_returns="
+                        f"{msg['num_returns']} but returned {len(values)} values"
+                    )
+        except BaseException as exc:  # noqa: BLE001 - ferried to the owner
+            tb = getattr(exc, "remote_traceback", None) or traceback.format_exc()
+            self._reply_error(msg, exc, tb)
+            return
+
+        def deliver() -> None:
+            reply = self._reply_client(msg["reply_addr"])
+            statuses: List[Tuple[str, Any]] = []
+            from .object_store import _estimate_nbytes
+
+            for oid_hex, value in zip(msg["return_oids"], values):
+                if _estimate_nbytes(value) <= cfg.remote_inline_max_bytes:
+                    push_object(msg["reply_addr"], oid_hex, value, client=reply)
+                    statuses.append(("pushed", None))
+                else:
+                    # big result: stays here; the owner pulls on get()
+                    oid = ObjectID(oid_hex)
+                    store = self.runtime.object_store
+                    store.create(oid)
+                    store.seal(oid, value)
+                    self.gcs.kv_put(oid_hex, self.address, namespace=OBJDIR_NS)
+                    statuses.append(("remote", self.address))
+            reply.call("task_done", task_hex, statuses, None)
+
+        self._deliver_with_retry(task_hex, msg["reply_addr"], deliver)
+
+    def _deliver_with_retry(self, task_hex: str, addr: str, deliver) -> None:
+        """Completion delivery must survive transient owner hiccups: an
+        undelivered task_done leaves the owner's get() hanging and its
+        RemoteNode resources leaked (the owner only reaps on OUR death,
+        and we are alive). Retries with fresh connections; re-pushes are
+        safe (seal replaces). Gives up only after ~30s — at that point the
+        owner is plausibly gone and its death reaps everything."""
+        attempts = 6
+        for attempt in range(attempts):
+            try:
+                deliver()
+                return
+            except (RpcError, OSError) as exc:
+                with self._lock:
+                    stale = self._reply_clients.pop(addr, None)
+                if stale is not None:
+                    stale.close()
+                if attempt == attempts - 1:
+                    logger.warning(
+                        "result delivery for %s to %s failed after %d attempts: %r",
+                        task_hex, addr, attempts, exc,
+                    )
+                    return
+                time.sleep(min(1.0 * (attempt + 1), 5.0))
+
+    def _reply_error(self, msg: Dict[str, Any], exc: BaseException, tb: str) -> None:
+        import pickle as _pickle
+
+        try:
+            blob = _pickle.dumps((exc, tb))
+        except Exception:
+            blob = _pickle.dumps((RuntimeError(f"{type(exc).__name__}: {exc!r}"), tb))
+        self._deliver_with_retry(
+            msg["task_hex"], msg["reply_addr"],
+            lambda: self._reply_client(msg["reply_addr"]).call(
+                "task_done", msg["task_hex"], None, blob
+            ),
+        )
+
+    def _reply_client(self, addr: str) -> RpcClient:
+        """One persistent connection per owner: pushes and the task_done
+        report ride the same ordered stream."""
+        with self._lock:
+            client = self._reply_clients.get(addr)
+            if client is None:
+                client = RpcClient(addr, timeout=60.0, token=self.token)
+                self._reply_clients[addr] = client
+            return client
+
+    # ------------------------------------------------------- object plumbing
+
+    def _fetch_remote(self, object_id: ObjectID, address: str) -> Any:
+        return fetch_object(address, object_id.hex(), token=self.token)
+
+    def _locate(self, object_id: ObjectID) -> Optional[str]:
+        return self.gcs.kv_get(object_id.hex(), namespace=OBJDIR_NS)
+
+    def _free_object(self, oid_hex: str) -> bool:
+        self.runtime.object_store.free(ObjectID(oid_hex))
+        try:
+            self.gcs.kv_delete(oid_hex, namespace=OBJDIR_NS)
+        except (RpcError, OSError):
+            pass
+        return True
+
+    def _enqueue_free(self, object_id: ObjectID, address: str) -> None:
+        # called under store entry locks: hand off, never block
+        self._free_queue.put((object_id.hex(), address))
+
+    def _free_loop(self) -> None:
+        # Dedicated cache of SHORT-timeout, no-retry clients: one free
+        # aimed at a dead node must not head-of-line-block frees to
+        # healthy nodes behind long connect timeouts.
+        free_clients: Dict[str, RpcClient] = {}
+        while not self._stop.is_set():
+            try:
+                oid_hex, addr = self._free_queue.get(timeout=0.5)
+            except queue.Empty:
+                continue
+            client = free_clients.get(addr)
+            if client is None:
+                client = RpcClient(addr, timeout=3.0, retries=0, token=self.token)
+                free_clients[addr] = client
+            try:
+                client.call("free_object", oid_hex)
+            except (RpcError, OSError):
+                # best-effort: drop the (likely dead) connection; node
+                # death reclaims its whole store anyway
+                client.close()
+                free_clients.pop(addr, None)
+        for client in free_clients.values():
+            client.close()
+
+    # ------------------------------------------------------------------ misc
+
+    def _node_info(self) -> Dict[str, Any]:
+        return {
+            "node_id": self.node_id.hex(),
+            "address": self.address,
+            "is_head": self.is_head,
+            "pid": os.getpid(),
+            "resources": dict(self._local_node.resources.total),
+            "available": dict(self._local_node.resources.available()),
+        }
+
+    def _shutdown_node(self) -> str:
+        """Graceful stop (cluster_utils / `ray_tpu stop`): the agent main
+        loop watches shutdown_requested."""
+        self.shutdown_requested.set()
+        return "ok"
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self.gcs.kv_delete(self.node_id.hex(), namespace=NODE_NS)
+        except (RpcError, OSError):
+            pass
+        with self._lock:
+            clients = list(self._reply_clients.values())
+            self._reply_clients.clear()
+            nodes = list(self._remote_nodes.values())
+            self._remote_nodes.clear()
+        for c in clients:
+            c.close()
+        for n in nodes:
+            n.client.close()
+        self.gcs.close()
+        self.server.stop()
+
+
+# ----------------------------------------------------------------- entrypoints
+
+
+def start_head(runtime, *, port: int = 0, token: Optional[str] = None,
+               bind_host: Optional[str] = None) -> ClusterContext:
+    """Make this process the cluster head: serve its GCS over RPC and
+    join as the first node (reference: `ray start --head` bringing up
+    gcs_server + the head raylet, python/ray/_private/node.py:1437)."""
+    from .config import cfg
+    from .gcs_service import serve_gcs
+
+    host = bind_host or cfg.cluster_bind_host
+    if host not in ("127.0.0.1", "localhost") and not token:
+        raise ValueError("a head bound off-localhost requires a cluster token")
+    gcs_server = serve_gcs(
+        runtime.gcs, host=host, port=port, token=token, stale_s=cfg.node_stale_s
+    )
+    ctx = ClusterContext(
+        runtime, gcs_server.url, token=token, is_head=True, bind_host=host
+    )
+    ctx.gcs_server = gcs_server
+    return ctx
+
+
+def join_cluster(runtime, address: str, *, token: Optional[str] = None,
+                 bind_host: Optional[str] = None) -> ClusterContext:
+    """Join an existing cluster as a worker node (reference:
+    `ray start --address=...` starting a raylet against the head GCS)."""
+    ctx = ClusterContext(
+        runtime, address, token=token, is_head=False, bind_host=bind_host
+    )
+    return ctx

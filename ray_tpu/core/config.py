@@ -102,6 +102,20 @@ define_flag("health_check_period_s", 0.5,
 define_flag("health_check_failures", 3,
             "Consecutive probe failures before a target is marked dead.")
 
+# cluster (multi-process / multi-host composition)
+define_flag("node_heartbeat_s", 0.5,
+            "Interval at which cluster nodes report resources to the GCS.")
+define_flag("node_stale_s", 5.0,
+            "A node missing from heartbeats this long is declared dead.")
+define_flag("system_failure_retries", 3,
+            "Automatic resubmits of a task whose executing node died.")
+define_flag("remote_inline_max_bytes", 512 * 1024,
+            "Remote task results at or under this size return by value; "
+            "larger ones stay on the executing node and get() pulls them.")
+define_flag("cluster_bind_host", "127.0.0.1",
+            "Host address cluster services bind to (0.0.0.0 for multi-host; "
+            "set a cluster token when leaving localhost).")
+
 # memory monitor / OOM
 define_flag("memory_monitor_interval_s", 0.25,
             "Polling interval of the host memory monitor (0 = disabled).")

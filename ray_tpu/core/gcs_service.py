@@ -51,10 +51,12 @@ class _ResourceSync:
         return {"total": total, "nodes": nodes}
 
 
-def serve_gcs(gcs: GlobalControlStore, host: str = "127.0.0.1", port: int = 0) -> RpcServer:
+def serve_gcs(gcs: GlobalControlStore, host: str = "127.0.0.1", port: int = 0,
+              token: Optional[str] = None,
+              stale_s: float = 10.0) -> RpcServer:
     """Expose a GlobalControlStore; returns the RpcServer (''host:port''
     in .url — hand that to GcsClient in other processes)."""
-    syncer = _ResourceSync()
+    syncer = _ResourceSync(stale_s=stale_s)
 
     handlers = {
         "ping": lambda: "ok",
@@ -71,7 +73,7 @@ def serve_gcs(gcs: GlobalControlStore, host: str = "127.0.0.1", port: int = 0) -
         "report_resources": syncer.report,
         "cluster_view": syncer.cluster_view,
     }
-    server = RpcServer(handlers, host=host, port=port)
+    server = RpcServer(handlers, host=host, port=port, token=token)
     server.syncer = syncer
     return server
 
@@ -81,8 +83,9 @@ class GcsClient:
     The surface mirrors the in-process KVStore/PubSub shapes so code can
     take either."""
 
-    def __init__(self, address: str, *, timeout: float = 30.0):
-        self._rpc = RpcClient(address, timeout=timeout)
+    def __init__(self, address: str, *, timeout: float = 30.0,
+                 token: Optional[str] = None):
+        self._rpc = RpcClient(address, timeout=timeout, token=token)
 
     # ------------------------------------------------------------------- kv
 

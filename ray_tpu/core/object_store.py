@@ -42,6 +42,7 @@ class Tier(enum.Enum):
     DEVICE = "device"      # jax.Array handles (HBM); spill via host copy
     SHM = "shm"            # native arena (ray_tpu/core/_native), numpy only
     SPILLED = "spilled"    # on disk
+    REMOTE = "remote"      # value lives in another node's store (cluster)
 
 
 # Tier thresholds come from the central flag registry (config.py):
@@ -74,6 +75,13 @@ def _is_device_array(value: Any) -> bool:
     return t.__module__.startswith("jax") and t.__name__ in ("Array", "ArrayImpl")
 
 
+class _RemoteFetchFailed(Exception):
+    """Internal: a REMOTE-tier fetch-through failed (owner unreachable)."""
+
+    def __init__(self, object_id, address):
+        super().__init__(f"fetch of {object_id} from {address} failed")
+
+
 class ObjectState(enum.Enum):
     PENDING = "pending"   # task not finished yet
     READY = "ready"
@@ -85,7 +93,8 @@ class ObjectEntry:
     __slots__ = (
         "object_id", "state", "value", "error", "tier", "nbytes",
         "pin_count", "event", "callbacks", "spill_path", "owner_task",
-        "last_access", "lock", "handle_count", "gc_on_seal",
+        "last_access", "lock", "handle_count", "gc_on_seal", "remote_addr",
+        "foreign",
     )
 
     def __init__(self, object_id: ObjectID):
@@ -109,6 +118,14 @@ class ObjectEntry:
         # reference_count.h:72). 0 handles + sealed → value is GC-eligible.
         self.handle_count = 0
         self.gc_on_seal = False
+        # Address of the executing node still holding a copy (cluster):
+        # set by seal_remote, kept across fetch-through so releasing this
+        # entry can free the remote copy too.
+        self.remote_addr: Optional[str] = None
+        # True when this entry was created for a ref that arrived from
+        # ANOTHER process (nothing local will ever seal it) — the only
+        # entries worth a GCS object-directory lookup on get().
+        self.foreign = False
 
 
 class ObjectStore:
@@ -149,9 +166,22 @@ class ObjectStore:
         self._resubmit: Optional[Callable[[Any], None]] = None
         self._reconstruct_lock = threading.Lock()
         self.max_reconstructions = 3
+        # Cluster hooks (set by core.cluster.ClusterContext):
+        # _fetch_remote(object_id, address) pulls a REMOTE-tier value over
+        # the wire; _locate(object_id) asks the GCS object directory for
+        # the address of an object this process has never seen (reference:
+        # ownership_based_object_directory.h:39 + pull_manager.h:57).
+        self._fetch_remote: Optional[Callable[[ObjectID, str], Any]] = None
+        self._locate: Optional[Callable[[ObjectID], Optional[str]]] = None
+        self._free_remote: Optional[Callable[[ObjectID, str], None]] = None
 
     def set_resubmit(self, fn: Callable[[Any], None]) -> None:
         self._resubmit = fn
+
+    def set_cluster_hooks(self, fetch_remote, locate, free_remote=None) -> None:
+        self._fetch_remote = fetch_remote
+        self._locate = locate
+        self._free_remote = free_remote
 
     # ------------------------------------------------------------------ write
 
@@ -162,7 +192,12 @@ class ObjectStore:
             if entry is None:
                 entry = ObjectEntry(object_id)
                 self._entries[object_id] = entry
-            entry.owner_task = owner_task
+            if owner_task is not None:
+                # never CLEAR recorded lineage: a result push from a node
+                # agent (object_transfer._push_end) calls create() without
+                # an owner, and wiping the submit-time spec would break
+                # reconstruction of exactly the objects that cross the wire
+                entry.owner_task = owner_task
             return entry
 
     def put(self, object_id: ObjectID, value: Any, owner_task=None) -> ObjectEntry:
@@ -280,6 +315,56 @@ class ObjectStore:
         # local_object_manager.h:112).
         self._maybe_spill()
 
+    def seal_remote(self, object_id: ObjectID, address: str) -> None:
+        """Seal an object as a remote placeholder: the value stays in the
+        store of the node at `address` (its ObjectTransferServer); get()
+        fetches through on first access and caches locally. No-op if the
+        value already arrived (e.g. a push raced the location reply)."""
+        with self._lock:
+            entry = self._entries.get(object_id)
+            if entry is None:
+                entry = self.create(object_id)
+        with entry.lock, self._lock:
+            if entry.state == ObjectState.READY:
+                return
+            entry.value = address
+            entry.remote_addr = address
+            entry.tier = Tier.REMOTE
+            entry.state = ObjectState.READY
+            entry.error = None
+            entry.last_access = time.monotonic()
+            callbacks = list(entry.callbacks)
+            entry.callbacks.clear()
+        entry.event.set()
+        for cb in callbacks:
+            cb(entry)
+
+    def _fetch_through(self, entry: ObjectEntry) -> Any:
+        """Pull a REMOTE-tier value from its owner and cache it locally.
+        Caller holds entry.lock (same discipline as _restore: only access
+        to THIS object blocks on the wire). On failure the entry drops to
+        LOST so the get() loop can lineage-reconstruct."""
+        address = entry.value
+        try:
+            value = self._fetch_remote(entry.object_id, address)
+        except Exception:
+            entry.value = None
+            entry.remote_addr = None  # owner unreachable: nothing to free
+            entry.state = ObjectState.LOST
+            entry.event.set()
+            raise _RemoteFetchFailed(entry.object_id, address)
+        nbytes = _estimate_nbytes(value)
+        with self._lock:
+            entry.value = value
+            entry.nbytes = nbytes
+            if _is_device_array(value):
+                entry.tier = Tier.DEVICE
+                self._device_bytes += nbytes
+            else:
+                entry.tier = Tier.INLINE if nbytes <= self._inline_max else Tier.HOST
+                self._host_bytes += nbytes
+        return value
+
     def seal_error(self, object_id: ObjectID, error: BaseException) -> None:
         with self._lock:
             entry = self._entries.get(object_id)
@@ -338,6 +423,23 @@ class ObjectStore:
             entry = self._entries.get(object_id)
             if entry is None:
                 entry = self.create(object_id)
+                entry.foreign = True  # no local producer registered it
+        if (
+            self._locate is not None
+            and entry.foreign
+            and not entry.event.is_set()
+        ):
+            # A ref that crossed from another process: nothing local will
+            # ever seal it. Ask the GCS object directory for its location
+            # (reference: OwnershipBasedObjectDirectory lookup on pull).
+            # Locally-owned pending entries (task/actor returns) never pay
+            # this RPC — they seal through the normal completion path.
+            try:
+                address = self._locate(object_id)
+            except Exception:
+                address = None
+            if address:
+                self.seal_remote(object_id, address)
         deadline = None if timeout is None else time.monotonic() + timeout
         reconstructions = 0
         restored = False
@@ -362,11 +464,24 @@ class ObjectStore:
                     if entry.tier == Tier.SPILLED:
                         value = self._restore(entry)
                         restored = True
+                        done = True
                     elif entry.tier == Tier.SHM:
                         value = self._shm_get(entry)
+                        done = True
+                    elif entry.tier == Tier.REMOTE:
+                        try:
+                            value = self._fetch_through(entry)
+                            # the fetched bytes count against capacity the
+                            # same as a disk restore: spill-check after
+                            restored = True
+                            done = True
+                        except _RemoteFetchFailed:
+                            # owner died: entry is LOST now; fall through to
+                            # the lineage-reconstruction branch below
+                            state = ObjectState.LOST
                     else:
                         value = entry.value
-                    done = True
+                        done = True
             if done:
                 break
             if state == ObjectState.LOST:
@@ -431,12 +546,18 @@ class ObjectStore:
                 entry = self._entries.get(object_id)
                 if entry is None:
                     # Only a re-bound handle (unpickled after the entry was
-                    # fully GC'd) increfs a missing id. There is no producer,
-                    # so surface the loss instead of leaving a PENDING entry
+                    # fully GC'd — or arriving from ANOTHER process) increfs
+                    # a missing id. In cluster mode the object directory may
+                    # know where it lives, so leave it pending+foreign for
+                    # get() to locate; standalone, there is no producer, so
+                    # surface the loss instead of leaving a PENDING entry
                     # nothing will ever seal (get() would hang forever).
                     entry = self.create(object_id)
-                    entry.state = ObjectState.LOST
-                    entry.event.set()
+                    if self._locate is not None:
+                        entry.foreign = True
+                    else:
+                        entry.state = ObjectState.LOST
+                        entry.event.set()
             with entry.lock:
                 entry.handle_count += 1
                 if entry.handle_count > 1:
@@ -534,6 +655,15 @@ class ObjectStore:
                 self._arena.delete(aid)
         if entry.spill_path and os.path.exists(entry.spill_path):
             os.unlink(entry.spill_path)
+        if entry.remote_addr is not None and self._free_remote is not None:
+            # the executing node still holds a copy (whether or not we
+            # fetched it since): ask it to release — best-effort, queued,
+            # never blocks under these locks
+            try:
+                self._free_remote(entry.object_id, entry.remote_addr)
+            except Exception:
+                pass
+            entry.remote_addr = None
         entry.spill_path = None
         entry.value = None
 

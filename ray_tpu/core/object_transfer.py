@@ -58,7 +58,8 @@ class _Transfer:
 class ObjectTransferServer:
     """Expose a runtime's object store for remote pull/push."""
 
-    def __init__(self, object_store, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, object_store, host: str = "127.0.0.1", port: int = 0,
+                 token: Optional[str] = None):
         self._store = object_store
         self._lock = threading.Lock()
         self._outgoing: Dict[str, _Transfer] = {}
@@ -75,8 +76,14 @@ class ObjectTransferServer:
             },
             host=host,
             port=port,
+            token=token,
         )
         self.address = self._server.url
+
+    def register(self, name: str, fn) -> None:
+        """Expose an extra RPC method on this server (the cluster node
+        agent rides the same port: one well-known address per node)."""
+        self._server.register(name, fn)
 
     def _sweep(self, now: float) -> None:
         """Drop transfers older than the TTL (caller holds the lock). A
@@ -174,11 +181,12 @@ def _windows(nbytes: int):
 
 
 def fetch_object(address: str, oid_hex: str, *, timeout: float = 30.0,
-                 client: Optional[RpcClient] = None) -> Any:
+                 client: Optional[RpcClient] = None,
+                 token: Optional[str] = None) -> Any:
     """Pull one object from a remote ObjectTransferServer (reference
     PullManager: locate by owner, fetch chunked, reassemble)."""
     own = client is None
-    client = client or RpcClient(address, timeout=timeout)
+    client = client or RpcClient(address, timeout=timeout, token=token)
     try:
         info = client.call("pull_begin", oid_hex, timeout)
         tid = info["transfer_id"]
@@ -197,13 +205,14 @@ def fetch_object(address: str, oid_hex: str, *, timeout: float = 30.0,
 
 def push_object(address: str, oid_hex: str, value: Any, *,
                 timeout: float = 30.0,
-                client: Optional[RpcClient] = None) -> None:
+                client: Optional[RpcClient] = None,
+                token: Optional[str] = None) -> None:
     """Push one object into a remote runtime's store (reference
     PushManager). Windows slice the original buffers — no monolithic
     payload copy on the sender."""
     meta, buffers = _dumps_oob(value)
     own = client is None
-    client = client or RpcClient(address, timeout=timeout)
+    client = client or RpcClient(address, timeout=timeout, token=token)
     try:
         tid = client.call(
             "push_begin", oid_hex, len(meta), [len(b) for b in buffers]

@@ -18,6 +18,7 @@ Reply: 8-byte length | pickle(("ok", value) | ("err", exception))
 
 from __future__ import annotations
 
+import hmac
 import logging
 import pickle
 import socket
@@ -31,10 +32,18 @@ logger = logging.getLogger(__name__)
 
 _HDR = struct.Struct(">Q")
 MAX_FRAME = 1 << 31  # 2 GiB safety bound
+# Auth handshake prefix. The token check happens BEFORE any unpickling:
+# a pickle payload on the wire is arbitrary code execution, so a server
+# bound off-localhost must drop unauthenticated peers at the first frame.
+_AUTH_MAGIC = b"RAYTPU-AUTH1:"
 
 
 class RpcError(RuntimeError):
     """Transport-level failure (connection refused/reset, bad frame)."""
+
+
+class RpcAuthError(RpcError):
+    """The peer rejected (or required) the cluster auth token."""
 
 
 def _send_frame(sock: socket.socket, payload: bytes) -> None:
@@ -66,8 +75,9 @@ class RpcServer:
     codes + messages the same way)."""
 
     def __init__(self, handlers: Dict[str, Callable], host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, token: Optional[str] = None):
         self.handlers = dict(handlers)
+        self._token = token or None
         self._conns: set = set()
         self._conns_lock = threading.Lock()
         outer = self
@@ -76,6 +86,12 @@ class RpcServer:
             def handle(self):
                 sock = self.request
                 sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                if outer._token is not None and not self._authenticate(sock):
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+                    return
                 with outer._conns_lock:
                     outer._conns.add(sock)
                 try:
@@ -83,6 +99,27 @@ class RpcServer:
                 finally:
                     with outer._conns_lock:
                         outer._conns.discard(sock)
+
+            def _authenticate(self, sock) -> bool:
+                """First frame must be the shared secret — checked with a
+                constant-time compare, with NO unpickling before success
+                (reference: redis password gating every `ray start` port)."""
+                try:
+                    frame = _recv_frame(sock)
+                except (RpcError, OSError):
+                    return False
+                expected = _AUTH_MAGIC + outer._token.encode()
+                if not hmac.compare_digest(frame, expected):
+                    logger.warning(
+                        "rpc: dropped unauthenticated connection from %s",
+                        self.client_address,
+                    )
+                    return False
+                try:
+                    _send_frame(sock, b"ok")
+                except OSError:
+                    return False
+                return True
 
             def _serve_loop(self, sock):
                 while True:
@@ -151,18 +188,32 @@ class RpcClient:
     parallelism — connections are cheap)."""
 
     def __init__(self, address: str, *, timeout: Optional[float] = 30.0,
-                 retries: int = 2, retry_wait_s: float = 0.2):
+                 retries: int = 2, retry_wait_s: float = 0.2,
+                 token: Optional[str] = None):
         host, _, port = address.rpartition(":")
         self._addr = (host or "127.0.0.1", int(port))
         self._timeout = timeout
         self._retries = retries
         self._retry_wait = retry_wait_s
+        self._token = token or None
         self._sock: Optional[socket.socket] = None
         self._lock = threading.Lock()
 
     def _connect(self) -> socket.socket:
         sock = socket.create_connection(self._addr, timeout=self._timeout)
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        if self._token is not None:
+            _send_frame(sock, _AUTH_MAGIC + self._token.encode())
+            try:
+                ack = _recv_frame(sock)
+            except RpcError:
+                sock.close()
+                raise RpcAuthError(
+                    f"server {self._addr} rejected the cluster auth token"
+                ) from None
+            if ack != b"ok":
+                sock.close()
+                raise RpcAuthError(f"bad auth ack from {self._addr}")
         return sock
 
     def call(self, method: str, *args, **kwargs) -> Any:
@@ -178,6 +229,8 @@ class RpcClient:
                     _send_frame(self._sock, payload)
                     frame = _recv_frame(self._sock)
                 status, value = pickle.loads(frame)
+            except RpcAuthError:
+                raise  # wrong/missing token: retrying cannot help
             except (OSError, RpcError) as exc:
                 last = exc
                 with self._lock:

@@ -87,8 +87,15 @@ class Runtime:
         object_store_capacity: Optional[int] = None,
         spill_dir: Optional[str] = None,
         detect_accelerators: bool = True,
+        head: bool = False,
+        address: Optional[str] = None,
+        cluster_token: Optional[str] = None,
+        gcs_port: int = 0,
     ):
         from .config import cfg
+
+        if head and address:
+            raise ValueError("pass either head=True or address=..., not both")
 
         if object_store_capacity is None:
             object_store_capacity = cfg.object_store_capacity_bytes
@@ -124,6 +131,18 @@ class Runtime:
             cfg.oom_policy,
         )
         self.memory_monitor.start()
+        # multi-process cluster membership (core/cluster.py): the head
+        # serves its GCS over RPC; workers join an existing head. Either
+        # way this process gains a node server + remote dispatch.
+        self.cluster = None
+        if head:
+            from .cluster import start_head
+
+            self.cluster = start_head(self, port=gcs_port, token=cluster_token)
+        elif address:
+            from .cluster import join_cluster
+
+            self.cluster = join_cluster(self, address, token=cluster_token)
         self._snapshot_stop = threading.Event()
         self._snapshot_path = cfg.gcs_snapshot_path or None
         if self._snapshot_path:
@@ -516,6 +535,12 @@ class Runtime:
         return list(self._task_events)
 
     def shutdown(self) -> None:
+        if self.cluster is not None:
+            self.cluster.stop()
+            gcs_server = getattr(self.cluster, "gcs_server", None)
+            if gcs_server is not None:
+                gcs_server.stop()
+            self.cluster = None
         self.health.stop()
         self.memory_monitor.stop()
         self._snapshot_stop.set()

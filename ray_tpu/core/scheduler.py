@@ -101,6 +101,9 @@ class TaskSpec:
     stream_max_backlog: Optional[int] = None
     # internal
     attempt: int = 0
+    # resubmits caused by node/worker death (budgeted separately from user
+    # max_retries, reference: task_manager system-failure retries)
+    system_attempts: int = 0
     cancelled: bool = False
     submitted_at: float = field(default_factory=time.monotonic)
     # observability (filled by the task runner; consumed by the timeline)
@@ -114,6 +117,8 @@ class TaskSpec:
 
 class Node:
     """A logical host with its own resource pool."""
+
+    is_remote = False
 
     def __init__(self, node_id: NodeID, resources: ResourceDict, is_head: bool = False,
                  labels: Optional[Dict[str, str]] = None):
@@ -135,6 +140,35 @@ class Node:
 
     def __repr__(self):
         return f"Node({self.node_id.hex()[:8]}, head={self.is_head})"
+
+
+class RemoteNode(Node):
+    """A node whose executor lives in another OS process (a joined node
+    agent, core/cluster.py). Tasks dispatched here go over RPC to the
+    agent at `agent_addr`; results arrive by push or stay remote and are
+    pulled on get(). Equivalent of a remote raylet's resource view in the
+    reference's cluster resource manager
+    (src/ray/raylet/scheduling/cluster_resource_manager.h:42).
+
+    The resource view is optimistic: this process accounts its own
+    dispatches against the node's registered totals; the agent executes
+    whatever arrives (the reference tolerates the same transient
+    oversubscription between resource-view broadcasts)."""
+
+    is_remote = True
+
+    def __init__(self, node_id: NodeID, resources: ResourceDict, agent_addr: str,
+                 token: Optional[str] = None, labels: Optional[Dict[str, str]] = None):
+        super().__init__(node_id, resources, is_head=False, labels=labels)
+        self.agent_addr = agent_addr
+        from .rpc import RpcClient
+
+        # execute_task returns "accepted" immediately; a generous timeout
+        # only bounds the dispatch round-trip, not task duration
+        self.client = RpcClient(agent_addr, timeout=30.0, retries=0, token=token)
+
+    def __repr__(self):
+        return f"RemoteNode({self.node_id.hex()[:8]}, {self.agent_addr})"
 
 
 # ------------------------------------------------------------------ placement grp
@@ -210,6 +244,10 @@ class ClusterScheduler:
         )
         self._dispatch_thread.start()
         self.stats = {"dispatched": 0, "retries": 0, "spillbacks": 0}
+        # Cluster hook (core/cluster.py): callable(spec, node, pool) that
+        # ships a task to a RemoteNode's agent. Never raises — completion
+        # (including dispatch failure) flows back through finish_remote.
+        self.remote_dispatcher: Optional[Callable] = None
 
     # -------------------------------------------------------------- membership
 
@@ -436,9 +474,20 @@ class ClusterScheduler:
             with self._lock:
                 self._pending.extendleft(reversed(deferred))
 
+    def _remotable(self, spec: TaskSpec) -> bool:
+        """Streaming generators need a live in-process stream and actor
+        methods execute in their owner's mailbox — neither can ship to a
+        node agent. Everything else can."""
+        return (
+            not spec.streaming
+            and spec.actor is None
+            and self.remote_dispatcher is not None
+        )
+
     def _try_dispatch(self, spec: TaskSpec) -> bool:
         target: Optional[Node] = None
         pool: Optional[ResourceSet] = None
+        remotable = self._remotable(spec)
 
         strategy = spec.scheduling_strategy
         if isinstance(strategy, PlacementGroupSchedulingStrategy):
@@ -446,6 +495,8 @@ class ClusterScheduler:
             idx = strategy.placement_group_bundle_index
             bundles = pg.bundles if idx < 0 else [pg.bundles[idx]]
             for bundle in bundles:
+                if bundle.node is not None and bundle.node.is_remote and not remotable:
+                    continue
                 if bundle.reserved is not None and bundle.reserved.try_acquire(spec.resources):
                     target, pool = bundle.node, bundle.reserved
                     break
@@ -454,6 +505,17 @@ class ClusterScheduler:
         elif isinstance(strategy, NodeAffinitySchedulingStrategy):
             with self._lock:
                 node = self._nodes.get(strategy.node_id)
+            if node is not None and node.is_remote and not remotable:
+                if not strategy.soft:
+                    self._fail_returns(
+                        spec,
+                        OutOfResourcesError(
+                            f"Task {spec.name} (streaming or actor-bound) cannot "
+                            f"run on remote node {strategy.node_id}"
+                        ),
+                    )
+                    return True
+                node = None  # soft affinity: fall back to a local node
             if node is None or not node.alive:
                 if not strategy.soft:
                     self._fail_returns(
@@ -503,17 +565,29 @@ class ClusterScheduler:
         self.stats["dispatched"] += 1
         with target._lock:
             target.running_tasks[spec.task_id] = spec
-        thread = threading.Thread(
-            target=self._run_task,
-            args=(spec, target, pool),
-            name=f"ray_tpu-worker-{spec.name}-{spec.task_id.hex()[:6]}",
-            daemon=True,
-        )
+        if target.is_remote:
+            # Ship to the node agent. The dispatcher thread only covers the
+            # (bounded) dispatch RPC; completion arrives asynchronously via
+            # finish_remote when the agent reports task_done.
+            thread = threading.Thread(
+                target=self.remote_dispatcher,
+                args=(spec, target, pool),
+                name=f"ray_tpu-dispatch-{spec.name}-{spec.task_id.hex()[:6]}",
+                daemon=True,
+            )
+        else:
+            thread = threading.Thread(
+                target=self._run_task,
+                args=(spec, target, pool),
+                name=f"ray_tpu-worker-{spec.name}-{spec.task_id.hex()[:6]}",
+                daemon=True,
+            )
         thread.start()
         return True
 
     def _pick_node(self, spec: TaskSpec) -> Optional[Node]:
-        nodes = [n for n in self.nodes() if n.alive]
+        remotable = self._remotable(spec)
+        nodes = [n for n in self.nodes() if n.alive and (remotable or not n.is_remote)]
         feasible = [
             n for n in nodes
             if all(n.resources.available().get(k, 0.0) >= v - 1e-9 for k, v in spec.resources.items())
@@ -572,7 +646,33 @@ class ClusterScheduler:
             with node._lock:
                 node.running_tasks.pop(spec.task_id, None)
 
+        self._complete(spec, error, error_tb)
+
+    def _complete(self, spec: TaskSpec, error: Optional[BaseException],
+                  error_tb: str = "", system_failure: bool = False) -> None:
+        """Shared completion tail for local and remote execution: retry
+        bookkeeping, return sealing on failure, task-done event."""
         if error is not None:
+            if system_failure:
+                # The executing node/worker died — not the task's fault.
+                # Budgeted separately from user retries (the reference
+                # resubmits system failures by default, task_manager.cc).
+                from .config import cfg
+
+                if spec.system_attempts < cfg.system_failure_retries and not spec.cancelled:
+                    spec.system_attempts += 1
+                    self.stats["retries"] += 1
+                    logger.warning(
+                        "resubmitting task %s after node failure (%d): %s",
+                        spec.name, spec.system_attempts, error,
+                    )
+                    self.submit(spec)
+                    return
+                self._fail_returns(spec, error)
+                spec.end_ts = time.time()
+                self._on_task_done(spec, error)
+                self._wake.set()
+                return
             retriable = spec.attempt < spec.max_retries and (
                 spec.retry_exceptions is True
                 or (isinstance(spec.retry_exceptions, (list, tuple))
@@ -588,6 +688,18 @@ class ClusterScheduler:
         spec.end_ts = time.time()
         self._on_task_done(spec, error)
         self._wake.set()
+
+    def finish_remote(self, spec: TaskSpec, node: Node, pool: ResourceSet,
+                      error: Optional[BaseException] = None, error_tb: str = "",
+                      system_failure: bool = False) -> None:
+        """Completion entry point for remotely dispatched tasks (called by
+        the cluster context when the agent reports task_done, or when the
+        agent's node died). Returns were already sealed by push/placeholder
+        on success."""
+        pool.release(spec.resources)
+        with node._lock:
+            node.running_tasks.pop(spec.task_id, None)
+        self._complete(spec, error, error_tb, system_failure=system_failure)
 
     def _seal_returns(self, spec: TaskSpec, result: Any) -> None:
         if spec.streaming:
