@@ -13,6 +13,7 @@ from __future__ import annotations
 import os
 import subprocess
 import sys
+import tempfile
 import time
 from typing import Any, Dict, List, Optional
 
@@ -22,9 +23,10 @@ from .core.rpc import RpcClient, RpcError
 class NodeHandle:
     """One spawned worker-agent process."""
 
-    def __init__(self, proc: subprocess.Popen, num_cpus: int):
+    def __init__(self, proc: subprocess.Popen, num_cpus: int, log_path: str):
         self.proc = proc
         self.num_cpus = num_cpus
+        self.log_path = log_path
 
     @property
     def pid(self) -> int:
@@ -32,6 +34,13 @@ class NodeHandle:
 
     def alive(self) -> bool:
         return self.proc.poll() is None
+
+    def logs(self) -> str:
+        try:
+            with open(self.log_path, "r") as f:
+                return f.read()
+        except OSError:
+            return ""
 
 
 class Cluster:
@@ -73,11 +82,19 @@ class Cluster:
         for key, value in (system_config or {}).items():
             child_env[f"RAY_TPU_{key.upper()}"] = str(value)
         child_env.update(env or {})
-        proc = subprocess.Popen(
-            cmd, env=child_env,
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-        )
-        handle = NodeHandle(proc, num_cpus)
+        # Log to a FILE, not a pipe: nothing drains a pipe while the agent
+        # runs, so a chatty worker would block on a full pipe buffer, stop
+        # heartbeating, and be declared dead.
+        fd, log_path = tempfile.mkstemp(prefix="ray_tpu_agent_", suffix=".log")
+        log_file = os.fdopen(fd, "w")
+        try:
+            proc = subprocess.Popen(
+                cmd, env=child_env,
+                stdout=log_file, stderr=subprocess.STDOUT, text=True,
+            )
+        finally:
+            log_file.close()  # the child holds its own descriptor
+        handle = NodeHandle(proc, num_cpus, log_path)
         self._nodes.append(handle)
         return handle
 
@@ -90,10 +107,9 @@ class Cluster:
                 return
             for handle in self._nodes:
                 if not handle.alive():
-                    out = handle.proc.stdout.read() if handle.proc.stdout else ""
                     raise RuntimeError(
                         f"worker agent pid={handle.pid} exited "
-                        f"rc={handle.proc.returncode}:\n{out}"
+                        f"rc={handle.proc.returncode}:\n{handle.logs()}"
                     )
             time.sleep(0.05)
         raise TimeoutError(

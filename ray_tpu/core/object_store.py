@@ -424,23 +424,38 @@ class ObjectStore:
             if entry is None:
                 entry = self.create(object_id)
                 entry.foreign = True  # no local producer registered it
+        deadline = None if timeout is None else time.monotonic() + timeout
         if (
             self._locate is not None
             and entry.foreign
             and not entry.event.is_set()
         ):
             # A ref that crossed from another process: nothing local will
-            # ever seal it. Ask the GCS object directory for its location
+            # ever seal it — a push may arrive, or the value sits in a
+            # remote store registered in the GCS object directory
             # (reference: OwnershipBasedObjectDirectory lookup on pull).
-            # Locally-owned pending entries (task/actor returns) never pay
-            # this RPC — they seal through the normal completion path.
-            try:
-                address = self._locate(object_id)
-            except Exception:
-                address = None
-            if address:
-                self.seal_remote(object_id, address)
-        deadline = None if timeout is None else time.monotonic() + timeout
+            # POLL the directory while waiting: the producer may register
+            # the location after this get() started (a task still
+            # running, or the objdir write racing us by milliseconds).
+            # Locally-owned pending entries never pay this RPC.
+            poll = 0.02
+            while not entry.event.is_set():
+                try:
+                    address = self._locate(object_id)
+                except Exception:
+                    address = None
+                if address:
+                    self.seal_remote(object_id, address)
+                    break
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    raise GetTimeoutError(
+                        f"Get timed out after {timeout}s waiting for "
+                        f"{object_id} (no location registered)"
+                    )
+                wait_s = poll if remaining is None else min(poll, remaining)
+                entry.event.wait(wait_s)
+                poll = min(poll * 2, 1.0)
         reconstructions = 0
         restored = False
         while True:

@@ -254,12 +254,19 @@ class Runtime:
         done_event.wait(timeout)
         for ref in refs:
             self.object_store.remove_ready_callback(ref.object_id, _cb)
-        ready = [r for r in refs if self.object_store.is_ready(r.object_id)]
-        not_ready = [r for r in refs if not self.object_store.is_ready(r.object_id)]
+        # Set-based bookkeeping: the reference envelope is 10k+ refs in
+        # flight (release/benchmarks/README.md:29) — membership scans over
+        # lists would make this quadratic.
+        ready_all: List[ObjectRef] = []
+        not_ready: List[ObjectRef] = []
+        for r in refs:
+            (ready_all if self.object_store.is_ready(r.object_id) else not_ready).append(r)
         # ray.wait contract: at most num_returns refs in the ready list;
         # surplus ready refs stay in the second list, order preserved.
-        surplus = ready[num_returns:]
-        return ready[:num_returns], [r for r in refs if r in surplus or r in not_ready]
+        ready = ready_all[:num_returns]
+        second_ids = {r.object_id for r in ready_all[num_returns:]}
+        second_ids.update(r.object_id for r in not_ready)
+        return ready, [r for r in refs if r.object_id in second_ids]
 
     # ------------------------------------------------------------------ tasks
 

@@ -56,9 +56,14 @@ class ActorPoolStrategy:
     instead of stateless tasks (reference ActorPoolMapOperator,
     _internal/execution/operators/actor_pool_map_operator.py). Use with a
     CLASS udf whose (expensive) __init__ runs once per actor — model
-    weights, tokenizers — and whose __call__ maps a block."""
+    weights, tokenizers — and whose __call__ maps a block.
+
+    executor="process" hosts each actor in its own OS worker process
+    (GIL-free: CPU-bound udfs — tokenization, image decode — scale with
+    cores, the exact Ray Data workload)."""
 
     size: int = 2
+    executor: str = "thread"
 
 
 @dataclasses.dataclass
@@ -92,6 +97,9 @@ class _Op:
     compute: Optional[ActorPoolStrategy] = None
     fn_args: tuple = ()
     fn_kwargs: Optional[Dict[str, Any]] = None
+    # "thread" (zero-copy, GIL-shared) or "process" (pooled OS workers,
+    # GIL-free CPU parallelism) for stateless map/filter stages
+    executor: str = "thread"
 
 
 class _BlockUDFActor:
@@ -131,7 +139,9 @@ def _actor_pool_stream(
     Actors are killed when the stage drains."""
     actor_cls = api.remote(_BlockUDFActor)
     pool = [
-        actor_cls.options(num_cpus=1).remote(op.fn, op.fn_args, op.fn_kwargs)
+        actor_cls.options(
+            num_cpus=1, executor=op.compute.executor
+        ).remote(op.fn, op.fn_args, op.fn_kwargs)
         for _ in builtins.range(op.compute.size)  # module range() is a Dataset
     ]
     produced: deque = deque()
@@ -197,7 +207,7 @@ def _plan_iter(ops: List[_Op], ctx: DataContext) -> Iterator[Any]:
 
     for op in ops[1:]:
         if op.kind == "map_batches":
-            map_remote = api.remote(op.fn)
+            map_remote = api.remote(op.fn).options(executor=op.executor)
             stream = _stream_submit(
                 stream, lambda ref, r=map_remote: r.remote(ref), ctx.prefetch_blocks
             )
@@ -210,7 +220,7 @@ def _plan_iter(ops: List[_Op], ctx: DataContext) -> Iterator[Any]:
                 keep = np.asarray([bool(fn(row)) for row in block_to_items(block)])
                 return block_take(block, np.nonzero(keep)[0]) if len(keep) else block
 
-            filt_remote = api.remote(filter_block)
+            filt_remote = api.remote(filter_block).options(executor=op.executor)
             stream = _stream_submit(
                 stream, lambda ref, r=filt_remote: r.remote(ref), ctx.prefetch_blocks
             )
@@ -296,12 +306,24 @@ class Dataset:
         compute: Optional[ActorPoolStrategy] = None,
         fn_constructor_args: tuple = (),
         fn_constructor_kwargs: Optional[Dict[str, Any]] = None,
+        executor: str = "thread",
     ) -> "Dataset":
         """Map blocks with a function (stateless tasks) or, with
         compute=ActorPoolStrategy(n), a CLASS udf hosted on a pool of n
         stateful actors — __init__ runs once per actor (reference
-        ActorPoolMapOperator)."""
+        ActorPoolMapOperator).
+
+        executor="process" runs the udf in pooled OS worker processes —
+        GIL-free, so CPU-bound udfs (tokenization, image decode) get real
+        multi-core scaling (reference: Ray Data tasks always run in
+        separate worker processes, task_pool_map_operator.py)."""
         if compute is not None:
+            if executor != "thread":
+                raise ValueError(
+                    "pass the executor on the strategy instead: "
+                    "compute=ActorPoolStrategy(n, executor='process') — the "
+                    "executor= kwarg only applies to stateless task maps"
+                )
             return Dataset(
                 self._ops + [_Op(
                     "map_batches_actors", fn=fn, compute=compute,
@@ -315,16 +337,18 @@ class Dataset:
                 "class udfs need compute=ActorPoolStrategy(n) so instances "
                 "have somewhere stateful to live"
             )
-        return Dataset(self._ops + [_Op("map_batches", fn=fn)], self._ctx)
+        return Dataset(
+            self._ops + [_Op("map_batches", fn=fn, executor=executor)], self._ctx
+        )
 
-    def map(self, fn: Callable[[Any], Any]) -> "Dataset":
+    def map(self, fn: Callable[[Any], Any], *, executor: str = "thread") -> "Dataset":
         def apply(block: Block) -> Block:
             return block_from_items([fn(row) for row in block_to_items(block)])
 
-        return self.map_batches(apply)
+        return self.map_batches(apply, executor=executor)
 
-    def filter(self, fn: Callable[[Any], bool]) -> "Dataset":
-        return Dataset(self._ops + [_Op("filter", fn=fn)], self._ctx)
+    def filter(self, fn: Callable[[Any], bool], *, executor: str = "thread") -> "Dataset":
+        return Dataset(self._ops + [_Op("filter", fn=fn, executor=executor)], self._ctx)
 
     def limit(self, n: int) -> "Dataset":
         return Dataset(self._ops + [_Op("limit", n=n)], self._ctx)

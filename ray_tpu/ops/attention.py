@@ -465,3 +465,64 @@ def flash_attention(
     if out.shape[2] != sq:
         out = out[:, :, :sq]
     return out
+
+
+def flash_attention_with_lse(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    sm_scale: Optional[float] = None,
+    block_q: int = 1024,
+    block_kv: int = 1024,
+    implementation: Optional[str] = None,
+) -> "tuple[jax.Array, jax.Array]":
+    """Like flash_attention but also returns the per-row logsumexp of the
+    scaled scores, shape (B, Hq, Sq, 1) float32 — the carry blockwise
+    consumers (ring attention) need to merge partial attentions exactly.
+
+    FORWARD ONLY: no VJP is registered through the lse output; callers
+    that need gradients wrap their own (ring_attention's custom_vjp
+    recomputes through the einsum reference)."""
+    if implementation is None:
+        implementation = "pallas" if jax.default_backend() == "tpu" else "xla"
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    if implementation == "xla" or not _HAS_PLTPU:
+        _, hq, sq, _ = q.shape
+        _, hkv, skv, _ = k.shape
+        if hq != hkv:
+            groups = hq // hkv
+            k = jnp.repeat(k, groups, axis=1)
+            v = jnp.repeat(v, groups, axis=1)
+        s = jnp.einsum(
+            "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
+        ) * sm_scale
+        if causal:
+            if sq != skv:
+                raise NotImplementedError("causal requires Sq == Skv")
+            row = jnp.arange(sq)[:, None]
+            col = jnp.arange(skv)[None, :]
+            s = jnp.where(col <= row, s, _NEG_INF)
+        m = jnp.max(s, axis=-1, keepdims=True)
+        p = jnp.exp(s - m)
+        l = jnp.sum(p, axis=-1, keepdims=True)
+        out = jnp.einsum("bhqk,bhkd->bhqd", p / l, v.astype(p.dtype))
+        return out.astype(q.dtype), m + jnp.log(l)
+    sq, skv = q.shape[2], k.shape[2]
+    if causal and sq != skv:
+        raise NotImplementedError("causal flash kernel requires Sq == Skv")
+    block_q = min(block_q, max(sq, 1))
+    block_kv = min(block_kv, max(skv, 1))
+    qp = _pad_seq(q, 2, block_q)
+    kp = _pad_seq(k, 2, block_kv)
+    vp = _pad_seq(v, 2, block_kv)
+    interpret = jax.default_backend() != "tpu"
+    out, lse = _fwd_pallas(
+        qp, kp, vp, causal, sm_scale, block_q, block_kv, skv, interpret
+    )
+    if out.shape[2] != sq:
+        out = out[:, :, :sq]
+        lse = lse[:, :, :sq]
+    return out, lse

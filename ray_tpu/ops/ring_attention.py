@@ -114,6 +114,103 @@ def _ring_attention_local(
     return (acc / safe_l).astype(q.dtype)
 
 
+def _ring_fused_local(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str,
+    causal: bool,
+    sm_scale: float,
+):
+    """Fused per-shard body: each ring block runs through the Pallas flash
+    kernel (ops/attention.py — online softmax INSIDE the block stays in
+    VMEM, no (S_local × S_local) f32 logits in HBM) and blocks merge
+    across ring steps by logsumexp reweighting, which is algebraically
+    the same online-softmax recurrence the einsum body carries as
+    (m, l, acc). The diagonal block is the causal kernel; past blocks the
+    full kernel; future blocks skip (Liu et al. causal skipping)."""
+    from .attention import flash_attention_with_lse
+
+    n = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    b, h, s_local, d = q.shape
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def merge(o_acc, lse_acc, o_new, lse_new):
+        lse = jnp.logaddexp(lse_acc, lse_new)
+        w_acc = jnp.exp(lse_acc - lse)
+        w_new = jnp.exp(lse_new - lse)
+        return o_acc * w_acc + o_new.astype(jnp.float32) * w_new, lse
+
+    def diag(o_acc, lse_acc, k_cur, v_cur):
+        o, lse = flash_attention_with_lse(
+            q, k_cur, v_cur, causal=True, sm_scale=sm_scale
+        )
+        return merge(o_acc, lse_acc, o, lse)
+
+    def full(o_acc, lse_acc, k_cur, v_cur):
+        o, lse = flash_attention_with_lse(
+            q, k_cur, v_cur, causal=False, sm_scale=sm_scale
+        )
+        return merge(o_acc, lse_acc, o, lse)
+
+    def step(carry, step_idx):
+        o_acc, lse_acc, k_cur, v_cur = carry
+        kv_idx = (my_idx - step_idx) % n
+        if causal:
+            branch = jnp.where(
+                kv_idx > my_idx, 0, jnp.where(kv_idx == my_idx, 1, 2)
+            )
+            o_acc, lse_acc = lax.switch(
+                branch,
+                [lambda o, l, *_: (o, l), diag, full],
+                o_acc, lse_acc, k_cur, v_cur,
+            )
+        else:
+            o_acc, lse_acc = full(o_acc, lse_acc, k_cur, v_cur)
+        k_next = lax.ppermute(k_cur, axis_name, perm)
+        v_next = lax.ppermute(v_cur, axis_name, perm)
+        return (o_acc, lse_acc, k_next, v_next), None
+
+    o0 = jnp.zeros((b, h, s_local, d), jnp.float32)
+    lse0 = jnp.full((b, h, s_local, 1), _NEG_INF, jnp.float32)
+    (o_acc, lse_acc, _, _), _ = lax.scan(
+        step, (o0, lse0, k, v), jnp.arange(n)
+    )
+    return o_acc.astype(q.dtype)
+
+
+def _make_fused_body(axis_name: str, causal: bool, sm_scale: float):
+    """Fused forward + einsum-reference backward. The flash kernel's VJP
+    does not thread through the cross-step lse merge, so the backward
+    recomputes the whole ring via the differentiable einsum body — same
+    collective pattern, transposed ppermutes, mathematically identical."""
+
+    @jax.custom_vjp
+    def body(q, k, v):
+        return _ring_fused_local(
+            q, k, v, axis_name=axis_name, causal=causal, sm_scale=sm_scale
+        )
+
+    def fwd(q, k, v):
+        return body(q, k, v), (q, k, v)
+
+    def bwd(res, g):
+        q, k, v = res
+        _, pullback = jax.vjp(
+            lambda q_, k_, v_: _ring_attention_local(
+                q_, k_, v_, axis_name=axis_name, causal=causal,
+                sm_scale=sm_scale,
+            ),
+            q, k, v,
+        )
+        return pullback(g)
+
+    body.defvjp(fwd, bwd)
+    return body
+
+
 def ring_attention(
     q: jax.Array,
     k: jax.Array,
@@ -123,9 +220,14 @@ def ring_attention(
     axis: str = "sp",
     causal: bool = False,
     sm_scale: Optional[float] = None,
+    impl: str = "fused",
 ) -> jax.Array:
     """Sequence-parallel exact attention. q (B,Hq,S,D), k/v (B,Hkv,S,D);
-    S must divide by mesh.shape[axis]. Returns (B,Hq,S,D) sharded like q."""
+    S must divide by mesh.shape[axis]. Returns (B,Hq,S,D) sharded like q.
+
+    impl: "fused" (default — per-block Pallas flash kernel on TPU, fused
+    XLA reference elsewhere) or "einsum" (the original blockwise einsum
+    body; also the backward path of "fused")."""
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
     hq, hkv = q.shape[1], k.shape[1]
@@ -138,9 +240,15 @@ def ring_attention(
         raise ValueError(f"seq {q.shape[2]} not divisible by {axis}={n}")
 
     spec = P(None, None, axis, None)
-    body = functools.partial(
-        _ring_attention_local, axis_name=axis, causal=causal, sm_scale=sm_scale
-    )
+    if impl == "fused":
+        body = _make_fused_body(axis, causal, sm_scale)
+    elif impl == "einsum":
+        body = functools.partial(
+            _ring_attention_local, axis_name=axis, causal=causal,
+            sm_scale=sm_scale,
+        )
+    else:
+        raise ValueError(f"unknown ring impl {impl!r}")
     fn = jax.shard_map(
         body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False,

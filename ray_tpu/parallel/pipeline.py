@@ -140,17 +140,25 @@ def make_pp_loss_fn(
 
         y_mb = spmd_pipeline(stage_fn, blocks, x_mb, axis="pp")
         y = y_mb.reshape(b, seq, -1)
-        y = _norm(y, rest["lnf_scale"], rest.get("lnf_bias"), c.norm)
-        head = rest.get("lm_head")
-        if head is None:
-            head = rest["wte"].T
-        logits = jnp.einsum("bse,ev->bsv", y, head.astype(dt))
-        loss, _ = cross_entropy_loss(logits, tgt, z_loss_coeff=z_loss_coeff)
-        # only the last stage holds real outputs; zero-mask the rest, then
-        # reassemble the replicated scalar: sum over pp, mean over dp
+
+        def head_loss(y):
+            yn = _norm(y, rest["lnf_scale"], rest.get("lnf_bias"), c.norm)
+            head = rest.get("lm_head")
+            if head is None:
+                head = rest["wte"].T
+            logits = jnp.einsum("bse,ev->bsv", yn, head.astype(dt))
+            loss, _ = cross_entropy_loss(logits, tgt, z_loss_coeff=z_loss_coeff)
+            return loss.astype(jnp.float32)
+
+        # Head/loss ONLY on the final stage: lax.cond executes one branch
+        # at runtime, so non-final stages skip the (B, S, V) vocab matmul
+        # entirely — head compute is x1, not xS (VERDICT r3 #6; the old
+        # where-mask zeroed the loss but still burned the FLOPs).
         s = jax.lax.axis_index("pp")
         n = jax.lax.psum(1, "pp")
-        loss = jnp.where(s == n - 1, loss, 0.0)
+        loss = jax.lax.cond(
+            s == n - 1, head_loss, lambda _: jnp.zeros((), jnp.float32), y
+        )
         loss = jax.lax.psum(loss, "pp")
         for ax in other_axes:
             loss = jax.lax.pmean(loss, ax)
@@ -169,6 +177,259 @@ def make_pp_loss_fn(
         return sharded(blocks, rest, tokens)
 
     return loss_fn
+
+
+def spmd_pipeline_1f1b(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    head_vjp_fn: Callable[[jax.Array, jax.Array], Tuple[jax.Array, Any, jax.Array]],
+    stage_params: Any,
+    microbatches: jax.Array,   # (M, mb, seq, E) — stage-0 inputs
+    targets: jax.Array,        # (M, mb, seq) — last-stage targets
+    *,
+    n_stages: int,
+    axis: str = "pp",
+):
+    """One-program 1F1B: every tick runs one microbatch FORWARD and one
+    microbatch BACKWARD per stage, so a microbatch's backward starts as
+    soon as its forward reaches the last stage. The activation stash is a
+    ring buffer of 2S-1 slots — bounded by the PIPELINE DEPTH, not the
+    microbatch count (GPipe-through-AD stashes all M+S-1 ticks). The
+    stage backward recomputes its forward from the stashed input
+    (activation remat), the standard memory/FLOP trade of 1F1B-on-XLA.
+
+    Reference substrate being inverted: the compiled-DAG runtime schedule
+    (python/ray/dag/compiled_dag_node.py:805) where actor stages exchange
+    tensors through channels under a driver-sequenced 1F1B loop — here
+    the whole schedule is ONE lax.scan; "channels" are ppermute DMAs and
+    the interleaving is the tick arithmetic:
+
+        fwd  of microbatch m at stage s: tick  s + m
+        bwd  of microbatch m at stage s: tick  2(S-1) - s + m
+
+    so the last stage backs a microbatch the same tick it forwards it,
+    and grads ride the reverse ring one hop per tick. Total ticks
+    M + 2(S-1).
+
+    head_vjp_fn(y, tgt) -> (loss_mb, d_head_params_mb, dy) runs ONLY on
+    the last stage (lax.cond), already scaled for the 1/M loss mean.
+    Returns (loss_sum, d_stage_params, d_head_params, dx_microbatches) —
+    loss/d_head valid (nonzero) on the last stage, dx on stage 0; callers
+    psum over the pp axis.
+    """
+    s_idx = jax.lax.axis_index(axis)
+    num_mb = microbatches.shape[0]
+    ring = min(num_mb, 2 * n_stages - 1)  # max in-flight per stage
+    perm_fwd = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+    perm_bwd = [(i, (i - 1) % n_stages) for i in range(n_stages)]
+    last = n_stages - 1
+
+    x0 = microbatches[0]
+    d_stage_zero = jax.tree.map(jnp.zeros_like, stage_params)
+    _, d_head_zero, _ = jax.eval_shape(
+        head_vjp_fn, x0, targets[0]
+    )
+    d_head_zero = jax.tree.map(
+        lambda a: jnp.zeros(a.shape, a.dtype), d_head_zero
+    )
+
+    def tick(carry, t):
+        fwd_buf, bwd_buf, stash, d_stage, d_head, dx_out, loss_acc = carry
+
+        # ------------------------------------------------------- forward
+        m_f = t - s_idx
+        fwd_valid = jnp.logical_and(m_f >= 0, m_f < num_mb)
+        feed = jax.lax.dynamic_index_in_dim(
+            microbatches, jnp.clip(m_f, 0, num_mb - 1), 0, keepdims=False
+        )
+        x_in = jnp.where(s_idx == 0, feed, fwd_buf)
+        y = jax.lax.cond(
+            fwd_valid,
+            lambda x: stage_fn(stage_params, x),
+            lambda x: jnp.zeros_like(x),
+            x_in,
+        )
+        # stash this tick's input for the (recomputing) backward
+        slot_f = jnp.clip(m_f, 0, num_mb - 1) % ring
+        prev = jax.lax.dynamic_index_in_dim(stash, slot_f, 0, keepdims=False)
+        stash = jax.lax.dynamic_update_index_in_dim(
+            stash, jnp.where(fwd_valid, x_in, prev), slot_f, 0
+        )
+
+        # -------------------------------------- last-stage loss head + dy
+        m_b = t - (2 * (n_stages - 1) - s_idx)
+        bwd_valid = jnp.logical_and(m_b >= 0, m_b < num_mb)
+        tgt = jax.lax.dynamic_index_in_dim(
+            targets, jnp.clip(m_b, 0, num_mb - 1), 0, keepdims=False
+        )
+        # On the last stage m_b == m_f: the microbatch just forwarded is
+        # backed this same tick, its dy coming from the loss head.
+        do_head = jnp.logical_and(s_idx == last, bwd_valid)
+        loss_mb, d_head_mb, dy_head = jax.lax.cond(
+            do_head,
+            head_vjp_fn,
+            lambda y, _t: (
+                jnp.zeros((), jnp.float32),
+                d_head_zero,
+                jnp.zeros_like(y),
+            ),
+            y, tgt,
+        )
+        loss_acc = loss_acc + loss_mb
+        d_head = jax.tree.map(jnp.add, d_head, d_head_mb)
+        dy_in = jnp.where(s_idx == last, dy_head, bwd_buf)
+
+        # ------------------------------------------------------ backward
+        slot_b = jnp.clip(m_b, 0, num_mb - 1) % ring
+        x_saved = jax.lax.dynamic_index_in_dim(stash, slot_b, 0, keepdims=False)
+
+        def do_bwd(args):
+            x_, dy_ = args
+            _, pull = jax.vjp(stage_fn, stage_params, x_)
+            return pull(dy_)
+
+        def no_bwd(args):
+            x_, dy_ = args
+            return d_stage_zero, jnp.zeros_like(x_)
+
+        d_stage_mb, dx_mb = jax.lax.cond(
+            bwd_valid, do_bwd, no_bwd, (x_saved, dy_in)
+        )
+        d_stage = jax.tree.map(jnp.add, d_stage, d_stage_mb)
+        # stage 0 banks the input grad for the embedding backward outside
+        out_slot = jnp.clip(m_b, 0, num_mb - 1)
+        cur = jax.lax.dynamic_index_in_dim(dx_out, out_slot, 0, keepdims=False)
+        bank = jnp.logical_and(s_idx == 0, bwd_valid)
+        dx_out = jax.lax.dynamic_update_index_in_dim(
+            dx_out, jnp.where(bank, dx_mb, cur), out_slot, 0
+        )
+
+        # --------------------------------------------------- communicate
+        fwd_buf = jax.lax.ppermute(y, axis, perm_fwd)
+        bwd_buf = jax.lax.ppermute(dx_mb, axis, perm_bwd)
+        return (fwd_buf, bwd_buf, stash, d_stage, d_head, dx_out, loss_acc), None
+
+    carry0 = (
+        jnp.zeros_like(x0),                                   # fwd_buf
+        jnp.zeros_like(x0),                                   # bwd_buf
+        jnp.zeros((ring,) + x0.shape, x0.dtype),              # stash
+        d_stage_zero,
+        d_head_zero,
+        jnp.zeros_like(microbatches),                         # dx_out
+        jnp.zeros((), jnp.float32),                           # loss_acc
+    )
+    total_ticks = num_mb + 2 * (n_stages - 1)
+    (_, _, _, d_stage, d_head, dx_out, loss_acc), _ = jax.lax.scan(
+        tick, carry0, jnp.arange(total_ticks)
+    )
+    return loss_acc, d_stage, d_head, dx_out
+
+
+def make_pp_loss_and_grad_1f1b(
+    config: TransformerConfig,
+    mesh: Mesh,
+    num_microbatches: int,
+    *,
+    z_loss_coeff: float = 0.0,
+) -> Callable[[Any, jax.Array], Tuple[jax.Array, Any]]:
+    """(loss, grads) under the 1F1B schedule — manual pipeline AD: the
+    embedding forward/backward runs outside the scan (its input grads
+    come back from stage 0), the loss head runs inside the last stage's
+    ticks, and stage grads accumulate per tick. Gradients are exactly the
+    GPipe path's (test_pipeline asserts it); only schedule and memory
+    differ."""
+    n_stages = mesh.shape["pp"]
+    if config.n_layers % n_stages != 0:
+        raise ValueError(
+            f"n_layers={config.n_layers} not divisible by pp={n_stages}"
+        )
+    c = config
+    dt = c.dtype
+
+    blocks_spec = P("pp")
+    rest_spec = P()
+    tokens_spec = P("dp", None)
+    other_axes = tuple(a for a in mesh.axis_names if a != "pp")
+
+    def device_loss_grad(blocks, rest, tokens):
+        inp, tgt = tokens[:, :-1], tokens[:, 1:]
+        b, seq = inp.shape
+        mb = b // num_microbatches
+        if b % num_microbatches:
+            raise ValueError(
+                f"per-dp-shard batch {b} not divisible by "
+                f"num_microbatches={num_microbatches}"
+            )
+        if c.pos_emb == "learned":
+            rope_tables = None
+        else:
+            rope_tables = rope_frequencies(c.head_dim, c.max_seq, c.rope_theta)
+
+        def embed_fn(rest_p):
+            x = rest_p["wte"].astype(dt)[inp]
+            if c.pos_emb == "learned":
+                x = x + rest_p["wpe"].astype(dt)[None, :seq]
+            return x
+
+        x, embed_pull = jax.vjp(embed_fn, rest)
+        x_mb = x.reshape(num_microbatches, mb, seq, x.shape[-1])
+        tgt_mb = tgt.reshape(num_microbatches, mb, seq)
+
+        def stage_fn(stage_blocks, x):
+            def body(carry, lp):
+                return _block(carry, lp, c, rope_tables, None), None
+            y, _ = jax.lax.scan(body, x, stage_blocks)
+            return y
+
+        inv_m = 1.0 / num_microbatches
+
+        def head_loss(rest_p, y, t):
+            yn = _norm(y, rest_p["lnf_scale"], rest_p.get("lnf_bias"), c.norm)
+            head = rest_p.get("lm_head")
+            if head is None:
+                head = rest_p["wte"].T
+            logits = jnp.einsum("bse,ev->bsv", yn, head.astype(dt))
+            loss, _ = cross_entropy_loss(logits, t, z_loss_coeff=z_loss_coeff)
+            return loss.astype(jnp.float32)
+
+        def head_vjp_fn(y, t):
+            (loss, pull) = jax.vjp(lambda rp, y_: head_loss(rp, y_, t), rest, y)
+            d_rest, dy = pull(jnp.asarray(inv_m, jnp.float32))
+            return loss * inv_m, d_rest, dy
+
+        loss, d_blocks, d_rest_head, dx_mb = spmd_pipeline_1f1b(
+            stage_fn, head_vjp_fn, blocks, x_mb, tgt_mb,
+            n_stages=n_stages, axis="pp",
+        )
+        # embedding backward: dx is nonzero only on stage 0, so the embed
+        # grads it produces are too — one psum over pp recovers exactly
+        # one stage's embed grads plus one stage's head grads
+        dx = dx_mb.reshape(b, seq, -1)
+        (d_rest_embed,) = embed_pull(dx)
+        d_rest = jax.tree.map(jnp.add, d_rest_head, d_rest_embed)
+        loss = jax.lax.psum(loss, "pp")
+        d_rest = jax.tree.map(lambda g: jax.lax.psum(g, "pp"), d_rest)
+        for ax in other_axes:
+            loss = jax.lax.pmean(loss, ax)
+            d_rest = jax.tree.map(lambda g: jax.lax.pmean(g, ax), d_rest)
+            d_blocks = jax.tree.map(lambda g: jax.lax.pmean(g, ax), d_blocks)
+        return loss, d_blocks, d_rest
+
+    sharded = shard_map(
+        device_loss_grad,
+        mesh=mesh,
+        in_specs=(blocks_spec, rest_spec, tokens_spec),
+        out_specs=(P(), blocks_spec, rest_spec),
+        check_vma=False,
+    )
+
+    def loss_and_grad(params, tokens):
+        blocks, rest = _split_blocks(params)
+        loss, d_blocks, d_rest = sharded(blocks, rest, tokens)
+        grads = dict(d_rest)
+        grads["blocks"] = d_blocks
+        return loss, grads
+
+    return loss_and_grad
 
 
 def pp_state_specs(config: TransformerConfig, abstract_state: Any) -> Any:
@@ -194,22 +455,36 @@ def make_pp_train_step(
     num_microbatches: int,
     state_shardings: Any,
     z_loss_coeff: float = 0.0,
+    schedule: str = "gpipe",
 ):
     """One jitted dp×pp training step with the same TrainState/metrics
-    contract as train.lm.make_train_step."""
+    contract as train.lm.make_train_step.
+
+    schedule: "gpipe" (AD through the forward pipeline; stashes all
+    M+S-1 ticks of activations) or "1f1b" (manual interleaved schedule,
+    spmd_pipeline_1f1b — activation stash bounded by 2S-1 microbatches,
+    backward recomputes stage forwards). Gradients are identical."""
     import optax
 
     from ..train.lm import TrainState
 
-    loss_fn = make_pp_loss_fn(
-        config, mesh, num_microbatches, z_loss_coeff=z_loss_coeff
-    )
+    if schedule == "1f1b":
+        loss_and_grad = make_pp_loss_and_grad_1f1b(
+            config, mesh, num_microbatches, z_loss_coeff=z_loss_coeff
+        )
+    elif schedule == "gpipe":
+        loss_fn = make_pp_loss_fn(
+            config, mesh, num_microbatches, z_loss_coeff=z_loss_coeff
+        )
+        loss_and_grad = jax.value_and_grad(loss_fn)
+    else:
+        raise ValueError(f"unknown pipeline schedule {schedule!r}")
     batch_sharding = NamedSharding(mesh, P("dp", None))
     metric_sharding = NamedSharding(mesh, P())
 
     def step_fn(state: TrainState, batch):
         tokens = batch["tokens"]
-        loss, grads = jax.value_and_grad(loss_fn)(state.params, tokens)
+        loss, grads = loss_and_grad(state.params, tokens)
         updates, new_opt = optimizer.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         new_state = TrainState(

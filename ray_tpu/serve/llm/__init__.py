@@ -2,5 +2,9 @@
 
 from .engine import EngineConfig, LLMEngine, ResponseStream  # noqa: F401
 from .paged import PagedConfig, PageAllocator  # noqa: F401
-from .paged_engine import PagedEngineConfig, PagedLLMEngine  # noqa: F401
+from .paged_engine import (  # noqa: F401
+    PagedEngineConfig,
+    PagedLLMEngine,
+    serving_shardings,
+)
 from .server import LLMServer, build_llm_app  # noqa: F401

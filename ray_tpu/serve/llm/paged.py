@@ -129,16 +129,27 @@ def _gather_ref_attention(q, k_cache, v_cache, block_tables, lengths):
     return jnp.einsum("bhk,bhkd->bhd", probs.astype(v.dtype), v)
 
 
-def paged_attention(q, k_cache, v_cache, block_tables, lengths, *, page_size: int):
+def paged_attention(q, k_cache, v_cache, block_tables, lengths, *, page_size: int,
+                    use_kernel: Optional[bool] = None):
     """Dispatch: Pallas paged kernel on TPU, gather reference elsewhere.
 
     The Mosaic lowering requires the trailing block dims be (8, 128)-
     divisible, so the kernel is only eligible for head_dim % 128 == 0 and
     page_size % 8 == 0 (e.g. Llama-class models); smaller shapes (tiny
     test configs, GPT-2's 64-dim heads) take the gather reference, which
-    XLA fuses well at those sizes anyway."""
+    XLA fuses well at those sizes anyway.
+
+    use_kernel=False forces the gather path: under a tensor-parallel mesh
+    the GSPMD partitioner cannot split a Pallas call, while the gather
+    reference partitions cleanly on the (tp-sharded) kv-head axis."""
     head_dim = q.shape[-1]
-    if jax.default_backend() == "tpu" and head_dim % 128 == 0 and page_size % 8 == 0:
+    if use_kernel is None:
+        use_kernel = (
+            jax.default_backend() == "tpu"
+            and head_dim % 128 == 0
+            and page_size % 8 == 0
+        )
+    if use_kernel:
         from jax.experimental.pallas.ops.tpu.paged_attention import (
             paged_attention as _kernel,
         )
@@ -165,6 +176,121 @@ def paged_attention(q, k_cache, v_cache, block_tables, lengths, *, page_size: in
 # --------------------------------------------------------------- model passes
 
 
+def batched_chunk_prefill_step(
+    params: Params,
+    cache: Dict[str, jax.Array],
+    page_rows: jax.Array,       # (B, maxP) block tables of the batched slots
+    chunk_page_ids: jax.Array,  # (B, chunk_pages) pages each chunk fills
+    tokens: jax.Array,          # (B, C) chunks, right-padded
+    offsets: jax.Array,         # (B,) tokens already ingested (page-aligned)
+    total_lens: jax.Array,      # (B,) offset + real tokens this chunk
+    config: TransformerConfig,
+    *,
+    page_size: int,
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Ingest one prompt chunk for up to B slots in ONE device call —
+    burst admissions prefill together instead of serializing TTFT
+    (vLLM batches prefill chunks across sequences the same way;
+    reference vllm_engine.py:254). Inactive lanes point their
+    chunk_page_ids at the scratch page (0) with total_len 0: they burn
+    lane FLOPs but write only garbage the attention masks off.
+
+    Returns the LAST real token's logits per lane (B, V) — only the
+    lanes finishing their prompt this tick sample from them.
+    """
+    c = config
+    dt = c.dtype
+    b, chunk = tokens.shape
+    chunk_pages = chunk // page_size
+    pos = offsets[:, None] + jnp.arange(chunk)[None, :]  # (B, C)
+    x = params["wte"].astype(dt)[tokens]  # (B, C, E)
+    if c.pos_emb == "learned":
+        x = x + params["wpe"].astype(dt)[jnp.clip(pos, 0, c.max_seq - 1)]
+        rope_tables = None
+    else:
+        rope_tables = rope_frequencies(c.head_dim, c.max_seq, c.rope_theta)
+    flat_ids = chunk_page_ids.reshape(-1)  # (B*cp,) — scratch dups are fine
+
+    def block_fn(x, scanned):
+        lp, k_cache, v_cache = scanned
+        h = _norm(x, lp["ln1_scale"], lp.get("ln1_bias"), c.norm)
+        q = jnp.einsum("bse,ehd->bhsd", h, lp["wq"].astype(dt))
+        k = jnp.einsum("bse,ehd->bhsd", h, lp["wk"].astype(dt))
+        v = jnp.einsum("bse,ehd->bhsd", h, lp["wv"].astype(dt))
+        if c.use_bias:
+            q = q + lp["bq"].astype(dt)[None, :, None, :]
+            k = k + lp["bk"].astype(dt)[None, :, None, :]
+            v = v + lp["bv"].astype(dt)[None, :, None, :]
+        if rope_tables is not None:
+            cos, sin = rope_tables
+            q = apply_rope(q, cos, sin, pos)
+            k = apply_rope(k, cos, sin, pos)
+        # whole-page scatter for every lane at once: (Hkv, B*cp, ps, D)
+        kp = (
+            k.transpose(1, 0, 2, 3)
+            .reshape(k.shape[1], b * chunk_pages, page_size, k.shape[-1])
+            .astype(c.dtype)
+        )
+        vp = (
+            v.transpose(1, 0, 2, 3)
+            .reshape(v.shape[1], b * chunk_pages, page_size, v.shape[-1])
+            .astype(c.dtype)
+        )
+        k_cache = k_cache.at[:, flat_ids].set(kp)
+        v_cache = v_cache.at[:, flat_ids].set(vp)
+        # per-lane gathered attention over each slot's own pages
+        keys = jnp.swapaxes(k_cache[:, page_rows], 0, 1)  # (B, Hkv, maxP, ps, D)
+        vals = jnp.swapaxes(v_cache[:, page_rows], 0, 1)
+        keys = keys.reshape(b, keys.shape[1], -1, keys.shape[-1])
+        vals = vals.reshape(b, vals.shape[1], -1, vals.shape[-1])
+        hq, hkv = q.shape[1], keys.shape[1]
+        if hq != hkv:
+            keys = jnp.repeat(keys, hq // hkv, axis=1)
+            vals = jnp.repeat(vals, hq // hkv, axis=1)
+        logits = jnp.einsum(
+            "bhqd,bhkd->bhqk", q, keys, preferred_element_type=jnp.float32
+        ) / math.sqrt(q.shape[-1])
+        key_pos = jnp.arange(keys.shape[2])
+        causal = key_pos[None, None, :] <= pos[:, :, None]       # (B, C, S)
+        valid = key_pos[None, None, :] < total_lens[:, None, None]
+        logits = jnp.where((causal & valid)[:, None], logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1)
+        attn = jnp.einsum("bhqk,bhkd->bhqd", probs.astype(vals.dtype), vals)
+        out = jnp.einsum("bhsd,hde->bse", attn.astype(dt), lp["wo"].astype(dt))
+        if c.use_bias:
+            out = out + lp["bo"].astype(dt)
+        x = x + out
+        h = _norm(x, lp["ln2_scale"], lp.get("ln2_bias"), c.norm)
+        up = jnp.einsum("bse,ef->bsf", h, lp["w_up"].astype(dt))
+        if c.use_bias:
+            up = up + lp["b_up"].astype(dt)
+        if c.act == "swiglu":
+            from ...ops import swiglu
+
+            act = swiglu(jnp.einsum("bse,ef->bsf", h, lp["w_gate"].astype(dt)), up)
+        else:
+            from ...ops import gelu
+
+            act = gelu(up)
+        down = jnp.einsum("bsf,fe->bse", act, lp["w_down"].astype(dt))
+        if c.use_bias:
+            down = down + lp["b_down"].astype(dt)
+        return x + down, (k_cache, v_cache)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        block_fn, x, (params["blocks"], cache["k"], cache["v"])
+    )
+    x = _norm(x, params["lnf_scale"], params.get("lnf_bias"), c.norm)
+    head = params.get("lm_head")
+    if head is None:
+        head = params["wte"].T
+    # vocab projection ONLY for each lane's last real token (B, E) @ (E, V)
+    last = jnp.clip(total_lens - offsets - 1, 0, chunk - 1)
+    x_last = x[jnp.arange(b), last]  # (B, E)
+    logits = jnp.einsum("be,ev->bv", x_last, head.astype(dt))
+    return logits, {"k": new_k, "v": new_v}
+
+
 def paged_decode_step(
     params: Params,
     cache: Dict[str, jax.Array],
@@ -174,6 +300,7 @@ def paged_decode_step(
     config: TransformerConfig,
     *,
     page_size: int,
+    use_kernel: Optional[bool] = None,
 ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """One continuous-batching decode step over the paged cache."""
     c = config
@@ -212,7 +339,7 @@ def paged_decode_step(
         v_cache = v_cache.at[:, page_ids, rows].set(newv)
         attn = paged_attention(
             q[:, :, 0, :], k_cache, v_cache, block_tables, lengths,
-            page_size=page_size,
+            page_size=page_size, use_kernel=use_kernel,
         )[:, :, None, :]
         out = jnp.einsum("bhsd,hde->bse", attn.astype(dt), lp["wo"].astype(dt))
         if c.use_bias:

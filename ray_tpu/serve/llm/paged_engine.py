@@ -46,7 +46,7 @@ from .engine import ResponseStream, _Request, _fail_all_requests, _reject_if_dea
 from .paged import (
     PagedConfig,
     PageAllocator,
-    chunk_prefill_step,
+    batched_chunk_prefill_step,
     init_paged_cache,
     paged_decode_step,
 )
@@ -58,7 +58,132 @@ class PagedEngineConfig:
     eos_id: int = -1
     decode_block_steps: int = 16  # K: fused decode+sample steps per dispatch
     max_inflight_blocks: int = 8  # device blocks outstanding before gating
+    # Compile every prefill bucket + both decode variants at construction
+    # (vLLM pre-captures its batch-size graphs the same way). Off by
+    # default: tests build many engines; serving/bench wants it on so the
+    # first burst never pays a 20-40s XLA compile mid-request.
+    precompile: bool = False
     paged: PagedConfig = dataclasses.field(default_factory=PagedConfig)
+
+
+# ------------------------------------------------------- jittable components
+# Module-level builders so the TP AOT test can lower the exact programs the
+# engine runs (at Llama-3-8B shapes) without instantiating an engine.
+
+
+def _sample_plain(logits, key, temps):
+    """temperature-only / greedy sampling — the common fast path."""
+    greedy = jnp.argmax(logits, axis=-1)
+    scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+    sampled = jax.random.categorical(key, scaled, axis=-1)
+    return jnp.where(temps <= 0.0, greedy, sampled).astype(jnp.int32)
+
+
+def _sample_filtered(logits, key, temps, top_ks, top_ps):
+    """Per-lane temperature + top-k + top-p (nucleus) sampling —
+    vLLM SamplingParams parity. POSITIONAL filtering over one
+    argsort: exactly top_k tokens survive even under logit ties,
+    and the nucleus keep-mask scatters back through the sort
+    order (disabled lanes use k=V / p=1.0, which keep all)."""
+    b, vocab = logits.shape
+    greedy = jnp.argmax(logits, axis=-1)
+    scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+    order = jnp.argsort(scaled, axis=-1)[:, ::-1]  # desc indices
+    desc = jnp.take_along_axis(scaled, order, axis=-1)
+    k_idx = jnp.where(top_ks > 0, top_ks, vocab)
+    positions = jnp.arange(vocab)[None, :]
+    in_topk = positions < k_idx[:, None]
+    p_desc = jax.nn.softmax(
+        jnp.where(in_topk, desc, -jnp.inf), axis=-1
+    )
+    cum = jnp.cumsum(p_desc, axis=-1)
+    # keep a token if the cumulative mass BEFORE it is < top_p
+    # (the top token always survives: cum - p == 0 there)
+    keep_sorted = in_topk & ((cum - p_desc) < top_ps[:, None])
+    keep = jnp.zeros_like(keep_sorted).at[
+        jnp.arange(b)[:, None], order
+    ].set(keep_sorted)
+    final = jnp.where(keep, scaled, -jnp.inf)
+    sampled = jax.random.categorical(key, final, axis=-1)
+    return jnp.where(temps <= 0.0, greedy, sampled).astype(jnp.int32)
+
+
+def build_decode_block(mc: TransformerConfig, page_size: int, K: int,
+                       sample_fn, use_kernel=None):
+    """K fused decode+sample steps; tokens never leave the device.
+    Output row 0 is the INPUT token vector — a freshly prefilled
+    lane's first sampled token rides along with its first block,
+    so it never needs a fetch of its own (every materialization
+    costs a full round trip on tunneled TPUs). Two variants are
+    compiled: plain (temperature only — no per-step vocab sort)
+    and filtered (top-k/top-p); the dispatcher picks per block."""
+
+    def _decode_block(params, cache, block_tables, tokens, positions,
+                      key, temps, *filters):
+        def body(carry, _):
+            cache, toks_c, pos_c, key_c = carry
+            logits, cache = paged_decode_step(
+                params, cache, block_tables, toks_c, pos_c, mc,
+                page_size=page_size, use_kernel=use_kernel,
+            )
+            key_c, sub = jax.random.split(key_c)
+            nxt = sample_fn(logits, sub, temps, *filters)
+            return (cache, nxt, pos_c + 1, key_c), nxt
+
+        (cache, final, _, _), toks = jax.lax.scan(
+            body, (cache, tokens, positions, key), None, length=K
+        )
+        toks = jnp.concatenate([tokens[None], toks], axis=0)  # (K+1, B)
+        return toks, final, cache
+
+    return _decode_block
+
+
+def build_batched_chunk_fn(mc: TransformerConfig, page_size: int):
+    def _batched_chunk(params, cache, page_rows, chunk_page_ids, tokens,
+                       offsets, totals):
+        return batched_chunk_prefill_step(
+            params, cache, page_rows, chunk_page_ids, tokens, offsets, totals,
+            mc, page_size=page_size,
+        )
+
+    return _batched_chunk
+
+
+def serving_shardings(model_config: TransformerConfig, mesh, rules=None):
+    """(param shardings, KV-pool sharding, replicated) for TP serving.
+
+    Reference parity: the reference serves TP via vLLM workers in a
+    placement group (/root/reference/python/ray/llm/_internal/serve/
+    deployments/llm/vllm/vllm_models.py:124 — one process per GPU,
+    NCCL all-reduce per layer). TPU inversion: ONE program over a mesh;
+    the same rule table train uses (Megatron split on heads/mlp/vocab)
+    annotates the params and the page pool shards on the kv-head axis,
+    and XLA inserts the collectives over ICI.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from ...models.transformer import logical_axes
+    from ...parallel import default_rules
+    from ...parallel.sharding import tree_specs
+
+    tp = mesh.shape.get("tp", 1)
+    if model_config.kv_heads % tp or model_config.n_heads % tp:
+        raise ValueError(
+            f"tp={tp} must divide kv_heads ({model_config.kv_heads}) and "
+            f"n_heads ({model_config.n_heads})"
+        )
+    specs = tree_specs(logical_axes(model_config), rules or default_rules())
+    param_sh = jax.tree.map(
+        lambda s: NamedSharding(mesh, s), specs,
+        is_leaf=lambda x: isinstance(x, PartitionSpec),
+    )
+    kv_spec = NamedSharding(
+        mesh, PartitionSpec(None, "tp", None, None, None)
+    )
+    cache_sh = {"k": kv_spec, "v": kv_spec}
+    replicated = NamedSharding(mesh, PartitionSpec())
+    return param_sh, cache_sh, replicated
 
 
 @dataclasses.dataclass
@@ -107,9 +232,16 @@ class PagedLLMEngine:
         model_config: TransformerConfig,
         params: Any,
         engine_config: Optional[PagedEngineConfig] = None,
+        mesh: Any = None,
     ):
+        """mesh: optional jax.sharding.Mesh with a 'tp' axis — params and
+        the KV page pool shard across it (serving_shardings) and every
+        prefill/decode program runs SPMD over the mesh. Host-side state
+        (slots, block tables, allocator) is unchanged: page tables are
+        replicated, exactly like vLLM's TP workers sharing one scheduler."""
         self.model_config = model_config
         self.params = params
+        self.mesh = mesh
         self.config = engine_config or PagedEngineConfig()
         pc = self.config.paged
         if pc.max_pages_per_slot % pc.chunk_pages:
@@ -149,78 +281,15 @@ class PagedLLMEngine:
         ps = pc.page_size
         K = self.config.decode_block_steps
 
-        def _sample_plain(logits, key, temps):
-            """temperature-only / greedy sampling — the common fast path."""
-            greedy = jnp.argmax(logits, axis=-1)
-            scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
-            sampled = jax.random.categorical(key, scaled, axis=-1)
-            return jnp.where(temps <= 0.0, greedy, sampled).astype(jnp.int32)
+        def _scatter_tokens(tokens, lane_slots, sampled):
+            """Thread freshly sampled first tokens into the engine token
+            vector: lane_slots maps each batched-prefill lane to its slot
+            index, with non-finishing lanes pointing past the end (their
+            garbage samples drop)."""
+            return tokens.at[lane_slots].set(sampled, mode="drop")
 
-        def _sample_logits(logits, key, temps, top_ks, top_ps):
-            """Per-lane temperature + top-k + top-p (nucleus) sampling —
-            vLLM SamplingParams parity. POSITIONAL filtering over one
-            argsort: exactly top_k tokens survive even under logit ties,
-            and the nucleus keep-mask scatters back through the sort
-            order (disabled lanes use k=V / p=1.0, which keep all)."""
-            b, vocab = logits.shape
-            greedy = jnp.argmax(logits, axis=-1)
-            scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
-            order = jnp.argsort(scaled, axis=-1)[:, ::-1]  # desc indices
-            desc = jnp.take_along_axis(scaled, order, axis=-1)
-            k_idx = jnp.where(top_ks > 0, top_ks, vocab)
-            positions = jnp.arange(vocab)[None, :]
-            in_topk = positions < k_idx[:, None]
-            p_desc = jax.nn.softmax(
-                jnp.where(in_topk, desc, -jnp.inf), axis=-1
-            )
-            cum = jnp.cumsum(p_desc, axis=-1)
-            # keep a token if the cumulative mass BEFORE it is < top_p
-            # (the top token always survives: cum - p == 0 there)
-            keep_sorted = in_topk & ((cum - p_desc) < top_ps[:, None])
-            keep = jnp.zeros_like(keep_sorted).at[
-                jnp.arange(b)[:, None], order
-            ].set(keep_sorted)
-            final = jnp.where(keep, scaled, -jnp.inf)
-            sampled = jax.random.categorical(key, final, axis=-1)
-            return jnp.where(temps <= 0.0, greedy, sampled).astype(jnp.int32)
-
-        def _make_decode_block(sample_fn):
-            """K fused decode+sample steps; tokens never leave the device.
-            Output row 0 is the INPUT token vector — a freshly prefilled
-            lane's first sampled token rides along with its first block,
-            so it never needs a fetch of its own (every materialization
-            costs a full round trip on tunneled TPUs). Two variants are
-            compiled: plain (temperature only — no per-step vocab sort)
-            and filtered (top-k/top-p); the dispatcher picks per block."""
-
-            def _decode_block(params, cache, block_tables, tokens, positions,
-                              key, temps, *filters):
-                def body(carry, _):
-                    cache, toks_c, pos_c, key_c = carry
-                    logits, cache = paged_decode_step(
-                        params, cache, block_tables, toks_c, pos_c, mc,
-                        page_size=ps,
-                    )
-                    key_c, sub = jax.random.split(key_c)
-                    nxt = sample_fn(logits, sub, temps, *filters)
-                    return (cache, nxt, pos_c + 1, key_c), nxt
-
-                (cache, final, _, _), toks = jax.lax.scan(
-                    body, (cache, tokens, positions, key), None, length=K
-                )
-                toks = jnp.concatenate([tokens[None], toks], axis=0)  # (K+1, B)
-                return toks, final, cache
-
-            return _decode_block
-
-        def _chunk(params, cache, page_row, chunk_page_ids, tokens, offset, total):
-            return chunk_prefill_step(
-                params, cache, page_row, chunk_page_ids, tokens, offset, total,
-                mc, page_size=ps,
-            )
-
-        def _set_token(tokens, idx, value):
-            return tokens.at[idx].set(value[0])
+        def _take(tokens, idx):
+            return tokens[idx][None]
 
         def _merge_tokens(old, new, mask):
             """Merge a decode block's final sampled tokens back into the
@@ -232,17 +301,45 @@ class PagedLLMEngine:
             they unstall."""
             return jnp.where(mask, new, old)
 
-        self._decode_block_plain = jax.jit(
-            _make_decode_block(_sample_plain), donate_argnums=(1,)
-        )
-        self._decode_block_filtered = jax.jit(
-            _make_decode_block(_sample_logits), donate_argnums=(1,)
-        )
-        self._chunk = jax.jit(_chunk, donate_argnums=(1,))
-        self._sample = jax.jit(_sample_logits)
-        self._set_token = jax.jit(_set_token, donate_argnums=(0,))
+        # Under a TP mesh the Pallas kernel cannot be partitioned; the
+        # gather reference shards cleanly on the kv-head axis. Single
+        # device keeps the kernel (auto-dispatch).
+        tp_active = mesh is not None and mesh.size > 1
+        use_kernel = False if tp_active else None
+        dec_plain = build_decode_block(mc, ps, K, _sample_plain, use_kernel)
+        dec_filtered = build_decode_block(mc, ps, K, _sample_filtered, use_kernel)
+        batched_chunk = build_batched_chunk_fn(mc, ps)
+        if mesh is not None:
+            param_sh, cache_sh, rep = serving_shardings(mc, mesh)
+            self.params = jax.device_put(params, param_sh)
+            self.cache = jax.device_put(self.cache, cache_sh)
+            common_in = (param_sh, cache_sh, rep, rep, rep, rep, rep)
+            self._decode_block_plain = jax.jit(
+                dec_plain, donate_argnums=(1,),
+                in_shardings=common_in, out_shardings=(rep, rep, cache_sh),
+            )
+            self._decode_block_filtered = jax.jit(
+                dec_filtered, donate_argnums=(1,),
+                in_shardings=common_in + (rep, rep),
+                out_shardings=(rep, rep, cache_sh),
+            )
+            self._batched_chunk = jax.jit(
+                batched_chunk, donate_argnums=(1,),
+                in_shardings=(param_sh, cache_sh, rep, rep, rep, rep, rep),
+                out_shardings=(rep, cache_sh),
+            )
+            self._tokens_dev = jax.device_put(
+                jnp.zeros((self.config.max_slots,), jnp.int32), rep
+            )
+        else:
+            self._decode_block_plain = jax.jit(dec_plain, donate_argnums=(1,))
+            self._decode_block_filtered = jax.jit(dec_filtered, donate_argnums=(1,))
+            self._batched_chunk = jax.jit(batched_chunk, donate_argnums=(1,))
+            self._tokens_dev = jnp.zeros((self.config.max_slots,), jnp.int32)
+        self._sample = jax.jit(_sample_filtered)
+        self._scatter_tokens = jax.jit(_scatter_tokens, donate_argnums=(0,))
+        self._take = jax.jit(_take)
         self._merge_tokens = jax.jit(_merge_tokens, donate_argnums=(0,))
-        self._tokens_dev = jnp.zeros((self.config.max_slots,), jnp.int32)
         self._key = jax.random.PRNGKey(0)
         self.metrics: Dict[str, float] = {
             "generated_tokens": 0.0,
@@ -253,6 +350,8 @@ class PagedLLMEngine:
             "page_stalls": 0.0,
             "pages_in_use": 0.0,
         }
+        if self.config.precompile:
+            self._precompile()
         self._drainer = threading.Thread(
             target=self._drain_worker, daemon=True, name="paged-llm-drain"
         )
@@ -261,6 +360,48 @@ class PagedLLMEngine:
             target=self._loop, daemon=True, name="paged-llm-engine"
         )
         self._thread.start()
+
+    def _precompile(self) -> None:
+        """Trigger every XLA compile the serving loop can hit — each
+        prefill bucket (1, 2, 4, ..., max_slots lanes) and both decode
+        variants — with all-inactive inputs whose writes land only in the
+        scratch page. Runs BEFORE the engine threads start, so no request
+        ever pays a compile. Donated caches rebind as in the live loop."""
+        pc = self.paged
+        ms = self.config.max_slots
+        ct, cp = pc.chunk_tokens, pc.chunk_pages
+        b = 1
+        while True:
+            logits, self.cache = self._batched_chunk(
+                self.params,
+                self.cache,
+                jnp.zeros((b, pc.max_pages_per_slot), jnp.int32),
+                jnp.zeros((b, cp), jnp.int32),     # scratch page only
+                jnp.zeros((b, ct), jnp.int32),
+                jnp.zeros((b,), jnp.int32),
+                jnp.zeros((b,), jnp.int32),        # totals 0: inactive
+            )
+            self._key, sub = jax.random.split(self._key)
+            self._sample(
+                logits, sub, jnp.zeros((b,), jnp.float32),
+                jnp.zeros((b,), jnp.int32), jnp.ones((b,), jnp.float32),
+            )
+            if b >= ms:
+                break
+            b = min(b * 2, ms)
+        zeros_bt = jnp.zeros((ms, pc.max_pages_per_slot), jnp.int32)
+        pos = jnp.zeros((ms,), jnp.int32)
+        temps = jnp.zeros((ms,), jnp.float32)
+        self._key, sub = jax.random.split(self._key)
+        _, _, self.cache = self._decode_block_plain(
+            self.params, self.cache, zeros_bt, self._tokens_dev, pos, sub, temps
+        )
+        self._key, sub = jax.random.split(self._key)
+        _, _, self.cache = self._decode_block_filtered(
+            self.params, self.cache, zeros_bt, self._tokens_dev, pos, sub,
+            temps, jnp.zeros((ms,), jnp.int32), jnp.ones((ms,), jnp.float32),
+        )
+        jax.block_until_ready(self.cache["k"])
 
     # ------------------------------------------------------------------- API
 
@@ -346,19 +487,21 @@ class PagedLLMEngine:
     # --------------------------------------------------------------- prefill
 
     def _prefill_tick(self) -> bool:
-        """Ingest ONE chunk of ONE prefilling slot per engine tick; the
-        final chunk samples the first token on device and queues its
-        emission. Returns True if a chunk ran."""
+        """Ingest one chunk for EVERY prefilling slot in one batched
+        device call (lanes padded to the next power of two; vLLM batches
+        prefill chunks across sequences the same way) — a burst of
+        admissions prefills together instead of serializing TTFT. Final
+        chunks sample their first tokens on device, batched. Returns True
+        if any chunk ran."""
+        ct = self.paged.chunk_tokens
+        cp = self.paged.chunk_pages
+        work: List[Tuple[int, int, int]] = []  # (slot_idx, offset, first_page)
         for idx, slot in enumerate(self.slots):
             if not slot.prefilling:
                 continue
-            request = slot.request
-            prompt = request.prompt
-            ct = self.paged.chunk_tokens
             offset = slot.prefill_offset
-            n_real = min(ct, len(prompt) - offset)
             first_page = offset // self.paged.page_size
-            need = first_page + self.paged.chunk_pages - len(slot.pages)
+            need = first_page + cp - len(slot.pages)
             if need > 0:
                 extra = self.allocator.alloc(need)
                 if extra is None:
@@ -368,51 +511,78 @@ class PagedLLMEngine:
                 slot.pages.extend(extra)
                 self.block_tables[idx, : len(slot.pages)] = slot.pages
             slot.stalled = False
-            chunk = np.zeros((1, ct), dtype=np.int32)
-            chunk[0, :n_real] = prompt[offset : offset + n_real]
-            chunk_page_ids = np.asarray(
-                slot.pages[first_page : first_page + self.paged.chunk_pages],
-                dtype=np.int32,
-            )
-            total = offset + n_real
-            logits, self.cache = self._chunk(
-                self.params,
-                self.cache,
-                jnp.asarray(self.block_tables[idx]),
-                jnp.asarray(chunk_page_ids),
-                jnp.asarray(chunk),
-                jnp.asarray(offset, dtype=jnp.int32),
-                jnp.asarray(total, dtype=jnp.int32),
-            )
-            slot.prefill_offset = total
-            slot.position = total
+            work.append((idx, offset, first_page))
+        if not work:
+            return False
+        # pad the lane count to a power of two: a handful of compiled
+        # programs covers every burst size without per-size recompiles
+        b = 1 << (len(work) - 1).bit_length()
+        b = min(b, self.config.max_slots)
+        tokens = np.zeros((b, ct), dtype=np.int32)
+        page_rows = np.zeros((b, self.paged.max_pages_per_slot), dtype=np.int32)
+        chunk_ids = np.zeros((b, cp), dtype=np.int32)  # inactive → scratch 0
+        offsets = np.zeros((b,), dtype=np.int32)
+        totals = np.zeros((b,), dtype=np.int32)  # 0 = inactive lane
+        for lane, (idx, offset, first_page) in enumerate(work):
+            slot = self.slots[idx]
+            prompt = slot.request.prompt
+            n_real = min(ct, len(prompt) - offset)
+            tokens[lane, :n_real] = prompt[offset : offset + n_real]
+            page_rows[lane] = self.block_tables[idx]
+            chunk_ids[lane] = slot.pages[first_page : first_page + cp]
+            offsets[lane] = offset
+            totals[lane] = offset + n_real
+        logits, self.cache = self._batched_chunk(
+            self.params,
+            self.cache,
+            jnp.asarray(page_rows),
+            jnp.asarray(chunk_ids),
+            jnp.asarray(tokens),
+            jnp.asarray(offsets),
+            jnp.asarray(totals),
+        )
+        # bookkeeping + batched first-token sampling for finishing lanes
+        lane_slots = np.full((b,), self.config.max_slots, dtype=np.int32)
+        temps = np.zeros((b,), dtype=np.float32)
+        top_ks = np.zeros((b,), dtype=np.int32)
+        top_ps = np.ones((b,), dtype=np.float32)
+        finished: List[Tuple[int, int]] = []
+        for lane, (idx, offset, first_page) in enumerate(work):
+            slot = self.slots[idx]
+            slot.prefill_offset = int(totals[lane])
+            slot.position = int(totals[lane])
             self.metrics["prefill_chunks"] += 1
             if not slot.prefilling:
-                # final chunk: sample the first generated token ON DEVICE,
-                # thread it into the decode token vector, and queue an
-                # async fetch for emission — no host read here.
-                self._key, sub = jax.random.split(self._key)
-                temps = jnp.asarray([request.temperature], dtype=jnp.float32)
-                first_dev = self._sample(
-                    logits, sub, temps,
-                    jnp.asarray([request.top_k], dtype=jnp.int32),
-                    jnp.asarray([request.top_p], dtype=jnp.float32),
-                )
-                self._tokens_dev = self._set_token(
-                    self._tokens_dev, idx, first_dev
-                )
+                request = slot.request
+                finished.append((lane, idx))
+                lane_slots[lane] = idx
+                temps[lane] = request.temperature
+                top_ks[lane] = request.top_k
+                top_ps[lane] = request.top_p
+        if finished:
+            self._key, sub = jax.random.split(self._key)
+            sampled = self._sample(
+                logits, sub, jnp.asarray(temps), jnp.asarray(top_ks),
+                jnp.asarray(top_ps),
+            )
+            self._tokens_dev = self._scatter_tokens(
+                self._tokens_dev, jnp.asarray(lane_slots), sampled
+            )
+            for lane, idx in finished:
+                slot = self.slots[idx]
+                request = slot.request
                 slot.dispatch_remaining = request.max_tokens - 1
                 if slot.dispatch_remaining <= 0:
                     # no decode block will ever carry this lane's first
                     # token: fetch it directly (rare max_tokens=1 path)
                     slot.done_dispatching = True
+                    first_dev = self._take(self._tokens_dev, idx)
                     _async_fetch(first_dev)
                     self._inflight += 1
                     self._fetchq.put(("first", (idx, request), first_dev))
                 else:
                     slot.awaiting_first = True
-            return True
-        return False
+        return True
 
     # ---------------------------------------------------------------- decode
 

@@ -33,14 +33,28 @@ class LLMServer:
         params: Any = None,
         engine_config: Optional[EngineConfig | PagedEngineConfig] = None,
         seed: int = 0,
+        tensor_parallel: int = 1,
     ):
         config = get_config(model) if isinstance(model, str) else model
         if params is None:
             params = init_params(config, jax.random.PRNGKey(seed))
         self.model_config = config
+        mesh = None
+        if tensor_parallel > 1:
+            from ...parallel import MeshSpec, build_mesh
+
+            mesh = build_mesh(
+                MeshSpec(tp=tensor_parallel),
+                devices=jax.devices()[:tensor_parallel],
+            )
         if isinstance(engine_config, PagedEngineConfig):
-            self.engine = PagedLLMEngine(config, params, engine_config)
+            self.engine = PagedLLMEngine(config, params, engine_config, mesh=mesh)
         else:
+            if mesh is not None:
+                raise ValueError(
+                    "tensor_parallel requires the paged engine "
+                    "(engine_config=PagedEngineConfig(...))"
+                )
             self.engine = LLMEngine(config, params, engine_config)
 
     def _submit(self, payload: Dict[str, Any]):
@@ -117,13 +131,18 @@ def build_llm_app(
     max_slots: int = 8,
     params: Any = None,
     paged: bool = False,
+    tensor_parallel: int = 1,
 ) -> Application:
-    """OpenAI-compatible app builder (reference build_openai_app)."""
+    """OpenAI-compatible app builder (reference build_openai_app).
+    tensor_parallel > 1 shards each replica's paged engine over a tp mesh
+    (reference: vLLM TP workers via placement groups, vllm_models.py:124)."""
     dep = deployment(
         LLMServer, name=name, num_replicas=num_replicas, max_ongoing_requests=max_slots * 2
     )
+    if tensor_parallel > 1 and not paged:
+        raise ValueError("tensor_parallel requires paged=True")
     engine_config = (
         PagedEngineConfig(max_slots=max_slots) if paged
         else EngineConfig(max_slots=max_slots)
     )
-    return dep.bind(model, params, engine_config)
+    return dep.bind(model, params, engine_config, 0, tensor_parallel)

@@ -105,6 +105,69 @@ def test_llama3_8b_state_bytes_scale_with_shards():
     assert sharded < total / 4, (sharded, total)
 
 
+def test_llama3_8b_tp_serving_lowers_sharded():
+    """VERDICT r3 #2: the paged serving engine's decode block — the exact
+    program PagedLLMEngine dispatches — must partition at Llama-3-8B
+    shapes over a tp=8 mesh: params Megatron-split, the KV page pool
+    sharded on the kv-head axis, token I/O replicated."""
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from ray_tpu.serve.llm.paged import PagedConfig, init_paged_cache
+    from ray_tpu.serve.llm.paged_engine import (
+        _sample_plain,
+        build_decode_block,
+        serving_shardings,
+    )
+
+    config = get_config("llama3-8b")
+    assert config.kv_heads == 8 and config.n_heads == 32
+    mesh = build_mesh(MeshSpec(tp=8))
+    pc = PagedConfig(page_size=64, num_pages=512, max_pages_per_slot=32,
+                     chunk_pages=4)
+    param_sh, cache_sh, rep = serving_shardings(config, mesh)
+
+    abs_params = jax.tree.map(
+        lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s),
+        jax.eval_shape(lambda k: init_params(config, k), jax.random.PRNGKey(0)),
+        param_sh,
+    )
+    abs_cache = jax.tree.map(
+        lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s),
+        jax.eval_shape(lambda: init_paged_cache(config, pc)),
+        cache_sh,
+    )
+    B, K = 8, 16
+    decode = build_decode_block(config, pc.page_size, K, _sample_plain,
+                                use_kernel=False)
+    jitted = jax.jit(
+        decode, donate_argnums=(1,),
+        in_shardings=(param_sh, cache_sh, rep, rep, rep, rep, rep),
+        out_shardings=(rep, rep, cache_sh),
+    )
+    i32 = jax.numpy.int32
+    abs_in = (
+        jax.ShapeDtypeStruct((B, pc.max_pages_per_slot), i32, sharding=rep),
+        jax.ShapeDtypeStruct((B,), i32, sharding=rep),
+        jax.ShapeDtypeStruct((B,), i32, sharding=rep),
+        jax.eval_shape(lambda: jax.random.PRNGKey(0)),
+        jax.ShapeDtypeStruct((B,), jax.numpy.float32, sharding=rep),
+    )
+    hlo = jitted.lower(abs_params, abs_cache, *abs_in).as_text()
+    assert "mhlo.num_partitions = 8" in hlo
+    assert '{"tp"}' in hlo, "nothing is tp-sharded in the serving HLO"
+    # the vLLM property that matters on HBM: per-device KV pool bytes
+    # shrink by the tp factor (pool sharded on kv heads, not replicated)
+    k_leaf = jax.eval_shape(lambda: init_paged_cache(config, pc))["k"]
+    shard_shape = cache_sh["k"].shard_shape(k_leaf.shape)
+    assert np.prod(shard_shape) * 8 == np.prod(k_leaf.shape) * 1, (
+        shard_shape, k_leaf.shape
+    )
+    # and at least one attention projection lands tp-sharded
+    flat = jax.tree.leaves(jax.tree.map(lambda s: str(s.spec), param_sh))
+    assert any("'tp'" in s for s in flat)
+
+
 def test_mixtral_8x7b_moe_lowers_expert_parallel():
     """BASELINE config 3: the REAL Mixtral 8x7B shapes (8 experts, 32
     layers, d_ff 14336) lower through the partitioner on a dp2 x ep4

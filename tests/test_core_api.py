@@ -214,3 +214,29 @@ def test_wait_returns_at_most_num_returns(runtime):
     assert len(ready) == 2
     assert len(not_ready) == 3
     assert set(ready + not_ready) == set(refs)
+
+
+def test_wait_and_get_scale_to_10k_refs(runtime):
+    """The reference envelope is 10k+ refs in flight
+    (release/benchmarks/README.md:29): wait() and list-get() over 10k
+    already-sealed refs must complete in well under a second."""
+    import time
+
+    import ray_tpu
+
+    refs = [ray_tpu.put(i) for i in range(10_000)]
+    t0 = time.perf_counter()
+    ready, rest = ray_tpu.wait(refs, num_returns=10_000, timeout=10)
+    t_wait = time.perf_counter() - t0
+    assert len(ready) == 10_000 and not rest
+    assert t_wait < 1.0, f"wait over 10k refs took {t_wait:.2f}s"
+
+    t0 = time.perf_counter()
+    values = ray_tpu.get(refs, timeout=10)
+    t_get = time.perf_counter() - t0
+    assert values[9999] == 9999
+    assert t_get < 1.0, f"get over 10k refs took {t_get:.2f}s"
+
+    # partial wait keeps the contract at scale: at most num_returns ready
+    ready, rest = ray_tpu.wait(refs, num_returns=7, timeout=10)
+    assert len(ready) == 7 and len(rest) == 9_993

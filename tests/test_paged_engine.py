@@ -203,7 +203,7 @@ def test_engine_death_fails_streams_not_hangs():
 
         engine._decode_block_plain = boom
         engine._decode_block_filtered = boom
-        engine._chunk = boom
+        engine._batched_chunk = boom
         s = engine.submit([1, 2, 3], max_tokens=4)
         with pytest.raises(RuntimeError, match="injected engine crash"):
             s.result(timeout=30)
@@ -326,3 +326,69 @@ def test_plain_decode_path_selected_for_greedy_batches():
         assert counts["filtered"] >= 1
     finally:
         engine.shutdown()
+
+
+# ------------------------------------------------------------- tensor parallel
+
+
+def _tp_mesh(n):
+    from ray_tpu.parallel import MeshSpec, build_mesh
+
+    return build_mesh(MeshSpec(tp=n), devices=jax.devices()[:n])
+
+
+def test_tp_engine_matches_single_device_greedy():
+    """The TP-sharded engine (params Megatron-split, KV pool sharded on
+    kv heads over the 8-device mesh) must emit EXACTLY the single-device
+    greedy tokens — sharding is an execution detail, not a semantics
+    change."""
+    from ray_tpu.models.transformer import TransformerConfig
+
+    config = TransformerConfig(
+        vocab_size=128, d_model=64, n_layers=2, n_heads=8, n_kv_heads=8,
+        d_ff=128, max_seq=512, pos_emb="rope", norm="rmsnorm", act="swiglu",
+        use_bias=False, dtype=jax.numpy.float32,
+    )
+    params = init_params(config, jax.random.PRNGKey(0))
+    ecfg = PagedEngineConfig(
+        max_slots=4, decode_block_steps=4,
+        paged=PagedConfig(page_size=16, num_pages=64, max_pages_per_slot=8,
+                          chunk_pages=2),
+    )
+    prompt = list(range(1, 20))
+    ref = PagedLLMEngine(config, params, ecfg)
+    try:
+        want = ref.generate(prompt, max_tokens=10, temperature=0.0)
+    finally:
+        ref.shutdown()
+
+    tp = PagedLLMEngine(config, params, ecfg, mesh=_tp_mesh(8))
+    try:
+        got = tp.generate(prompt, max_tokens=10, temperature=0.0)
+        # continuous batching still works under the mesh
+        streams = [tp.submit(list(range(2, 12)), max_tokens=6) for _ in range(6)]
+        outs = [s.result(timeout=120) for s in streams]
+    finally:
+        tp.shutdown()
+    assert got == want, (got, want)
+    assert all(len(o) == 6 for o in outs)
+    assert all(o == outs[0] for o in outs)
+
+
+def test_tp_engine_rejects_indivisible_heads():
+    from ray_tpu.models.transformer import TransformerConfig
+
+    config = TransformerConfig(
+        vocab_size=64, d_model=48, n_layers=1, n_heads=6, n_kv_heads=3,
+        d_ff=96, max_seq=128, pos_emb="rope", norm="rmsnorm", act="swiglu",
+        use_bias=False, dtype=jax.numpy.float32,
+    )
+    params = init_params(config, jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="must divide"):
+        PagedLLMEngine(
+            config, params,
+            PagedEngineConfig(max_slots=2, paged=PagedConfig(
+                page_size=8, num_pages=32, max_pages_per_slot=4, chunk_pages=2
+            )),
+            mesh=_tp_mesh(4),
+        )
