@@ -52,6 +52,7 @@ def _cmd_start(args) -> int:
         return 2
     rt = ray_tpu.init(
         num_cpus=args.num_cpus,
+        resources=json.loads(args.resources) if args.resources else None,
         detect_accelerators=not args.no_tpu,
         head=args.head,
         address=args.address,
@@ -171,6 +172,8 @@ def build_parser() -> argparse.ArgumentParser:
     st.add_argument("--port", type=int, default=0,
                     help="GCS port for --head (0 = ephemeral)")
     st.add_argument("--num-cpus", type=int, default=None)
+    st.add_argument("--resources", default=None,
+                    help='extra custom resources as JSON, e.g. \'{"GPU": 2}\'')
     st.add_argument("--token", default=None,
                     help="cluster auth token (required off-localhost)")
 

@@ -68,12 +68,17 @@ class Cluster:
         self._nodes: List[NodeHandle] = []
 
     def add_node(self, num_cpus: int = 1, env: Optional[Dict[str, str]] = None,
-                 system_config: Optional[Dict[str, Any]] = None) -> NodeHandle:
+                 system_config: Optional[Dict[str, Any]] = None,
+                 resources: Optional[Dict[str, float]] = None) -> NodeHandle:
         """Spawn a worker agent that joins this cluster."""
+        import json as _json
+
         cmd = [
             sys.executable, "-m", "ray_tpu", "--no-tpu", "start",
             "--address", self.address, "--num-cpus", str(num_cpus),
         ]
+        if resources:
+            cmd += ["--resources", _json.dumps(resources)]
         if self.token:
             cmd += ["--token", self.token]
         child_env = dict(os.environ)
@@ -138,6 +143,10 @@ class Cluster:
         handle.proc.wait()
         if handle in self._nodes:
             self._nodes.remove(handle)
+        try:
+            os.unlink(handle.log_path)
+        except OSError:
+            pass
 
     def _agent_info(self, handle: NodeHandle) -> Optional[str]:
         """Find the agent address of a spawned node via the GCS table."""
@@ -153,5 +162,9 @@ class Cluster:
         for handle in list(self._nodes):
             handle.proc.kill()
             handle.proc.wait()
+            try:
+                os.unlink(handle.log_path)
+            except OSError:
+                pass
         self._nodes.clear()
         ray_tpu.shutdown()

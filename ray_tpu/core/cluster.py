@@ -50,17 +50,183 @@ import time
 import traceback
 from typing import Any, Dict, List, Optional, Tuple
 
+from .exceptions import ActorDiedError
 from .gcs_service import GcsClient
-from .ids import NodeID, ObjectID
+from .ids import ActorID, NodeID, ObjectID
 from .object_transfer import ObjectTransferServer, fetch_object, push_object
 from .rpc import RpcClient, RpcError
-from .scheduler import RemoteNode, TaskSpec, _resolve
+from .scheduler import (
+    NodeAffinitySchedulingStrategy,
+    RemoteNode,
+    TaskSpec,
+    _resolve,
+)
 from .worker_pool import WorkerCrashedError
 
 logger = logging.getLogger(__name__)
 
-NODE_NS = "_nodes"      # GCS KV: node_id hex -> node info dict
-OBJDIR_NS = "_objdir"   # GCS KV: object id hex -> transfer address
+NODE_NS = "_nodes"       # GCS KV: node_id hex -> node info dict
+OBJDIR_NS = "_objdir"    # GCS KV: object id hex -> transfer address
+ACTOR_NS = "_cluster_actors"  # GCS KV: name -> {node_hex, actor_hex}
+
+
+class _RemoteActorCall:
+    """One in-flight method call on a remote actor."""
+
+    __slots__ = ("task_hex", "method", "args", "kwargs", "return_ids")
+
+    def __init__(self, task_hex, method, args, kwargs, return_ids):
+        self.task_hex = task_hex
+        self.method = method
+        self.args = args
+        self.kwargs = kwargs
+        self.return_ids = return_ids
+
+
+class RemoteActorProxy:
+    """Owner-side stand-in for an actor hosted by a node agent
+    (reference: an ActorHandle whose transport is the direct actor
+    submit RPC, core_worker/transport/actor_task_submitter.h). Method
+    calls enqueue here and a single sender thread ships them in
+    SUBMISSION ORDER — the agent's mailbox then serializes execution, so
+    cross-process calls keep exactly the local actor ordering contract.
+
+    Lifecycle: PENDING (creation in flight; calls buffer) → ALIVE
+    (calls stream) → DEAD (calls fail with ActorDiedError). An agent
+    death kills every proxy on that node; there is no cross-node actor
+    restart (documented cluster gap — agent-local restarts still apply
+    via max_restarts on the hosting runtime)."""
+
+    def __init__(self, ctx: "ClusterContext", actor_id: ActorID, name: str):
+        self.ctx = ctx
+        self.actor_id = actor_id
+        self.display_name = name
+        self.state = "PENDING"
+        self.death_reason = ""
+        self.node: Optional[RemoteNode] = None
+        self.resources: Dict[str, float] = {}
+        # set when the owner registered a name for this actor; cleared
+        # (and unregistered) on death so names never squat
+        self.registered_name: Optional[str] = None
+        self.registered_namespace: str = "default"
+        self._queue: "queue.Queue[Optional[_RemoteActorCall]]" = queue.Queue()
+        self._inflight: Dict[str, _RemoteActorCall] = {}
+        self._lock = threading.Lock()
+        self._created = threading.Event()
+        self._sender = threading.Thread(
+            target=self._send_loop, daemon=True,
+            name=f"ray_tpu-ractor-{actor_id.hex()[:8]}",
+        )
+        self._sender.start()
+
+    # ----------------------------------------------------------- submission
+
+    def submit(self, call: _RemoteActorCall) -> None:
+        with self._lock:
+            if self.state == "DEAD":
+                self._fail_call(call, self.death_reason)
+                return
+        self._queue.put(call)
+
+    def _send_loop(self) -> None:
+        import cloudpickle
+
+        self._created.wait()
+        while True:
+            call = self._queue.get()
+            if call is None:
+                return
+            with self._lock:
+                if self.state != "ALIVE":
+                    self._fail_call(call, self.death_reason or "actor is dead")
+                    continue
+                node = self.node
+                self._inflight[call.task_hex] = call
+            with self.ctx._lock:
+                self.ctx._actor_calls[call.task_hex] = self
+            try:
+                # args resolve HERE (owner side, in submission order) so
+                # ObjectRef arguments ship by value like task dispatch
+                args = _resolve(call.args, self.ctx.runtime.object_store)
+                kwargs = _resolve(call.kwargs, self.ctx.runtime.object_store)
+                blob = cloudpickle.dumps({
+                    "actor_hex": self.actor_id.hex(),
+                    "task_hex": call.task_hex,
+                    "method": call.method,
+                    "args": args,
+                    "kwargs": kwargs,
+                    "return_oids": [oid.hex() for oid in call.return_ids],
+                    "reply_addr": self.ctx.address,
+                })
+                reply = node.client.call("call_actor", blob)
+                if reply != "accepted":
+                    raise RpcError(f"agent rejected actor call: {reply!r}")
+            except (RpcError, OSError) as exc:
+                with self._lock:
+                    self._inflight.pop(call.task_hex, None)
+                self.die(f"actor call transport failed: {exc!r}")
+                self._fail_call(call, self.death_reason)
+            except BaseException as exc:  # serialization errors: this call only
+                with self._lock:
+                    self._inflight.pop(call.task_hex, None)
+                for oid in call.return_ids:
+                    self.ctx.runtime.object_store.seal_error(oid, exc)
+
+    def _fail_call(self, call: _RemoteActorCall, reason: str) -> None:
+        err = ActorDiedError(self.actor_id, reason or "remote actor died")
+        for oid in call.return_ids:
+            self.ctx.runtime.object_store.seal_error(oid, err)
+
+    # ------------------------------------------------------------ lifecycle
+
+    def mark_alive(self, node: RemoteNode) -> None:
+        with self._lock:
+            self.node = node
+            if self.state == "PENDING":
+                self.state = "ALIVE"
+        self._created.set()
+
+    def die(self, reason: str) -> None:
+        """Fail every queued + in-flight call and all future ones."""
+        with self._lock:
+            if self.state == "DEAD":
+                return
+            self.state = "DEAD"
+            self.death_reason = reason
+            inflight = list(self._inflight.values())
+            self._inflight.clear()
+            node, resources = self.node, self.resources
+            self.resources = {}
+        self._created.set()  # unblock the sender so it can drain/fail
+        with self.ctx._lock:
+            for call in inflight:
+                self.ctx._actor_calls.pop(call.task_hex, None)
+        for call in inflight:
+            self._fail_call(call, reason)
+        # release the owner-side resource reservation exactly once
+        if node is not None and resources:
+            node.resources.release(resources)
+        # release the name(s): a dead actor must not squat its name
+        if self.registered_name:
+            self.ctx.runtime.gcs.unregister_named_actor(
+                self.registered_name, self.registered_namespace
+            )
+            try:
+                self.ctx.gcs.kv_delete(
+                    f"{self.registered_namespace}/{self.registered_name}",
+                    namespace=ACTOR_NS,
+                )
+            except (RpcError, OSError):
+                pass
+            self.registered_name = None
+
+    def take_inflight(self, task_hex: str) -> Optional[_RemoteActorCall]:
+        with self._lock:
+            return self._inflight.pop(task_hex, None)
+
+    def stop(self) -> None:
+        self._created.set()
+        self._queue.put(None)
 
 
 class ClusterContext:
@@ -90,6 +256,11 @@ class ClusterContext:
         self.server.register("free_object", self._free_object)
         self.server.register("node_info", self._node_info)
         self.server.register("shutdown_node", self._shutdown_node)
+        self.server.register("create_actor", self._agent_create_actor)
+        self.server.register("call_actor", self._agent_call_actor)
+        self.server.register("kill_actor", self._agent_kill_actor)
+        self.server.register("actor_state", self._agent_actor_state)
+        self.server.register("actor_task_done", self._actor_task_done)
         self.address = self.server.address
 
         self.gcs = GcsClient(gcs_address, token=self.token)
@@ -99,6 +270,12 @@ class ClusterContext:
 
         # dispatch bookkeeping: task hex -> (spec, node, pool)
         self._pending: Dict[str, Tuple[TaskSpec, RemoteNode, Any]] = {}
+        # remote actors this process OWNS (proxies), and the in-flight
+        # actor calls awaiting an actor_task_done reply
+        self.remote_actors: Dict[ActorID, RemoteActorProxy] = {}
+        self._actor_calls: Dict[str, RemoteActorProxy] = {}
+        # actors THIS node hosts for remote owners: actor hex -> handle
+        self._hosted_actors: Dict[str, Any] = {}
         self._lock = threading.Lock()
         self._remote_nodes: Dict[str, RemoteNode] = {}
         self._reply_clients: Dict[str, RpcClient] = {}
@@ -228,6 +405,14 @@ class ClusterContext:
                 ),
                 system_failure=True,
             )
+        # remote actors hosted there die with it (no cross-node restart)
+        with self._lock:
+            proxies = [
+                p for p in self.remote_actors.values()
+                if p.node is not None and p.node.node_id.hex() == node_hex
+            ]
+        for proxy in proxies:
+            proxy.die(f"hosting node {node_hex[:12]} died: {reason}")
 
     def nodes(self) -> List[Dict[str, Any]]:
         """Cluster membership as recorded in the GCS node table."""
@@ -327,6 +512,299 @@ class ClusterContext:
             # kind == "pushed": the push RPC already sealed the value
         self.runtime.scheduler.finish_remote(spec, node, pool)
         return "ok"
+
+    # -------------------------------------------------------- remote actors
+
+    def can_place_actor_remotely(self, strategy, resources) -> Optional[RemoteNode]:
+        """Owner-side placement decision: explicit NodeAffinity to a live
+        remote node, or default-strategy spillover when NO local node can
+        ever satisfy the resources but a remote one can."""
+        if isinstance(strategy, NodeAffinitySchedulingStrategy):
+            with self._lock:
+                node = self._remote_nodes.get(strategy.node_id.hex())
+            return node if node is not None and node.alive else None
+        if not isinstance(strategy, str) or strategy not in ("DEFAULT", "SPREAD"):
+            return None  # placement groups stay local
+        local = [
+            n for n in self.runtime.scheduler.nodes()
+            if not n.is_remote and n.alive
+        ]
+        if any(n.resources.can_ever_fit(resources) for n in local):
+            return None
+        with self._lock:
+            remotes = [n for n in self._remote_nodes.values() if n.alive]
+        feasible = [n for n in remotes if n.resources.can_ever_fit(resources)]
+        if not feasible:
+            return None
+        return min(feasible, key=lambda n: n.utilization())
+
+    def create_remote_actor(
+        self, node: RemoteNode, cls, args, kwargs, *, resources,
+        max_restarts, max_concurrency, name, namespace, executor,
+        runtime_env,
+    ) -> Tuple[ActorID, RemoteActorProxy]:
+        """Host an actor on a node agent. Returns immediately with a
+        PENDING proxy; method calls buffer until the agent confirms
+        (reference: async actor creation through the GCS actor manager,
+        gcs_actor_manager.h:328)."""
+        actor_id = ActorID.of(self.runtime.job_id)
+        proxy = RemoteActorProxy(self, actor_id, name or getattr(cls, "__name__", "Actor"))
+        with self._lock:
+            self.remote_actors[actor_id] = proxy
+        threading.Thread(
+            target=self._create_actor_worker,
+            args=(proxy, node, cls, args, kwargs, dict(resources or {}),
+                  max_restarts, max_concurrency, name, namespace, executor,
+                  runtime_env),
+            daemon=True,
+            name=f"ray_tpu-ractor-create-{actor_id.hex()[:8]}",
+        ).start()
+        return actor_id, proxy
+
+    def _create_actor_worker(self, proxy, node, cls, args, kwargs, resources,
+                             max_restarts, max_concurrency, name, namespace,
+                             executor, runtime_env) -> None:
+        import cloudpickle
+
+        # owner-side reservation on the remote node's resource view —
+        # waits like local actor placement does (actors.py) so the view
+        # stays consistent with task dispatch
+        while not node.resources.try_acquire(resources):
+            if proxy.state == "DEAD" or not node.alive:
+                proxy.die("node lost before actor placement")
+                return
+            time.sleep(0.005)
+        with proxy._lock:
+            if proxy.state == "DEAD":
+                # killed while we were acquiring: die() saw empty
+                # resources, so WE release the acquisition
+                node.resources.release(resources)
+                return
+            proxy.resources = dict(resources)
+            proxy.node = node
+        try:
+            blob = cloudpickle.dumps({
+                "actor_hex": proxy.actor_id.hex(),
+                "cls": cls,
+                "args": args,
+                "kwargs": kwargs,
+                "resources": resources,
+                "max_restarts": max_restarts,
+                "max_concurrency": max_concurrency,
+                "executor": executor,
+                "runtime_env": runtime_env,
+                "name": name,
+            })
+            reply = node.client.call("create_actor", blob)
+            if reply != "ok":
+                raise RpcError(f"agent rejected actor creation: {reply!r}")
+        except BaseException as exc:  # noqa: BLE001 - creation failure boundary
+            proxy.die(f"remote actor creation failed: {exc!r}")
+            return
+        if proxy.state == "DEAD":
+            # killed while the creation RPC was in flight: the agent now
+            # hosts an orphan — reap it (die() already released resources)
+            try:
+                node.client.call("kill_actor", proxy.actor_id.hex())
+            except (RpcError, OSError):
+                pass
+            return
+        if name:
+            # cluster-wide named-actor directory: any driver can resolve
+            # this actor to (node, id) and build its own proxy
+            try:
+                self.gcs.kv_put(
+                    f"{namespace}/{name}",
+                    {"node_hex": node.node_id.hex(),
+                     "actor_hex": proxy.actor_id.hex()},
+                    namespace=ACTOR_NS,
+                )
+            except (RpcError, OSError):
+                pass
+        proxy.mark_alive(node)
+
+    def submit_remote_actor_call(self, proxy: RemoteActorProxy, method: str,
+                                 args, kwargs, return_ids) -> None:
+        import uuid
+
+        call = _RemoteActorCall(uuid.uuid4().hex, method, args, kwargs, return_ids)
+        proxy.submit(call)
+
+    def kill_remote_actor(self, proxy: RemoteActorProxy) -> None:
+        node, hex_ = proxy.node, proxy.actor_id.hex()
+        proxy.die("killed by owner")
+        proxy.stop()
+        if node is not None:
+            try:
+                node.client.call("kill_actor", hex_)
+            except (RpcError, OSError):
+                pass
+
+    def _actor_task_done(self, task_hex: str,
+                         statuses: Optional[List[Tuple[str, Any]]],
+                         error_blob: Optional[bytes]) -> str:
+        import pickle as _pickle
+
+        with self._lock:
+            proxy = self._actor_calls.pop(task_hex, None)
+        if proxy is None:
+            return "stale"
+        call = proxy.take_inflight(task_hex)
+        if call is None:
+            return "stale"
+        store = self.runtime.object_store
+        if error_blob is not None:
+            try:
+                error, tb = _pickle.loads(error_blob)
+            except Exception:
+                error, tb = RuntimeError("undecodable remote actor error"), ""
+            if tb and not getattr(error, "remote_traceback", None):
+                try:
+                    error.remote_traceback = tb
+                except Exception:
+                    pass
+            for oid in call.return_ids:
+                store.seal_error(oid, error)
+            return "ok"
+        for oid, (kind, addr) in zip(call.return_ids, statuses or ()):
+            if kind == "remote":
+                store.seal_remote(oid, addr)
+            # "pushed" already sealed via the transfer plane
+        return "ok"
+
+    # --------------------------------------------------- agent-side hosting
+
+    def _agent_create_actor(self, blob: bytes) -> str:
+        import cloudpickle
+
+        msg = cloudpickle.loads(blob)
+        handle = self.runtime.create_actor(
+            msg["cls"], tuple(msg["args"]), dict(msg["kwargs"]),
+            resources=msg["resources"],
+            max_restarts=msg["max_restarts"],
+            max_concurrency=msg["max_concurrency"],
+            executor=msg["executor"],
+            runtime_env=msg["runtime_env"],
+        )
+        with self._lock:
+            self._hosted_actors[msg["actor_hex"]] = handle
+        return "ok"
+
+    def _agent_call_actor(self, blob: bytes) -> str:
+        import cloudpickle
+
+        msg = cloudpickle.loads(blob)
+        with self._lock:
+            handle = self._hosted_actors.get(msg["actor_hex"])
+        if handle is None:
+            raise KeyError(f"no hosted actor {msg['actor_hex']!r}")
+        # Submit into the mailbox SYNCHRONOUSLY, on the owner's (single,
+        # ordered) RPC connection thread: two sequential calls from one
+        # owner must enqueue in arrival order — a thread per call could
+        # invert them. Only the (blocking) result await runs in a thread.
+        n = len(msg["return_oids"])
+        try:
+            refs = self.runtime.submit_actor_task(
+                handle._actor_id, msg["method"], tuple(msg["args"]),
+                dict(msg["kwargs"]), num_returns=n if n > 1 else 1,
+            )
+        except BaseException as exc:  # noqa: BLE001 - ferried to the owner
+            tb = getattr(exc, "remote_traceback", None) or traceback.format_exc()
+            threading.Thread(
+                target=self._reply_actor_error, args=(msg, exc, tb), daemon=True,
+            ).start()
+            return "accepted"
+        refs = refs if isinstance(refs, list) else [refs]
+        threading.Thread(
+            target=self._run_agent_actor_call, args=(refs, msg),
+            daemon=True,
+            name=f"ray_tpu-agent-actor-{msg['task_hex'][:6]}",
+        ).start()
+        return "accepted"
+
+    def _run_agent_actor_call(self, refs, msg: Dict[str, Any]) -> None:
+        """Await a hosted actor call's result and deliver to the owner —
+        same result plane as remote tasks."""
+        from .config import cfg
+
+        task_hex = msg["task_hex"]
+        try:
+            values = [self.runtime.get(r) for r in refs]
+        except BaseException as exc:  # noqa: BLE001 - ferried to the owner
+            tb = getattr(exc, "remote_traceback", None) or traceback.format_exc()
+            self._reply_actor_error(msg, exc, tb)
+            return
+
+        def deliver() -> None:
+            reply = self._reply_client(msg["reply_addr"])
+            statuses: List[Tuple[str, Any]] = []
+            from .object_store import _estimate_nbytes
+
+            for oid_hex, value in zip(msg["return_oids"], values):
+                if _estimate_nbytes(value) <= cfg.remote_inline_max_bytes:
+                    push_object(msg["reply_addr"], oid_hex, value, client=reply)
+                    statuses.append(("pushed", None))
+                else:
+                    oid = ObjectID(oid_hex)
+                    store = self.runtime.object_store
+                    store.create(oid)
+                    store.seal(oid, value)
+                    self.gcs.kv_put(oid_hex, self.address, namespace=OBJDIR_NS)
+                    statuses.append(("remote", self.address))
+            reply.call("actor_task_done", task_hex, statuses, None)
+
+        self._deliver_with_retry(task_hex, msg["reply_addr"], deliver)
+
+    def _reply_actor_error(self, msg: Dict[str, Any], exc: BaseException, tb: str) -> None:
+        import pickle as _pickle
+
+        try:
+            blob = _pickle.dumps((exc, tb))
+        except Exception:
+            blob = _pickle.dumps((RuntimeError(f"{type(exc).__name__}: {exc!r}"), tb))
+        self._deliver_with_retry(
+            msg["task_hex"], msg["reply_addr"],
+            lambda: self._reply_client(msg["reply_addr"]).call(
+                "actor_task_done", msg["task_hex"], None, blob
+            ),
+        )
+
+    def _agent_kill_actor(self, actor_hex: str) -> bool:
+        with self._lock:
+            handle = self._hosted_actors.pop(actor_hex, None)
+        if handle is None:
+            return False
+        self.runtime.kill_actor(handle, no_restart=True)
+        return True
+
+    def _agent_actor_state(self, actor_hex: str) -> str:
+        with self._lock:
+            handle = self._hosted_actors.get(actor_hex)
+        if handle is None:
+            return "DEAD"
+        return self.runtime.actor_runtime(handle._actor_id).state.value
+
+    def lookup_named_actor(self, name: str, namespace: str = "default"):
+        """Resolve a cluster-registered named actor to a proxy (any
+        driver, any node). Returns None when unknown."""
+        try:
+            rec = self.gcs.kv_get(f"{namespace}/{name}", namespace=ACTOR_NS)
+        except (RpcError, OSError):
+            return None
+        if not rec:
+            return None
+        with self._lock:
+            node = self._remote_nodes.get(rec["node_hex"])
+        if node is None:
+            return None
+        actor_id = ActorID(rec["actor_hex"])
+        with self._lock:
+            proxy = self.remote_actors.get(actor_id)
+            if proxy is None:
+                proxy = RemoteActorProxy(self, actor_id, name)
+                proxy.mark_alive(node)
+                self.remote_actors[actor_id] = proxy
+        return proxy
 
     # ----------------------------------------------------- agent-side execute
 
@@ -515,6 +993,11 @@ class ClusterContext:
 
     def stop(self) -> None:
         self._stop.set()
+        with self._lock:
+            proxies = list(self.remote_actors.values())
+            self.remote_actors.clear()
+        for proxy in proxies:
+            proxy.stop()
         try:
             self.gcs.kv_delete(self.node_id.hex(), namespace=NODE_NS)
         except (RpcError, OSError):

@@ -36,6 +36,11 @@ class TaskError(RayTpuError):
             f"task {function_name} failed:\n{self.remote_traceback}"
         )
 
+    def __reduce__(self):
+        # Custom __init__ args break BaseException's default pickling —
+        # these errors cross process boundaries (cluster result plane)
+        return (TaskError, (self.function_name, self.cause, self.remote_traceback))
+
 
 class ActorError(RayTpuError):
     pass
@@ -46,6 +51,9 @@ class ActorDiedError(ActorError):
         self.actor_id = actor_id
         self.reason = reason
         super().__init__(f"Actor {actor_id} is dead: {reason}")
+
+    def __reduce__(self):
+        return (ActorDiedError, (self.actor_id, self.reason))
 
 
 class ActorUnavailableError(ActorError):
@@ -59,7 +67,11 @@ class GetTimeoutError(RayTpuError, TimeoutError):
 class ObjectLostError(RayTpuError):
     def __init__(self, object_id, note: str = ""):
         self.object_id = object_id
+        self.note = note
         super().__init__(f"Object {object_id} was lost or evicted. {note}")
+
+    def __reduce__(self):
+        return (ObjectLostError, (self.object_id, self.note))
 
 
 class TaskCancelledError(RayTpuError):

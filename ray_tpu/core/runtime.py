@@ -373,6 +373,33 @@ class Runtime:
                 "actor runtime_env requires executor='process' (thread "
                 "actors share the driver's process environment)"
             )
+        # Cluster placement: NodeAffinity to a remote node, or default
+        # spillover when only a remote node can satisfy the resources —
+        # the agent hosts the actor, this process keeps a proxy handle
+        # (core/cluster.py RemoteActorProxy).
+        if self.cluster is not None:
+            res = dict(resources or {"CPU": 1.0})
+            node = self.cluster.can_place_actor_remotely(scheduling_strategy, res)
+            if node is not None:
+                actor_id, proxy = self.cluster.create_remote_actor(
+                    node, cls, args, kwargs, resources=res,
+                    max_restarts=max_restarts, max_concurrency=max_concurrency,
+                    name=name, namespace=namespace, executor=executor,
+                    runtime_env=renv,
+                )
+                handle = ActorHandle(actor_id, self)
+                if name:
+                    # reserve BEFORE creation proceeds (duplicate raises
+                    # without leaking a live remote actor); proxy.die
+                    # releases the name when the actor goes away
+                    try:
+                        self.gcs.register_named_actor(name, handle, namespace=namespace)
+                    except BaseException:
+                        self.cluster.kill_remote_actor(proxy)
+                        raise
+                    proxy.registered_name = name
+                    proxy.registered_namespace = namespace
+                return handle
         actor_id = ActorID.of(self.job_id)
         handle = ActorHandle(actor_id, self)
         # Reserve the name BEFORE spawning the actor so a duplicate name
@@ -452,6 +479,30 @@ class Runtime:
         with self._lock:
             return self._actors[actor_id]
 
+    def _remote_actor_proxy(self, actor_id: ActorID):
+        if self.cluster is None:
+            return None
+        return self.cluster.remote_actors.get(actor_id)
+
+    def actor_state(self, actor_id: ActorID) -> ActorState:
+        """State of a local actor or a cluster-hosted one (proxied over
+        RPC to the hosting agent)."""
+        with self._lock:
+            rt = self._actors.get(actor_id)
+        if rt is not None:
+            return rt.state
+        proxy = self._remote_actor_proxy(actor_id)
+        if proxy is None:
+            raise KeyError(actor_id)
+        if proxy.state == "DEAD":
+            return ActorState.DEAD
+        if proxy.state == "PENDING":
+            return ActorState.PENDING
+        try:
+            return ActorState(proxy.node.client.call("actor_state", actor_id.hex()))
+        except Exception:
+            return ActorState.DEAD
+
     def submit_actor_task(
         self,
         actor_id: ActorID,
@@ -460,6 +511,24 @@ class Runtime:
         kwargs: Dict[str, Any],
         num_returns: Union[int, str] = 1,
     ) -> Union[ObjectRef, List[ObjectRef], "ObjectRefGenerator"]:
+        proxy = self._remote_actor_proxy(actor_id)
+        if proxy is not None:
+            if num_returns == "streaming":
+                raise ValueError(
+                    'num_returns="streaming" is not supported on cluster-'
+                    "hosted actors (streams need a live in-process queue)"
+                )
+            r_task_id = TaskID.of(self.job_id)
+            return_ids = [
+                ObjectID.for_task_return(r_task_id, i) for i in range(num_returns)
+            ]
+            for oid in return_ids:
+                self.object_store.create(oid)
+            self.cluster.submit_remote_actor_call(
+                proxy, method_name, args, kwargs, return_ids
+            )
+            refs = [ObjectRef(oid, self) for oid in return_ids]
+            return refs[0] if num_returns == 1 else refs
         task_id = TaskID.of(self.job_id)
         streaming = num_returns == "streaming"
         if streaming and self.actor_runtime(actor_id).executor == "process":
@@ -499,6 +568,10 @@ class Runtime:
         }
 
     def kill_actor(self, handle: "ActorHandle", no_restart: bool = True) -> None:
+        proxy = self._remote_actor_proxy(handle._actor_id)
+        if proxy is not None:
+            self.cluster.kill_remote_actor(proxy)
+            return
         rt = self.actor_runtime(handle._actor_id)
         rt.kill(no_restart=no_restart)
         if no_restart and getattr(rt, "registered_name", None):
@@ -506,13 +579,19 @@ class Runtime:
 
     def get_actor(self, name: str, namespace: str = "default") -> "ActorHandle":
         handle = self.gcs.get_named_actor(name, namespace)
-        if handle is None:
-            raise ValueError(f"No actor named {name!r} in namespace {namespace!r}")
-        return handle
+        if handle is not None:
+            return handle
+        if self.cluster is not None:
+            # cluster-wide directory: an actor named by ANY driver on ANY
+            # node resolves to a proxy handle here
+            proxy = self.cluster.lookup_named_actor(name, namespace)
+            if proxy is not None:
+                return ActorHandle(proxy.actor_id, self)
+        raise ValueError(f"No actor named {name!r} in namespace {namespace!r}")
 
     def list_actors(self) -> List[Dict[str, Any]]:
         with self._lock:
-            return [
+            out = [
                 {
                     "actor_id": aid.hex(),
                     "name": rt.name,
@@ -521,6 +600,16 @@ class Runtime:
                 }
                 for aid, rt in self._actors.items()
             ]
+        if self.cluster is not None:
+            for aid, proxy in list(self.cluster.remote_actors.items()):
+                out.append({
+                    "actor_id": aid.hex(),
+                    "name": proxy.display_name,
+                    "state": proxy.state,
+                    "restarts": 0,
+                    "node": proxy.node.node_id.hex() if proxy.node else None,
+                })
+        return out
 
     # ------------------------------------------------------------- placement
 
@@ -612,7 +701,7 @@ class ActorHandle:
         return ActorMethod(self, "__ray_apply__")
 
     def state(self) -> ActorState:
-        return self._runtime.actor_runtime(self._actor_id).state
+        return self._runtime.actor_state(self._actor_id)
 
     def __repr__(self):
         return f"ActorHandle({self._actor_id.hex()[:12]})"

@@ -230,3 +230,122 @@ def test_rpc_auth_token_required():
         none.close()
     finally:
         server.stop()
+
+
+# ------------------------------------------------------------- remote actors
+
+
+def test_remote_actor_on_agent(cluster):
+    """An actor pinned to a remote node executes THERE, keeps state
+    across ordered method calls, and dies cleanly on kill."""
+    import os
+
+    from ray_tpu.core.actors import ActorState
+    from ray_tpu.core.exceptions import ActorDiedError
+    from ray_tpu.core.scheduler import NodeAffinitySchedulingStrategy
+
+    remote_nodes = [n for n in cluster.runtime.scheduler.nodes() if n.is_remote]
+
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self, start):
+            self.value = start
+
+        def add(self, n):
+            self.value += n
+            return self.value
+
+        def pid(self):
+            import os as _os
+
+            return _os.getpid()
+
+    target = remote_nodes[0]
+    counter = Counter.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(target.node_id)
+    ).remote(100)
+    # ordered stateful calls across the wire
+    refs = [counter.add.remote(1) for _ in range(5)]
+    assert ray_tpu.get(refs, timeout=60) == [101, 102, 103, 104, 105]
+    pid = ray_tpu.get(counter.pid.remote(), timeout=60)
+    assert pid != os.getpid()
+    info = next(
+        rec for rec in cluster.runtime.cluster.nodes()
+        if rec["node_id"] == target.node_id.hex()
+    )
+    assert info["pid"] == pid
+    assert counter.state() == ActorState.ALIVE
+
+    ray_tpu.kill(counter)
+    with pytest.raises(ActorDiedError):
+        ray_tpu.get(counter.add.remote(1), timeout=60)
+    assert counter.state() == ActorState.DEAD
+
+
+def test_remote_actor_spillover_and_named_lookup(cluster):
+    """Default placement spills to an agent when only IT has the
+    resources; the name resolves cluster-wide."""
+    victim_free = None
+
+    @ray_tpu.remote(resources={"accel": 1})
+    class Worker:
+        def where(self):
+            import os as _os
+
+            return _os.getpid()
+
+    # no local node has "accel": only the dedicated agent can host it
+    cluster.add_node(num_cpus=1, resources={"accel": 2},
+                     system_config={"node_heartbeat_s": 0.2})
+    cluster.wait_for_nodes(4)
+    w = Worker.options(name="accel-worker").remote()
+    import os
+
+    pid = ray_tpu.get(w.where.remote(), timeout=60)
+    assert pid != os.getpid()
+
+    # named lookup returns a live handle to the same actor
+    again = ray_tpu.get_actor("accel-worker")
+    assert ray_tpu.get(again.where.remote(), timeout=60) == pid
+
+
+def test_remote_actor_error_and_node_death(cluster):
+    """User exceptions cross the wire; killing the hosting agent fails
+    pending and future calls with ActorDiedError."""
+    import time as _time
+
+    from ray_tpu.core.exceptions import ActorDiedError, TaskError
+    from ray_tpu.core.scheduler import NodeAffinitySchedulingStrategy
+
+    remote_nodes = [n for n in cluster.runtime.scheduler.nodes() if n.is_remote]
+
+    @ray_tpu.remote
+    class Flaky:
+        def boom(self):
+            raise RuntimeError("actor kaboom")
+
+        def slow(self):
+            _time.sleep(5.0)
+            return "done"
+
+    target = remote_nodes[0]
+    actor = Flaky.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(target.node_id)
+    ).remote()
+    with pytest.raises((TaskError, RuntimeError), match="actor kaboom"):
+        ray_tpu.get(actor.boom.remote(), timeout=60)
+
+    pending = actor.slow.remote()
+    _time.sleep(0.5)  # let the call land on the agent
+    victim = next(
+        h for h in cluster._nodes
+        if cluster.runtime.cluster.nodes() and any(
+            rec.get("pid") == h.pid and rec["node_id"] == target.node_id.hex()
+            for rec in cluster.runtime.cluster.nodes()
+        )
+    )
+    cluster.remove_node(victim, allow_graceful=False)
+    with pytest.raises(ActorDiedError):
+        ray_tpu.get(pending, timeout=60)
+    with pytest.raises(ActorDiedError):
+        ray_tpu.get(actor.slow.remote(), timeout=60)
