@@ -29,6 +29,7 @@ def init(
     spill_dir: Optional[str] = None,
     detect_accelerators: bool = True,
     ignore_reinit_error: bool = True,
+    labels: Optional[Dict[str, str]] = None,
     head: bool = False,
     address: Optional[str] = None,
     cluster_token: Optional[str] = None,
@@ -76,6 +77,7 @@ def init(
         object_store_capacity=object_store_capacity,
         spill_dir=spill_dir,
         detect_accelerators=detect_accelerators,
+        labels=labels,
         head=head,
         address=address,
         cluster_token=cluster_token,

@@ -53,6 +53,7 @@ def _cmd_start(args) -> int:
     rt = ray_tpu.init(
         num_cpus=args.num_cpus,
         resources=json.loads(args.resources) if args.resources else None,
+        labels=json.loads(args.labels) if args.labels else None,
         detect_accelerators=not args.no_tpu,
         head=args.head,
         address=args.address,
@@ -174,6 +175,8 @@ def build_parser() -> argparse.ArgumentParser:
     st.add_argument("--num-cpus", type=int, default=None)
     st.add_argument("--resources", default=None,
                     help='extra custom resources as JSON, e.g. \'{"GPU": 2}\'')
+    st.add_argument("--labels", default=None,
+                    help='node labels as JSON, e.g. \'{"zone": "us-a"}\'')
     st.add_argument("--token", default=None,
                     help="cluster auth token (required off-localhost)")
 

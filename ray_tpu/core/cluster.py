@@ -33,10 +33,13 @@ Design, inverted for TPU:
   budget), its objects lazily flip LOST on fetch failure and lineage
   reconstruction re-executes their creating tasks.
 
-Known gaps (tracked for later rounds): actors do not place on remote
-nodes (they execute in their owner's process); streaming generators are
-local-only; cross-process borrowed references beyond the best-effort
-free_object protocol.
+Actors place remotely too: agents host actors for any driver
+(RemoteActorProxy below) with ordered method calls over RPC, a
+cluster-wide named-actor directory, and ActorDiedError on node loss.
+
+Known gaps (tracked for later rounds): streaming generators are
+local-only; no cross-node actor restart; cross-process borrowed
+references beyond the best-effort free_object protocol.
 """
 
 from __future__ import annotations
@@ -127,6 +130,22 @@ class RemoteActorProxy:
                 self._fail_call(call, self.death_reason)
                 return
         self._queue.put(call)
+        # Re-check AFTER the enqueue: die()/stop() may have raced us, in
+        # which case the sender thread could already be gone with our
+        # call still queued — drain it here so the caller never hangs.
+        with self._lock:
+            dead = self.state == "DEAD"
+        if dead:
+            self._drain_queue_failed()
+
+    def _drain_queue_failed(self) -> None:
+        while True:
+            try:
+                c = self._queue.get_nowait()
+            except queue.Empty:
+                return
+            if c is not None:
+                self._fail_call(c, self.death_reason or "actor is dead")
 
     def _send_loop(self) -> None:
         import cloudpickle
@@ -135,6 +154,8 @@ class RemoteActorProxy:
         while True:
             call = self._queue.get()
             if call is None:
+                # shutdown sentinel: fail anything enqueued behind it
+                self._drain_queue_failed()
                 return
             with self._lock:
                 if self.state != "ALIVE":
@@ -164,11 +185,15 @@ class RemoteActorProxy:
             except (RpcError, OSError) as exc:
                 with self._lock:
                     self._inflight.pop(call.task_hex, None)
+                with self.ctx._lock:
+                    self.ctx._actor_calls.pop(call.task_hex, None)
                 self.die(f"actor call transport failed: {exc!r}")
                 self._fail_call(call, self.death_reason)
             except BaseException as exc:  # serialization errors: this call only
                 with self._lock:
                     self._inflight.pop(call.task_hex, None)
+                with self.ctx._lock:
+                    self.ctx._actor_calls.pop(call.task_hex, None)
                 for oid in call.return_ids:
                     self.ctx.runtime.object_store.seal_error(oid, exc)
 
@@ -311,6 +336,7 @@ class ClusterContext:
             "node_id": self.node_id.hex(),
             "address": self.address,
             "resources": dict(self._local_node.resources.total),
+            "labels": dict(self._local_node.labels),
             "is_head": self.is_head,
             "pid": os.getpid(),
             "hostname": socket.gethostname(),
@@ -360,7 +386,7 @@ class ClusterContext:
             # a fresh client
             node = RemoteNode(
                 NodeID(node_hex), dict(info["resources"]), info["address"],
-                token=self.token,
+                token=self.token, labels=info.get("labels") or {},
             )
             with self._lock:
                 self._remote_nodes[node_hex] = node

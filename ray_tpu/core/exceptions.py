@@ -38,7 +38,9 @@ class TaskError(RayTpuError):
 
     def __reduce__(self):
         # Custom __init__ args break BaseException's default pickling —
-        # these errors cross process boundaries (cluster result plane)
+        # these errors cross process boundaries (cluster result plane).
+        # Subclasses with different ctors must override (worker_pool's
+        # WorkerCrashedError does).
         return (TaskError, (self.function_name, self.cause, self.remote_traceback))
 
 

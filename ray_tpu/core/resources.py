@@ -69,21 +69,69 @@ class ResourceSet:
                 self._available[k] = max(0.0, self._available.get(k, 0.0) - v)
 
 
-def detect_tpu_resources() -> ResourceDict:
-    """Detect TPU chips on this host via JAX, without forcing a jax import
-    at package-import time.
+def _pod_env_resources() -> Optional[ResourceDict]:
+    """TPU resources from the pod environment, trusted BEFORE JAX.
 
-    Returns e.g. {"TPU": 4.0, "TPU-v5p-8-head": 1.0} on a v5p host. Mirrors
-    the reference's TPUAcceleratorManager (accelerators/tpu.py:109) which
-    reads TPU_VISIBLE_CHIPS / GKE metadata; here JAX is the source of truth.
+    On GKE/GCE TPU VMs the runtime sets TPU_ACCELERATOR_TYPE (e.g.
+    "v4-16", "v5litepod-8"), TPU_VISIBLE_CHIPS ("0,1,2,3" — the chips
+    this container may touch), and for multi-host slices TPU_WORKER_ID /
+    TPU_WORKER_HOSTNAMES. Mirrors the reference TPUAcceleratorManager
+    (accelerators/tpu.py:109 visible-chips handling; :375 pod-type →
+    `TPU-<type>-head` synthesized ONLY on worker 0, which is what makes
+    whole-slice gang scheduling expressible as one resource demand).
+    Returns None when the environment says nothing (fall back to JAX).
     """
-    import importlib.util
+    acc_type = os.environ.get("TPU_ACCELERATOR_TYPE")
+    visible = os.environ.get("TPU_VISIBLE_CHIPS")
+    if not acc_type and not visible:
+        return None
+    if visible is not None:
+        chips = float(len([c for c in visible.split(",") if c.strip()]))
+    else:
+        # Only the type is known. The numeric suffix counts TENSORCORES
+        # for v2/v3/v4/v5p (2 per chip) but CHIPS for v5litepod/v5e/v6e —
+        # the same generation table the reference TPUAcceleratorManager
+        # keys on. Per-host chips = slice chips / worker count.
+        chips = 4.0
+        if acc_type and "-" in acc_type:
+            try:
+                gen = acc_type.split("-", 1)[0].lower()
+                total = int(acc_type.rsplit("-", 1)[1])
+                cores_per_chip = 2 if gen in ("v2", "v3", "v4", "v5p") else 1
+                slice_chips = max(1, total // cores_per_chip)
+                hostnames = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+                n_hosts = max(1, len([h for h in hostnames.split(",") if h.strip()]))
+                chips = float(max(1, slice_chips // n_hosts))
+            except ValueError:
+                pass
+    out: ResourceDict = {"TPU": chips}
+    if acc_type:
+        worker_id = int(os.environ.get("TPU_WORKER_ID", "0") or 0)
+        if worker_id == 0:
+            # one head resource per slice: a gang reserves the whole pod
+            # by demanding {"TPU-<type>-head": 1}
+            out[f"TPU-{acc_type}-head"] = 1.0
+    return out
 
-    if importlib.util.find_spec("jax") is None:  # pragma: no cover
-        return {}
+
+def detect_tpu_resources() -> ResourceDict:
+    """Detect TPU chips on this host: pod environment variables first
+    (TPU_ACCELERATOR_TYPE / TPU_VISIBLE_CHIPS / TPU_WORKER_ID — the
+    GKE/GCE contract), then JAX as the fallback source of truth, without
+    forcing a jax import at package-import time.
+
+    Returns e.g. {"TPU": 4.0, "TPU-v5p-8-head": 1.0} on a v5p host.
+    """
     from .config import cfg
 
     if cfg.force_no_tpu:
+        return {}
+    env = _pod_env_resources()
+    if env is not None:
+        return env
+    import importlib.util
+
+    if importlib.util.find_spec("jax") is None:  # pragma: no cover
         return {}
     try:
         import jax
@@ -103,6 +151,15 @@ def detect_tpu_resources() -> ResourceDict:
     }
 
 
+def detect_host_memory() -> float:
+    """Total host memory in bytes (sysconf; 8 GiB fallback) — the
+    reference sizes a node's `memory` resource from the real host too."""
+    try:
+        return float(os.sysconf("SC_PHYS_PAGES") * os.sysconf("SC_PAGE_SIZE"))
+    except (ValueError, OSError, AttributeError):  # pragma: no cover
+        return float(8 << 30)
+
+
 def default_node_resources(
     num_cpus: Optional[int] = None,
     num_tpus: Optional[int] = None,
@@ -115,8 +172,10 @@ def default_node_resources(
         out["TPU"] = float(num_tpus)
     elif detect_accelerators:
         out.update(detect_tpu_resources())
-    out["memory"] = float(8 << 30)
-    out["object_store_memory"] = float(2 << 30)
+    mem = detect_host_memory()
+    # 70% schedulable, like the reference's default memory headroom
+    out["memory"] = float(int(mem * 0.7))
+    out["object_store_memory"] = float(min(int(mem * 0.2), 8 << 30))
     if resources:
         out.update({k: float(v) for k, v in resources.items()})
     return out

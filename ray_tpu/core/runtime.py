@@ -87,6 +87,7 @@ class Runtime:
         object_store_capacity: Optional[int] = None,
         spill_dir: Optional[str] = None,
         detect_accelerators: bool = True,
+        labels: "Optional[Dict[str, str]]" = None,
         head: bool = False,
         address: Optional[str] = None,
         cluster_token: Optional[str] = None,
@@ -116,7 +117,8 @@ class Runtime:
         )
         for i in range(num_nodes):
             self.scheduler.add_node(
-                Node(NodeID.from_random(), dict(node_res), is_head=(i == 0))
+                Node(NodeID.from_random(), dict(node_res), is_head=(i == 0),
+                     labels=dict(labels or {}))
             )
         # failure detection + OOM policy + GCS durability (all flag-driven)
         from .health import HealthCheckManager, MemoryMonitor

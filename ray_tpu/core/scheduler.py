@@ -74,6 +74,27 @@ class PlacementGroupSchedulingStrategy(SchedulingStrategy):
 
 
 @dataclass
+class NodeLabelSchedulingStrategy(SchedulingStrategy):
+    """Constrain placement by node labels (reference
+    util/scheduling_strategies.py NodeLabelSchedulingStrategy +
+    raylet/scheduling/policy/node_label_scheduling_policy.h).
+
+    hard: every {key: [allowed values]} must match for a node to be
+    eligible (a missing key never matches). soft: among eligible nodes,
+    prefer those matching these too; fall back to any eligible node."""
+
+    hard: Optional[Dict[str, List[str]]] = None
+    soft: Optional[Dict[str, List[str]]] = None
+
+    @staticmethod
+    def _matches(labels: Dict[str, str], wants: Optional[Dict[str, List[str]]]) -> bool:
+        for key, allowed in (wants or {}).items():
+            if labels.get(key) not in allowed:
+                return False
+        return True
+
+
+@dataclass
 class TaskSpec:
     task_id: TaskID
     name: str
@@ -545,8 +566,25 @@ class ClusterScheduler:
         else:
             node = self._pick_node(spec)
             if node is None:
+                # fail fast iff the SAME eligibility _pick_node applies
+                # (alive + remotable + hard labels, soft ignored) can
+                # never satisfy the request
+                candidates = self._eligible_nodes(spec, apply_soft=False)
+                if (
+                    isinstance(strategy, NodeLabelSchedulingStrategy)
+                    and not candidates
+                    and self.nodes()
+                ):
+                    self._fail_returns(
+                        spec,
+                        OutOfResourcesError(
+                            f"Task {spec.name}: no eligible node matches hard "
+                            f"labels {strategy.hard}"
+                        ),
+                    )
+                    return True
                 feasible = any(
-                    n.resources.can_ever_fit(spec.resources) for n in self.nodes()
+                    n.resources.can_ever_fit(spec.resources) for n in candidates
                 )
                 if not feasible and self.nodes():
                     self._fail_returns(
@@ -585,22 +623,53 @@ class ClusterScheduler:
         thread.start()
         return True
 
-    def _pick_node(self, spec: TaskSpec) -> Optional[Node]:
+    # Hybrid policy randomizes among this many top candidates so a burst
+    # of drivers/submitters doesn't herd onto one node (reference
+    # hybrid_scheduling_policy.h:50 schedule_top_k_absolute/fraction).
+    HYBRID_TOP_K = 2
+
+    def _eligible_nodes(self, spec: TaskSpec, *, apply_soft: bool = True) -> List[Node]:
+        """Every placement filter EXCEPT current availability: alive,
+        remotable (streaming/actor tasks stay local), hard label match —
+        the one definition both _pick_node and the fail-fast
+        infeasibility check must agree on."""
         remotable = self._remotable(spec)
         nodes = [n for n in self.nodes() if n.alive and (remotable or not n.is_remote)]
+        strategy = spec.scheduling_strategy
+        if isinstance(strategy, NodeLabelSchedulingStrategy):
+            nodes = [
+                n for n in nodes
+                if NodeLabelSchedulingStrategy._matches(n.labels, strategy.hard)
+            ]
+            if apply_soft:
+                preferred = [
+                    n for n in nodes
+                    if NodeLabelSchedulingStrategy._matches(n.labels, strategy.soft)
+                ]
+                nodes = preferred or nodes
+        return nodes
+
+    def _pick_node(self, spec: TaskSpec) -> Optional[Node]:
+        import random
+
+        nodes = self._eligible_nodes(spec)
+        strategy = spec.scheduling_strategy
         feasible = [
             n for n in nodes
             if all(n.resources.available().get(k, 0.0) >= v - 1e-9 for k, v in spec.resources.items())
         ]
         if not feasible:
             return None
-        if spec.scheduling_strategy == "SPREAD":
+        if strategy == "SPREAD":
             return min(feasible, key=lambda n: n.utilization())
-        # Hybrid: pack onto the busiest node below threshold, else spread.
+        # Hybrid: pack onto busy-but-below-threshold nodes first, else
+        # spread to the emptiest — randomized among the top-k candidates.
         below = [n for n in feasible if n.utilization() < self.HYBRID_THRESHOLD]
         if below:
-            return max(below, key=lambda n: n.utilization())
-        return min(feasible, key=lambda n: n.utilization())
+            ranked = sorted(below, key=lambda n: -n.utilization())
+        else:
+            ranked = sorted(feasible, key=lambda n: n.utilization())
+        return random.choice(ranked[: self.HYBRID_TOP_K])
 
     # ------------------------------------------------------------- task runner
 

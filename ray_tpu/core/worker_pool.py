@@ -46,6 +46,12 @@ class WorkerCrashedError(TaskError):
         self.task_name = "<process-worker>"
         self.cause = None
 
+    def __reduce__(self):
+        # TaskError.__reduce__ reads attributes this subclass never sets;
+        # crossing the cluster result plane needs an honest round trip so
+        # owner-side isinstance(TaskError) fault handling still fires
+        return (WorkerCrashedError, (self.args[0] if self.args else "",))
+
 
 def _worker_main(conn, env_vars: Dict[str, str]) -> None:
     """Child process loop: recv request frames, execute, reply.

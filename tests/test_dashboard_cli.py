@@ -150,3 +150,64 @@ def test_rest_job_submission():
         raise AssertionError("expected HTTP 415")
     except urllib.error.HTTPError as e:
         assert e.code == 415
+
+
+def test_rest_failure_paths():
+    """Malformed bodies, wrong content types, unknown endpoints, and
+    dead-job lookups all answer with errors instead of crashing the
+    server or fabricating state."""
+    from ray_tpu.jobs import default_job_manager
+
+    url = start_dashboard(port=0)
+
+    def post(data: bytes, ctype="application/json"):
+        req = urllib.request.Request(
+            url + "/api/jobs", data=data, headers={"Content-Type": ctype}
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=10) as r:
+                return r.status
+        except urllib.error.HTTPError as e:
+            return e.code
+
+    assert post(b"this is not json") == 400
+    assert post(json.dumps({"no_entrypoint": True}).encode()) == 400
+    assert post(json.dumps({"entrypoint": "echo hi"}).encode(),
+                ctype="text/plain") == 415  # CSRF guard
+    assert post(json.dumps({"entrypoint": ""}).encode()) == 400
+    # none of the rejects registered a job
+    assert all(
+        j.job_id != "phantom" for j in default_job_manager().list()
+    )
+
+    def get_code(path):
+        try:
+            with urllib.request.urlopen(url + path, timeout=10) as r:
+                return r.status, r.read().decode()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read().decode()
+
+    # unknown API endpoint answers 500 with a JSON error, not a hang
+    status, body = get_code("/api/nonsense")
+    assert status == 500
+    assert "unknown endpoint" in body
+
+    # plain 404 for non-API paths
+    status, _ = get_code("/definitely/not/here")
+    assert status == 404
+
+
+def test_dead_job_lookups():
+    """status/logs/wait of a job id that never existed raise KeyError
+    (CLI surfaces them; the REST read API simply omits the job)."""
+    import pytest as _pytest
+
+    from ray_tpu.jobs import default_job_manager
+
+    mgr = default_job_manager()
+    with _pytest.raises(KeyError):
+        mgr.status("never-existed")
+    with _pytest.raises(KeyError):
+        mgr.logs("never-existed")
+    with _pytest.raises(KeyError):
+        mgr.wait("never-existed", timeout=1)

@@ -132,3 +132,137 @@ def test_large_numpy_roundtrip(runtime):
         return float(x.sum())
 
     assert abs(ray_tpu.get(total.remote(ref)) - float(arr.sum())) < 1e-6
+
+
+# ------------------------------------------------- label + top-k policies
+
+
+def test_node_label_hard_constraint():
+    """NodeLabelSchedulingStrategy(hard=...) pins to matching nodes;
+    nothing matching -> OutOfResourcesError (reference
+    node_label_scheduling_policy.h)."""
+    import ray_tpu
+    from ray_tpu.core.exceptions import OutOfResourcesError
+    from ray_tpu.core.ids import NodeID
+    from ray_tpu.core.scheduler import Node, NodeLabelSchedulingStrategy
+
+    rt = ray_tpu.init(num_cpus=2, detect_accelerators=False)
+    try:
+        labeled = Node(
+            NodeID.from_random(), {"CPU": 2.0}, labels={"zone": "us-a"}
+        )
+        rt.scheduler.add_node(labeled)
+
+        @ray_tpu.remote
+        def whereami():
+            return "ran"
+
+        ref = whereami.options(
+            scheduling_strategy=NodeLabelSchedulingStrategy(
+                hard={"zone": ["us-a", "us-b"]}
+            )
+        ).remote()
+        assert ray_tpu.get(ref, timeout=30) == "ran"
+        # it MUST have run on the labeled node
+        events = [e for e in rt.task_events() if e["name"] == "whereami"]
+        assert events and events[-1]["node"] == labeled.node_id.hex()
+
+        bad = whereami.options(
+            scheduling_strategy=NodeLabelSchedulingStrategy(
+                hard={"zone": ["eu-west"]}
+            )
+        ).remote()
+        with pytest.raises(OutOfResourcesError):
+            ray_tpu.get(bad, timeout=30)
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_node_label_soft_preference():
+    import ray_tpu
+    from ray_tpu.core.ids import NodeID
+    from ray_tpu.core.scheduler import Node, NodeLabelSchedulingStrategy
+
+    rt = ray_tpu.init(num_cpus=2, detect_accelerators=False)
+    try:
+        fast = Node(NodeID.from_random(), {"CPU": 2.0}, labels={"disk": "ssd"})
+        rt.scheduler.add_node(fast)
+
+        @ray_tpu.remote
+        def f():
+            return 1
+
+        ref = f.options(
+            scheduling_strategy=NodeLabelSchedulingStrategy(
+                soft={"disk": ["ssd"]}
+            )
+        ).remote()
+        assert ray_tpu.get(ref, timeout=30) == 1
+        events = [e for e in rt.task_events() if e["name"] == "f"]
+        assert events[-1]["node"] == fast.node_id.hex()
+
+        # soft miss still schedules (falls back to any node)
+        ref2 = f.options(
+            scheduling_strategy=NodeLabelSchedulingStrategy(
+                soft={"disk": ["nvme"]}
+            )
+        ).remote()
+        assert ray_tpu.get(ref2, timeout=30) == 1
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_hybrid_top_k_randomizes_over_idle_nodes():
+    """The hybrid policy picks among the top-k candidates, not always
+    the same node (reference hybrid_scheduling_policy.h top-k)."""
+    import ray_tpu
+    from ray_tpu.core.ids import TaskID
+    from ray_tpu.core.scheduler import TaskSpec
+
+    rt = ray_tpu.init(num_cpus=2, num_nodes=4, detect_accelerators=False)
+    try:
+        spec = TaskSpec(
+            task_id=TaskID.of(rt.job_id), name="probe", func=lambda: None,
+            args=(), kwargs={}, resources={"CPU": 1.0},
+        )
+        chosen = {
+            rt.scheduler._pick_node(spec).node_id.hex() for _ in range(40)
+        }
+        assert len(chosen) >= 2, "top-k hybrid never varied its pick"
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_tpu_pod_env_resources(monkeypatch):
+    """TPU pod env vars drive resource synthesis: visible chips count,
+    and the slice head resource appears only on worker 0 (reference
+    accelerators/tpu.py:109, :375)."""
+    from ray_tpu.core.resources import detect_tpu_resources
+
+    monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v4-16")
+    monkeypatch.setenv("TPU_VISIBLE_CHIPS", "0,1,2,3")
+    monkeypatch.setenv("TPU_WORKER_ID", "0")
+    res = detect_tpu_resources()
+    assert res["TPU"] == 4.0
+    assert res["TPU-v4-16-head"] == 1.0
+
+    # worker 1 of the same slice: chips, but NO head resource
+    monkeypatch.setenv("TPU_WORKER_ID", "1")
+    res = detect_tpu_resources()
+    assert res["TPU"] == 4.0
+    assert "TPU-v4-16-head" not in res
+
+    # type-only (no visible chips): v4-16 = 16 TensorCores = 8 chips,
+    # split over 2 hosts -> 4 chips each
+    monkeypatch.delenv("TPU_VISIBLE_CHIPS")
+    monkeypatch.setenv("TPU_WORKER_ID", "0")
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "h0,h1")
+    res = detect_tpu_resources()
+    assert res["TPU"] == 4.0
+    assert res["TPU-v4-16-head"] == 1.0
+
+    # chip-counting generation: v5litepod-8 = 8 chips over 2 hosts
+    monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v5litepod-8")
+    res = detect_tpu_resources()
+    assert res["TPU"] == 4.0
+    assert res["TPU-v5litepod-8-head"] == 1.0
