@@ -139,13 +139,19 @@ class RemoteActorProxy:
             self._drain_queue_failed()
 
     def _drain_queue_failed(self) -> None:
+        saw_sentinel = False
         while True:
             try:
                 c = self._queue.get_nowait()
             except queue.Empty:
-                return
-            if c is not None:
+                break
+            if c is None:
+                saw_sentinel = True  # stop()'s shutdown marker: not ours
+            else:
                 self._fail_call(c, self.death_reason or "actor is dead")
+        if saw_sentinel:
+            # re-post so the sender thread still sees it and exits
+            self._queue.put(None)
 
     def _send_loop(self) -> None:
         import cloudpickle

@@ -106,7 +106,10 @@ def _pod_env_resources() -> Optional[ResourceDict]:
                 pass
     out: ResourceDict = {"TPU": chips}
     if acc_type:
-        worker_id = int(os.environ.get("TPU_WORKER_ID", "0") or 0)
+        try:
+            worker_id = int(os.environ.get("TPU_WORKER_ID", "0") or 0)
+        except ValueError:
+            worker_id = 0  # malformed env must not brick node startup
         if worker_id == 0:
             # one head resource per slice: a gang reserves the whole pod
             # by demanding {"TPU-<type>-head": 1}

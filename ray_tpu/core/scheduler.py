@@ -567,9 +567,8 @@ class ClusterScheduler:
             node = self._pick_node(spec)
             if node is None:
                 # fail fast iff the SAME eligibility _pick_node applies
-                # (alive + remotable + hard labels, soft ignored) can
-                # never satisfy the request
-                candidates = self._eligible_nodes(spec, apply_soft=False)
+                # (alive + remotable + hard labels) can never satisfy
+                candidates = self._eligible_nodes(spec)
                 if (
                     isinstance(strategy, NodeLabelSchedulingStrategy)
                     and not candidates
@@ -628,11 +627,14 @@ class ClusterScheduler:
     # hybrid_scheduling_policy.h:50 schedule_top_k_absolute/fraction).
     HYBRID_TOP_K = 2
 
-    def _eligible_nodes(self, spec: TaskSpec, *, apply_soft: bool = True) -> List[Node]:
+    def _eligible_nodes(self, spec: TaskSpec) -> List[Node]:
         """Every placement filter EXCEPT current availability: alive,
         remotable (streaming/actor tasks stay local), hard label match —
         the one definition both _pick_node and the fail-fast
-        infeasibility check must agree on."""
+        infeasibility check must agree on. Soft labels are a PREFERENCE
+        applied over currently-feasible nodes in _pick_node, never a
+        filter here (a busy preferred node must not starve the task
+        while an unlabeled node sits idle)."""
         remotable = self._remotable(spec)
         nodes = [n for n in self.nodes() if n.alive and (remotable or not n.is_remote)]
         strategy = spec.scheduling_strategy
@@ -641,12 +643,6 @@ class ClusterScheduler:
                 n for n in nodes
                 if NodeLabelSchedulingStrategy._matches(n.labels, strategy.hard)
             ]
-            if apply_soft:
-                preferred = [
-                    n for n in nodes
-                    if NodeLabelSchedulingStrategy._matches(n.labels, strategy.soft)
-                ]
-                nodes = preferred or nodes
         return nodes
 
     def _pick_node(self, spec: TaskSpec) -> Optional[Node]:
@@ -660,6 +656,12 @@ class ClusterScheduler:
         ]
         if not feasible:
             return None
+        if isinstance(strategy, NodeLabelSchedulingStrategy) and strategy.soft:
+            preferred = [
+                n for n in feasible
+                if NodeLabelSchedulingStrategy._matches(n.labels, strategy.soft)
+            ]
+            feasible = preferred or feasible
         if strategy == "SPREAD":
             return min(feasible, key=lambda n: n.utilization())
         # Hybrid: pack onto busy-but-below-threshold nodes first, else

@@ -96,6 +96,12 @@ def _worker_main(conn, env_vars: Dict[str, str]) -> None:
                     result = True
                 elif method_name == "__ray_pid__":
                     result = os.getpid()
+                elif method_name == "__ray_apply__":
+                    # fn(instance, *args) — the compiled-DAG loop entry
+                    # (experimental/dag.py) running INSIDE the worker, so
+                    # process actors can host DAG stages over shm channels
+                    fn = args[0]
+                    result = fn(actor, *args[1:], **kwargs)
                 else:
                     result = getattr(actor, method_name)(*args, **kwargs)
             else:

@@ -22,8 +22,10 @@ Usage (same shape as the reference):
 
 Each actor in the DAG dedicates its execution thread to the compiled
 loop until teardown() (the reference likewise takes actors exclusive).
-Thread-executor actors only: process actors would need a cross-process
-channel, which the shared-memory arena does not expose yet.
+Works across executors: when any bound actor is process-executor, every
+edge switches to the shared-memory channel (shm_channel.ShmChannel —
+mmap'd version-stamped buffers, the analogue of the reference's mutable
+plasma channels); all-thread DAGs keep the zero-copy in-process Channel.
 """
 
 from __future__ import annotations
@@ -33,6 +35,10 @@ from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
 from .channel import Channel, ChannelClosedError, ChannelReader
+from .shm_channel import ShmChannel
+
+# payload bound per shm edge (pickled); in-process edges are unbounded
+SHM_CHANNEL_CAPACITY = 4 << 20
 
 
 class _DagError:
@@ -155,9 +161,10 @@ def _dag_actor_loop(instance, method_name, arg_spec, readers, writer):
 class CompiledDAG:
     def __init__(self, outputs: List[DAGNode]):
         self._outputs = outputs
-        self._input_channel: Optional[Channel] = None
-        self._node_channels: Dict[int, Channel] = {}
-        self._output_readers: List[ChannelReader] = []
+        self._use_shm = False
+        self._input_channel: Optional[Any] = None
+        self._node_channels: Dict[int, Any] = {}
+        self._output_readers: List[Any] = []
         self._loop_refs: List[Any] = []
         self._pending: "deque[_DAGFuture]" = deque()
         self._lock = threading.Lock()
@@ -194,11 +201,7 @@ class CompiledDAG:
                 raise TypeError(f"cannot compile node of type {type(node).__name__}")
             runtime = node.handle._runtime
             if runtime.actor_runtime(node.handle._actor_id).executor != "thread":
-                raise ValueError(
-                    f"cannot compile {node.method_name!r}: compiled DAGs "
-                    "require thread-executor actors (process actors would "
-                    "need a cross-process channel)"
-                )
+                self._use_shm = True  # cross-process edges: shm channels
             upstream = [a for a in node.args if isinstance(a, DAGNode)]
             if not upstream:
                 raise ValueError(
@@ -227,17 +230,36 @@ class CompiledDAG:
                 "(or one method that does both steps)"
             )
 
-        # one channel per producer, sized by its consumer count
-        self._input_channel = Channel(num_readers=consumers.get(id(input_node), 0))
-        for node in nodes:
-            self._node_channels[id(node)] = Channel(
-                num_readers=consumers.get(id(node), 0)
-            )
+        # one channel per producer, sized by its consumer count; mixed
+        # thread/process DAGs use shm channels on EVERY edge (uniformity
+        # beats per-edge type dispatch, and in-process reads of an shm
+        # channel are still just mmap reads)
+        def make_channel(n_readers: int):
+            if self._use_shm:
+                return ShmChannel(
+                    capacity=SHM_CHANNEL_CAPACITY, num_readers=max(1, n_readers)
+                )
+            return Channel(num_readers=max(1, n_readers))
 
-        def channel_for(node: DAGNode) -> Channel:
+        self._input_channel = make_channel(consumers.get(id(input_node), 0))
+        for node in nodes:
+            self._node_channels[id(node)] = make_channel(
+                consumers.get(id(node), 0)
+            )
+        next_reader: Dict[int, int] = {}
+
+        def channel_for(node: DAGNode):
             if isinstance(node, InputNode):
                 return self._input_channel
             return self._node_channels[id(node)]
+
+        def reader_for(node: DAGNode):
+            chan = channel_for(node)
+            if self._use_shm:
+                rid = next_reader.get(id(chan), 0)
+                next_reader[id(chan)] = rid + 1
+                return chan.reader(rid)
+            return ChannelReader(chan)
 
         # launch the per-actor loops (downstream-first so readers attach
         # before any write can land)
@@ -247,7 +269,7 @@ class CompiledDAG:
             for arg in node.args:
                 if isinstance(arg, DAGNode):
                     arg_spec.append(("chan", len(readers), None))
-                    readers.append(ChannelReader(channel_for(arg)))
+                    readers.append(reader_for(arg))
                 else:
                     arg_spec.append(("const", -1, arg))
             ref = node.handle.__ray_apply__.remote(
@@ -255,9 +277,7 @@ class CompiledDAG:
                 self._node_channels[id(node)],
             )
             self._loop_refs.append(ref)
-        self._output_readers = [
-            ChannelReader(channel_for(out)) for out in self._outputs
-        ]
+        self._output_readers = [reader_for(out) for out in self._outputs]
         self._collector = threading.Thread(
             target=self._collect, daemon=True, name="compiled-dag-collector"
         )
@@ -323,6 +343,11 @@ class CompiledDAG:
                 api.get(ref, timeout=timeout)
             except Exception:
                 pass  # loop errors already surfaced via _DagError values
+        if self._use_shm:
+            self._input_channel.unlink()
+            for chan in self._node_channels.values():
+                chan.close()
+                chan.unlink()
 
     def __del__(self):
         try:

@@ -1,0 +1,210 @@
+"""Cross-process mutable-object channels over shared memory.
+
+Reference parity: the shared-memory channel substrate under Compiled
+Graphs (/root/reference/python/ray/experimental/channel/
+shared_memory_channel.py:151 and the mutable-object manager
+src/ray/core_worker/experimental_mutable_object_manager.h:44 — a
+version-stamped writable buffer with reader/writer synchronization,
+transported through plasma).
+
+TPU-host inversion: one mmap'd file per channel (under /dev/shm when
+available) laid out as
+
+    header:  magic | num_readers | closed | version | data_len | capacity
+    acks:    one u64 per reader — the last version that reader consumed
+    data:    capacity bytes (pickled payload)
+
+Synchronization is lock-free: the writer waits until every ack equals
+the current version (all readers consumed it), writes the payload, THEN
+bumps the version; each reader waits for a version above its ack, reads,
+and stores the new version into ITS OWN ack slot. Every shared word is
+an aligned 8-byte slot written by exactly one side, so plain coherent
+stores are enough — no futexes, no semaphores, and the payload bytes
+cross processes through the page cache with zero RPC round trips.
+Same-host only by construction (cross-host traffic rides the RPC/object
+planes); in-process endpoints should prefer experimental.channel.Channel
+which passes references with no serialization at all.
+
+Handles pickle as (path, layout) and re-open on the other side, so a
+channel endpoint can ride into a process-executor actor as a plain
+argument.
+"""
+
+from __future__ import annotations
+
+import mmap
+import os
+import pickle
+import struct
+import tempfile
+import time
+from typing import Any, Optional
+
+from .channel import ChannelClosedError
+
+_MAGIC = 0x52545043484E4C31  # "RTPCHNL1"
+_HDR = struct.Struct("<QQQQQQ")  # magic, num_readers, closed, version, data_len, capacity
+_ACK = struct.Struct("<Q")
+_U64 = struct.Struct("<Q")
+# Byte offsets of the individually-owned header words. The single-writer
+# discipline holds per WORD: magic/num_readers/capacity are written once
+# at create; closed is written ONLY by close(); version and data_len ONLY
+# by write(). No read-modify-write of the whole header ever happens after
+# creation, so a close racing a write can neither be erased nor regress
+# the version stamp.
+_OFF_CLOSED = 16
+_OFF_VERSION = 24
+_OFF_DATA_LEN = 32
+
+
+def _shm_dir() -> str:
+    return "/dev/shm" if os.path.isdir("/dev/shm") else tempfile.gettempdir()
+
+
+class ShmChannel:
+    """Single-slot, version-stamped, multi-reader channel across OS
+    processes on one host. Create once (create=True), hand the object to
+    readers (it pickles by path); each reader calls ``reader(i)`` for
+    its dedicated ack slot."""
+
+    def __init__(self, capacity: int = 1 << 20, num_readers: int = 1,
+                 path: Optional[str] = None, _create: bool = True):
+        if num_readers < 1:
+            raise ValueError("num_readers must be >= 1")
+        self.capacity = int(capacity)
+        self.num_readers = int(num_readers)
+        self._data_off = _HDR.size + _ACK.size * self.num_readers
+        if _create:
+            fd, self.path = tempfile.mkstemp(
+                prefix="ray_tpu_chan_", dir=_shm_dir()
+            ) if path is None else (os.open(path, os.O_CREAT | os.O_RDWR), path)
+            try:
+                os.ftruncate(fd, self._data_off + self.capacity)
+                self._mm = mmap.mmap(fd, self._data_off + self.capacity)
+            finally:
+                os.close(fd)
+            _HDR.pack_into(
+                self._mm, 0, _MAGIC, self.num_readers, 0, 0, 0, self.capacity
+            )
+        else:
+            self.path = path
+            fd = os.open(path, os.O_RDWR)
+            try:
+                self._mm = mmap.mmap(fd, self._data_off + self.capacity)
+            finally:
+                os.close(fd)
+            magic, nr, _, _, _, cap = _HDR.unpack_from(self._mm, 0)
+            if magic != _MAGIC or nr != self.num_readers or cap != self.capacity:
+                raise ValueError(f"channel file {path!r} does not match layout")
+        self._owner = _create
+
+    # ------------------------------------------------------------- plumbing
+
+    def _read_header(self):
+        return _HDR.unpack_from(self._mm, 0)
+
+    def _version(self) -> int:
+        return _U64.unpack_from(self._mm, _OFF_VERSION)[0]
+
+    def _closed(self) -> bool:
+        return bool(_U64.unpack_from(self._mm, _OFF_CLOSED)[0])
+
+    def _ack(self, i: int) -> int:
+        return _ACK.unpack_from(self._mm, _HDR.size + _ACK.size * i)[0]
+
+    def _set_ack(self, i: int, version: int) -> None:
+        _ACK.pack_into(self._mm, _HDR.size + _ACK.size * i, version)
+
+    @staticmethod
+    def _wait(predicate, timeout: Optional[float], what: str) -> None:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        pause = 20e-6
+        while not predicate():
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError(f"shm channel {what} timed out")
+            time.sleep(pause)
+            pause = min(pause * 2, 1e-3)
+
+    # ------------------------------------------------------------------ API
+
+    def write(self, value: Any, timeout: Optional[float] = None) -> None:
+        """Publish the next version; blocks until every reader consumed
+        the previous one (the reference's writer semaphore, as ack
+        comparison)."""
+        payload = pickle.dumps(value, protocol=pickle.HIGHEST_PROTOCOL)
+        if len(payload) > self.capacity:
+            raise ValueError(
+                f"payload of {len(payload)} bytes exceeds channel capacity "
+                f"{self.capacity}; construct with a larger capacity"
+            )
+        version = self._version()
+        self._wait(
+            lambda: self._closed()
+            or all(self._ack(i) >= version for i in range(self.num_readers)),
+            timeout, "write",
+        )
+        if self._closed():
+            raise ChannelClosedError("channel is closed")
+        self._mm[self._data_off : self._data_off + len(payload)] = payload
+        # data first, then length, then the version stamp — each its own
+        # 8-byte store: a reader that observes the new version is
+        # guaranteed to see the new payload, and the `closed` word (owned
+        # by close()) is never rewritten here
+        _U64.pack_into(self._mm, _OFF_DATA_LEN, len(payload))
+        _U64.pack_into(self._mm, _OFF_VERSION, version + 1)
+
+    def read(self, reader_id: int = 0, timeout: Optional[float] = None) -> Any:
+        """Consume the next version (each reader sees each version exactly
+        once). Raises ChannelClosedError once the writer closed and every
+        version was consumed."""
+        if not 0 <= reader_id < self.num_readers:
+            raise ValueError(f"reader_id {reader_id} out of range")
+        seen = self._ack(reader_id)
+        self._wait(
+            lambda: self._version() > seen or self._closed(), timeout, "read"
+        )
+        version = self._version()
+        if version <= seen:  # closed with nothing new
+            raise ChannelClosedError("channel is closed")
+        data_len = _U64.unpack_from(self._mm, _OFF_DATA_LEN)[0]
+        value = pickle.loads(self._mm[self._data_off : self._data_off + data_len])
+        self._set_ack(reader_id, version)
+        return value
+
+    def reader(self, reader_id: int) -> "ShmChannelReader":
+        return ShmChannelReader(self, reader_id)
+
+    def close(self) -> None:
+        # single 8-byte store into the word only close() owns — safe
+        # against a concurrent write() stamping version/data_len
+        _U64.pack_into(self._mm, _OFF_CLOSED, 1)
+
+    def unlink(self) -> None:
+        """Remove the backing file (creator only, after all ends closed)."""
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+    def __reduce__(self):
+        return (
+            _reopen_channel, (self.path, self.capacity, self.num_readers)
+        )
+
+
+def _reopen_channel(path: str, capacity: int, num_readers: int) -> ShmChannel:
+    return ShmChannel(
+        capacity=capacity, num_readers=num_readers, path=path, _create=False
+    )
+
+
+class ShmChannelReader:
+    """A reader endpoint bound to one ack slot; picklable like the
+    channel itself."""
+
+    def __init__(self, channel: ShmChannel, reader_id: int):
+        self.channel = channel
+        self.reader_id = reader_id
+
+    def read(self, timeout: Optional[float] = None) -> Any:
+        return self.channel.read(self.reader_id, timeout)

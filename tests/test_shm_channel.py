@@ -1,0 +1,108 @@
+"""Cross-process shared-memory channels (reference:
+python/ray/experimental/channel/shared_memory_channel.py:151)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.experimental.channel import ChannelClosedError
+from ray_tpu.experimental.shm_channel import ShmChannel
+
+
+def test_roundtrip_and_versions():
+    ch = ShmChannel(capacity=1 << 16, num_readers=1)
+    try:
+        ch.write({"a": 1})
+        assert ch.read(0) == {"a": 1}
+        ch.write([1, 2, 3])
+        assert ch.read(0) == [1, 2, 3]
+    finally:
+        ch.close()
+        ch.unlink()
+
+
+def test_backpressure_blocks_writer():
+    ch = ShmChannel(capacity=1 << 16, num_readers=1)
+    try:
+        ch.write("v1")
+        with pytest.raises(TimeoutError):
+            ch.write("v2", timeout=0.2)  # v1 unconsumed
+        assert ch.read(0) == "v1"
+        ch.write("v2", timeout=5)
+        assert ch.read(0) == "v2"
+    finally:
+        ch.close()
+        ch.unlink()
+
+
+def test_two_readers_each_see_every_version():
+    ch = ShmChannel(capacity=1 << 16, num_readers=2)
+    try:
+        ch.write("x")
+        assert ch.read(0) == "x"
+        with pytest.raises(TimeoutError):
+            ch.write("y", timeout=0.2)  # reader 1 lagging
+        assert ch.read(1) == "x"
+        ch.write("y", timeout=5)
+        assert ch.read(0) == "y" and ch.read(1) == "y"
+    finally:
+        ch.close()
+        ch.unlink()
+
+
+def test_closed_channel_raises():
+    ch = ShmChannel(capacity=1 << 12)
+    try:
+        ch.write(1)
+        assert ch.read(0) == 1
+        ch.close()
+        with pytest.raises(ChannelClosedError):
+            ch.read(0, timeout=1)
+        with pytest.raises(ChannelClosedError):
+            ch.write(2, timeout=1)
+    finally:
+        ch.unlink()
+
+
+def test_capacity_guard():
+    ch = ShmChannel(capacity=128)
+    try:
+        with pytest.raises(ValueError, match="exceeds channel capacity"):
+            ch.write(np.zeros(1024))
+    finally:
+        ch.close()
+        ch.unlink()
+
+
+def test_cross_process_actor_pipeline(runtime):
+    """The real point: a channel endpoint rides into a PROCESS actor and
+    values stream driver -> actor -> driver through shared memory, in
+    order, with backpressure."""
+
+    @ray_tpu.remote(executor="process")
+    class Stage:
+        def __init__(self, inbound, outbound):
+            self.inbound = inbound      # ShmChannelReader (unpickled in child)
+            self.outbound = outbound    # ShmChannel (writer end)
+
+        def pump(self, n):
+            for _ in range(n):
+                arr = self.inbound.read(timeout=30)
+                self.outbound.write(arr * 2)
+            return "done"
+
+    inbound = ShmChannel(capacity=1 << 20, num_readers=1)
+    outbound = ShmChannel(capacity=1 << 20, num_readers=1)
+    try:
+        stage = Stage.remote(inbound.reader(0), outbound)
+        result = stage.pump.remote(5)
+        for i in range(5):
+            inbound.write(np.full(1000, i, dtype=np.int64))
+            out = outbound.read(0, timeout=30)
+            assert out[0] == i * 2 and out.shape == (1000,)
+        assert ray_tpu.get(result, timeout=60) == "done"
+    finally:
+        inbound.close()
+        outbound.close()
+        inbound.unlink()
+        outbound.unlink()
