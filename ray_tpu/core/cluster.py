@@ -37,9 +37,16 @@ Actors place remotely too: agents host actors for any driver
 (RemoteActorProxy below) with ordered method calls over RPC, a
 cluster-wide named-actor directory, and ActorDiedError on node loss.
 
+ObjectRefs crossing process boundaries register as BORROWERS at their
+owner (the borrow/unborrow handlers below): the owner pins the value
+until every borrower's copy dies, and a borrower's get() pulls straight
+from the owner — the reference's borrowed-reference protocol
+(reference_count.h:72) without the Cython plumbing.
+
 Known gaps (tracked for later rounds): streaming generators are
-local-only; no cross-node actor restart; cross-process borrowed
-references beyond the best-effort free_object protocol.
+local-only; no cross-node actor restart; the borrow registration is
+async, so an owner that GCs within the in-flight window surfaces
+ObjectLostError at the borrower's get().
 """
 
 from __future__ import annotations
@@ -285,6 +292,8 @@ class ClusterContext:
         self.server.register("execute_task", self._execute_task)
         self.server.register("task_done", self._task_done)
         self.server.register("free_object", self._free_object)
+        self.server.register("borrow_object", self._borrow_object)
+        self.server.register("unborrow_object", self._unborrow_object)
         self.server.register("node_info", self._node_info)
         self.server.register("shutdown_node", self._shutdown_node)
         self.server.register("create_actor", self._agent_create_actor)
@@ -310,7 +319,8 @@ class ClusterContext:
         self._lock = threading.Lock()
         self._remote_nodes: Dict[str, RemoteNode] = {}
         self._reply_clients: Dict[str, RpcClient] = {}
-        self._free_queue: "queue.Queue[Tuple[str, str]]" = queue.Queue()
+        self._free_queue: "queue.Queue[Tuple[str, str, str]]" = queue.Queue()
+        self._borrow_queue: "queue.Queue[Tuple[str, str, str]]" = queue.Queue()
         self._stop = threading.Event()
         self.shutdown_requested = threading.Event()
 
@@ -318,6 +328,7 @@ class ClusterContext:
             fetch_remote=self._fetch_remote,
             locate=self._locate,
             free_remote=self._enqueue_free,
+            unborrow=self._enqueue_unborrow,
         )
         runtime.scheduler.remote_dispatcher = self._dispatch
 
@@ -330,6 +341,10 @@ class ClusterContext:
             target=self._free_loop, daemon=True, name="ray_tpu-cluster-free"
         )
         self._free_thread.start()
+        self._borrow_thread = threading.Thread(
+            target=self._borrow_loop, daemon=True, name="ray_tpu-cluster-borrow"
+        )
+        self._borrow_thread.start()
 
     # ------------------------------------------------------------ membership
 
@@ -445,6 +460,12 @@ class ClusterContext:
             ]
         for proxy in proxies:
             proxy.die(f"hosting node {node_hex[:12]} died: {reason}")
+        # its borrows will never be unregistered: release them here so a
+        # crashed agent cannot pin our values forever
+        released = self.runtime.object_store.release_borrows_from(node.agent_addr)
+        if released:
+            logger.info("released %d borrows held by dead node %s",
+                        released, node_hex[:12])
 
     def nodes(self) -> List[Dict[str, Any]]:
         """Cluster membership as recorded in the GCS node table."""
@@ -977,9 +998,66 @@ class ClusterContext:
             pass
         return True
 
+    def _borrow_object(self, oid_hex: str, borrower: str) -> bool:
+        """A peer unpickled one of our refs: pin the value until it
+        unborrows (reference: borrower registration, reference_count.h)."""
+        return self.runtime.object_store.add_borrow(ObjectID(oid_hex), borrower)
+
+    def _unborrow_object(self, oid_hex: str, borrower: str) -> bool:
+        self.runtime.object_store.remove_borrow(ObjectID(oid_hex), borrower)
+        return True
+
     def _enqueue_free(self, object_id: ObjectID, address: str) -> None:
         # called under store entry locks: hand off, never block
-        self._free_queue.put((object_id.hex(), address))
+        self._free_queue.put(("free_object", object_id.hex(), address))
+
+    def enqueue_borrow(self, object_id: ObjectID, owner_addr: str) -> None:
+        """Register this process as a borrower at the owner. Rides the
+        DEDICATED borrow channel (retrying clients, never queued behind
+        best-effort frees): the borrow/unborrow pair for one ref stays
+        FIFO on one queue, and the in-flight window before registration
+        stays bounded by this queue alone. An owner that GCs inside that
+        window surfaces ObjectLostError at the borrower's get()."""
+        self._borrow_queue.put(("borrow_object", object_id.hex(), owner_addr))
+
+    def _enqueue_unborrow(self, object_id: ObjectID, owner_addr: str) -> None:
+        self._borrow_queue.put(("unborrow_object", object_id.hex(), owner_addr))
+
+    def _borrow_loop(self) -> None:
+        """Borrow registrations are correctness-bearing (they pin the
+        owner's value), so unlike the free loop this one RETRIES: a
+        failed op re-enqueues with backoff rather than being dropped —
+        a lost unborrow would pin the owner's value for its lifetime,
+        a lost borrow would leave this process's ref unprotected."""
+        clients: Dict[str, RpcClient] = {}
+        max_attempts = 5
+        while not self._stop.is_set():
+            try:
+                item = self._borrow_queue.get(timeout=0.5)
+            except queue.Empty:
+                continue
+            op, oid_hex, addr = item[:3]
+            attempt = item[3] if len(item) > 3 else 0
+            client = clients.get(addr)
+            if client is None:
+                client = RpcClient(addr, timeout=10.0, retries=2, token=self.token)
+                clients[addr] = client
+            try:
+                client.call(op, oid_hex, self.address)
+            except (RpcError, OSError) as exc:
+                client.close()
+                clients.pop(addr, None)
+                if attempt + 1 < max_attempts and not self._stop.is_set():
+                    time.sleep(min(0.5 * (attempt + 1), 2.0))
+                    self._borrow_queue.put((op, oid_hex, addr, attempt + 1))
+                else:
+                    # owner plausibly dead: its death reclaims everything
+                    logger.warning(
+                        "%s for %s at %s dropped after %d attempts: %r",
+                        op, oid_hex, addr, attempt + 1, exc,
+                    )
+        for client in clients.values():
+            client.close()
 
     def _free_loop(self) -> None:
         # Dedicated cache of SHORT-timeout, no-retry clients: one free
@@ -988,7 +1066,7 @@ class ClusterContext:
         free_clients: Dict[str, RpcClient] = {}
         while not self._stop.is_set():
             try:
-                oid_hex, addr = self._free_queue.get(timeout=0.5)
+                op, oid_hex, addr = self._free_queue.get(timeout=0.5)
             except queue.Empty:
                 continue
             client = free_clients.get(addr)
@@ -996,7 +1074,7 @@ class ClusterContext:
                 client = RpcClient(addr, timeout=3.0, retries=0, token=self.token)
                 free_clients[addr] = client
             try:
-                client.call("free_object", oid_hex)
+                client.call(op, oid_hex)
             except (RpcError, OSError):
                 # best-effort: drop the (likely dead) connection; node
                 # death reclaims its whole store anyway

@@ -94,7 +94,7 @@ class ObjectEntry:
         "object_id", "state", "value", "error", "tier", "nbytes",
         "pin_count", "event", "callbacks", "spill_path", "owner_task",
         "last_access", "lock", "handle_count", "gc_on_seal", "remote_addr",
-        "foreign",
+        "foreign", "owner_addr", "gc_done",
     )
 
     def __init__(self, object_id: ObjectID):
@@ -126,6 +126,15 @@ class ObjectEntry:
         # ANOTHER process (nothing local will ever seal it) — the only
         # entries worth a GCS object-directory lookup on get().
         self.foreign = False
+        # Borrowed reference (reference: reference_count.h:72 borrows):
+        # the address of the OWNING process whose refcount pins the
+        # value. get() pulls from there; releasing this entry sends an
+        # unborrow (never a free — other borrowers may exist).
+        self.owner_addr: Optional[str] = None
+        # One-shot latch: the value was released by GC. Two racing
+        # last-releasers (concurrent unborrows, unborrow vs decref) must
+        # not double-run the non-idempotent accounting in _release_value.
+        self.gc_done = False
 
 
 class ObjectStore:
@@ -174,14 +183,75 @@ class ObjectStore:
         self._fetch_remote: Optional[Callable[[ObjectID, str], Any]] = None
         self._locate: Optional[Callable[[ObjectID], Optional[str]]] = None
         self._free_remote: Optional[Callable[[ObjectID, str], None]] = None
+        self._unborrow: Optional[Callable[[ObjectID, str], None]] = None
+        # owner-side borrow registry: object id -> borrower addresses
+        self._borrowers: Dict[ObjectID, set] = {}
 
     def set_resubmit(self, fn: Callable[[Any], None]) -> None:
         self._resubmit = fn
 
-    def set_cluster_hooks(self, fetch_remote, locate, free_remote=None) -> None:
+    def set_cluster_hooks(self, fetch_remote, locate, free_remote=None,
+                          unborrow=None) -> None:
         self._fetch_remote = fetch_remote
         self._locate = locate
         self._free_remote = free_remote
+        self._unborrow = unborrow
+
+    # ----------------------------------------------------------- borrows
+    # Cross-process borrowed references: a peer that unpickled one of our
+    # refs pins the value here until it unborrows (reference: borrower
+    # bookkeeping in reference_count.h:72). Pins block GC/eviction.
+    # Borrows are keyed by the borrowing process's address so an
+    # unborrow whose matching borrow registration was LOST in transit
+    # can never release a pin that belongs to a different live borrower.
+
+    def add_borrow(self, object_id: ObjectID, borrower: str) -> bool:
+        entry = self.entry(object_id)
+        if entry is None:
+            return False  # already gone: the borrower's get() will fail
+        with self._lock:
+            holders = self._borrowers.setdefault(object_id, set())
+            if borrower in holders:
+                return True  # duplicate registration: one pin per borrower
+            holders.add(borrower)
+        self.pin(object_id)
+        return True
+
+    def remove_borrow(self, object_id: ObjectID, borrower: str) -> None:
+        with self._lock:
+            holders = self._borrowers.get(object_id)
+            if holders is None or borrower not in holders:
+                return  # no matching recorded borrow: nothing to release
+            holders.discard(borrower)
+            if not holders:
+                del self._borrowers[object_id]
+        entry = self.entry(object_id)
+        if entry is None:
+            return
+        self.unpin(object_id)
+        with entry.lock:
+            gc_now = (
+                entry.pin_count == 0
+                and entry.handle_count == 0
+                and entry.event.is_set()
+            )
+        if gc_now:
+            # last borrower left after the owner's handles died: the
+            # deferred GC the pin was blocking runs now
+            self._gc_entry(entry)
+
+    def release_borrows_from(self, borrower: str) -> int:
+        """Drop every borrow a (dead) borrower registered — its unborrows
+        will never arrive, and a crashed agent must not pin values here
+        forever. Returns how many borrows were released."""
+        with self._lock:
+            doomed = [
+                oid for oid, holders in self._borrowers.items()
+                if borrower in holders
+            ]
+        for oid in doomed:
+            self.remove_borrow(oid, borrower)
+        return len(doomed)
 
     # ------------------------------------------------------------------ write
 
@@ -296,6 +366,7 @@ class ObjectStore:
             entry.nbytes = nbytes
             entry.tier = tier
             entry.state = ObjectState.READY
+            entry.gc_done = False  # a re-seal makes the entry collectable again
             entry.last_access = time.monotonic()
             callbacks = list(entry.callbacks)
             entry.callbacks.clear()
@@ -331,6 +402,7 @@ class ObjectStore:
             entry.remote_addr = address
             entry.tier = Tier.REMOTE
             entry.state = ObjectState.READY
+            entry.gc_done = False
             entry.error = None
             entry.last_access = time.monotonic()
             callbacks = list(entry.callbacks)
@@ -425,6 +497,9 @@ class ObjectStore:
                 entry = self.create(object_id)
                 entry.foreign = True  # no local producer registered it
         deadline = None if timeout is None else time.monotonic() + timeout
+        if entry.owner_addr is not None and not entry.event.is_set():
+            # borrowed ref: the owner IS the location — no directory RPC
+            self.seal_remote(object_id, entry.owner_addr)
         if (
             self._locate is not None
             and entry.foreign
@@ -624,6 +699,9 @@ class ObjectStore:
         with entry.lock:
             if entry.handle_count > 0 or entry.pin_count > 0:
                 return  # a handle was recreated (incref) since the decref
+            if entry.gc_done:
+                return  # a concurrent last-releaser already ran
+            entry.gc_done = True
             self._release_value(entry)
             self.stats["gc"] += 1
             if entry.owner_task is not None:
@@ -670,10 +748,20 @@ class ObjectStore:
                 self._arena.delete(aid)
         if entry.spill_path and os.path.exists(entry.spill_path):
             os.unlink(entry.spill_path)
-        if entry.remote_addr is not None and self._free_remote is not None:
-            # the executing node still holds a copy (whether or not we
-            # fetched it since): ask it to release — best-effort, queued,
-            # never blocks under these locks
+        if entry.owner_addr is not None:
+            # borrowed value: tell the owner we are done (an unborrow,
+            # NEVER a free — the owner and other borrowers may live on)
+            if self._unborrow is not None:
+                try:
+                    self._unborrow(entry.object_id, entry.owner_addr)
+                except Exception:
+                    pass
+            entry.owner_addr = None
+            entry.remote_addr = None  # owner's copy is not ours to free
+        elif entry.remote_addr is not None and self._free_remote is not None:
+            # we OWN this object; the executing node still holds the
+            # parked copy (whether or not we fetched it since): ask it to
+            # release — best-effort, queued, never blocks under locks
             try:
                 self._free_remote(entry.object_id, entry.remote_addr)
             except Exception:
@@ -685,8 +773,11 @@ class ObjectStore:
     def free(self, object_id: ObjectID) -> None:
         with self._lock:
             entry = self._entries.pop(object_id, None)
-            if entry is not None:
-                self._release_value(entry)
+        if entry is not None:
+            with entry.lock:
+                if not entry.gc_done:  # a racing GC may have released it
+                    entry.gc_done = True
+                    self._release_value(entry)
 
     # -------------------------------------------------------------- spill/LRU
 

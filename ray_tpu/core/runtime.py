@@ -65,14 +65,46 @@ class ObjectRef:
         return f"ObjectRef({self.object_id.hex()})"
 
     def __reduce__(self):
-        # Refs may be passed through pickled task args between processes of
-        # the same runtime; they rebind to the active runtime on unpickle.
+        # Refs may be passed through pickled task args between processes.
+        # In cluster mode the ref carries its OWNER's node address, so the
+        # receiving process becomes a registered BORROWER: it pins the
+        # value at the owner until its copy of the ref dies (reference:
+        # borrower protocol, reference_count.h:72).
+        ctx = getattr(self._runtime, "cluster", None)
+        if ctx is not None:
+            entry = self._runtime.object_store.entry(self.object_id)
+            owner = (
+                entry.owner_addr
+                if entry is not None and entry.owner_addr  # chained borrow
+                else ctx.address
+            )
+            return (_rebind_cluster_ref, (self.object_id.hex(), owner))
         return (_rebind_object_ref, (self.object_id.hex(),))
 
 
 def _rebind_object_ref(hex_id: str) -> "ObjectRef":
     rt = get_runtime()
     return ObjectRef(ObjectID(hex_id), rt)
+
+
+def _rebind_cluster_ref(hex_id: str, owner_addr: str) -> "ObjectRef":
+    rt = get_runtime()
+    oid = ObjectID(hex_id)
+    ctx = rt.cluster
+    if ctx is not None and owner_addr != ctx.address:
+        store = rt.object_store
+        entry = store.entry(oid)
+        if entry is None:
+            entry = store.create(oid)
+            entry.foreign = True
+        # Register the borrow even when a sealed LOCAL copy exists (e.g.
+        # this agent parked the task's result): without the pin, the
+        # owner's last handle dying would free_object our copy while this
+        # ref still lives. One borrow per (process, object).
+        if entry.owner_addr is None:
+            entry.owner_addr = owner_addr
+            ctx.enqueue_borrow(oid, owner_addr)
+    return ObjectRef(oid, rt)
 
 
 class Runtime:

@@ -31,6 +31,7 @@ import enum
 import itertools
 import logging
 import os
+import queue
 import threading
 import time
 import traceback
@@ -239,6 +240,64 @@ class PlacementGroup:
 # ---------------------------------------------------------------------- scheduler
 
 
+class _ReusableThreadPool:
+    """Grow-on-demand worker threads with an idle free-list.
+
+    The reference leases a dedicated worker PROCESS per running task from
+    a pool that grows under load and reaps idle workers
+    (raylet/worker_pool.h:228). The thread-executor analogue: a task
+    always gets a dedicated thread (so a blocking get() inside a task can
+    never deadlock a fixed-size pool — concurrency is still gated by
+    RESOURCES, not thread count), but finished threads park on a
+    free-list and are reused instead of paying thread churn per task,
+    and idle threads exit after `idle_timeout_s`."""
+
+    def __init__(self, idle_timeout_s: float = 30.0, max_idle: int = 32,
+                 name: str = "ray_tpu-worker"):
+        self._idle: List["queue.Queue"] = []
+        self._lock = threading.Lock()
+        self._idle_timeout = idle_timeout_s
+        self._max_idle = max_idle
+        self._name = name
+        self._spawned = 0  # observability: how many threads ever created
+
+    def submit(self, fn: Callable[[], None]) -> None:
+        with self._lock:
+            q = self._idle.pop() if self._idle else None
+        if q is None:
+            q = queue.Queue()
+            self._spawned += 1
+            threading.Thread(
+                target=self._worker, args=(q,), daemon=True,
+                name=f"{self._name}-{self._spawned}",
+            ).start()
+        q.put(fn)
+
+    def _worker(self, q: "queue.Queue") -> None:
+        while True:
+            try:
+                fn = q.get(timeout=self._idle_timeout)
+            except queue.Empty:
+                # Idle reap — but a submitter may have popped our queue
+                # between the timeout and this check. If our queue is no
+                # longer on the free-list, a task is (about to be) in it:
+                # keep serving. Otherwise deregister and exit.
+                with self._lock:
+                    if q in self._idle:
+                        self._idle.remove(q)
+                        return
+                continue
+            try:
+                fn()
+            except BaseException:  # noqa: BLE001 - worker must survive
+                logger.exception("task thread crashed outside the task boundary")
+            fn = None  # a parked thread must not pin the task's closure
+            with self._lock:
+                if len(self._idle) >= self._max_idle:
+                    return  # enough warm threads parked already
+                self._idle.append(q)
+
+
 class ClusterScheduler:
     """Resource-aware dispatcher over logical nodes.
 
@@ -269,6 +328,14 @@ class ClusterScheduler:
         # ships a task to a RemoteNode's agent. Never raises — completion
         # (including dispatch failure) flows back through finish_remote.
         self.remote_dispatcher: Optional[Callable] = None
+        # task execution threads: dedicated per running task (blocking
+        # get() can never deadlock) but REUSED across tasks
+        self._task_threads = _ReusableThreadPool()
+        # With an autoscaler attached, "no node can ever satisfy" is a
+        # PROVISIONING signal, not an error: demand stays queued for the
+        # scaler to read (reference: pending tasks drive
+        # resource_demand_scheduler). Autoscaler.start() clears this.
+        self.fail_fast_infeasible = True
 
     # -------------------------------------------------------------- membership
 
@@ -568,6 +635,8 @@ class ClusterScheduler:
             if node is None:
                 # fail fast iff the SAME eligibility _pick_node applies
                 # (alive + remotable + hard labels) can never satisfy
+                if not self.fail_fast_infeasible:
+                    return False  # autoscaler will provision for this demand
                 candidates = self._eligible_nodes(spec)
                 if (
                     isinstance(strategy, NodeLabelSchedulingStrategy)
@@ -606,20 +675,13 @@ class ClusterScheduler:
             # Ship to the node agent. The dispatcher thread only covers the
             # (bounded) dispatch RPC; completion arrives asynchronously via
             # finish_remote when the agent reports task_done.
-            thread = threading.Thread(
-                target=self.remote_dispatcher,
-                args=(spec, target, pool),
-                name=f"ray_tpu-dispatch-{spec.name}-{spec.task_id.hex()[:6]}",
-                daemon=True,
+            self._task_threads.submit(
+                lambda s=spec, t=target, p=pool: self.remote_dispatcher(s, t, p)
             )
         else:
-            thread = threading.Thread(
-                target=self._run_task,
-                args=(spec, target, pool),
-                name=f"ray_tpu-worker-{spec.name}-{spec.task_id.hex()[:6]}",
-                daemon=True,
+            self._task_threads.submit(
+                lambda s=spec, t=target, p=pool: self._run_task(s, t, p)
             )
-        thread.start()
         return True
 
     # Hybrid policy randomizes among this many top candidates so a burst
@@ -680,6 +742,10 @@ class ClusterScheduler:
         error_tb = ""
         spec.start_ts = time.time()
         spec.node_hex = node.node_id.hex()
+        # debuggability: the (reused) thread carries the task it runs
+        threading.current_thread().name = (
+            f"ray_tpu-worker-{spec.name}-{spec.task_id.hex()[:6]}"
+        )
         try:
             from . import chaos, runtime_env as _renv
 

@@ -17,5 +17,13 @@ from .state import (  # noqa: F401
     summary,
 )
 from .actor_pool import ActorPool  # noqa: F401
+from .profiling import (  # noqa: F401
+    annotate,
+    device_trace,
+    start_device_trace,
+    start_profiler_server,
+    step_annotation,
+    stop_device_trace,
+)
 from .queue import Empty, Full, Queue  # noqa: F401
 from . import multiprocessing  # noqa: F401

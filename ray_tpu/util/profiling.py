@@ -1,0 +1,77 @@
+"""Device-level profiling: the jax.profiler bridge.
+
+Reference parity: the tracing/profiling aux subsystem (SURVEY.md §5 —
+the reference wires OpenTelemetry spans through its workers and `ray
+timeline` dumps chrome traces). TPU inversion: the interesting timeline
+is on the DEVICE, and XLA already has a first-class profiler. This
+module is the thin, always-importable bridge:
+
+- ``device_trace(logdir)`` captures a TensorBoard-loadable XLA trace
+  (HLO timings, memory, ICI collectives) around any block of work.
+- ``start_profiler_server(port)`` exposes the live profiling endpoint
+  that `tensorboard --logdir` / `xprof` can attach to on demand.
+- ``annotate(name)`` labels host-side regions so device traces line up
+  with runtime phases (engine ticks, train steps).
+
+Host-side task timelines remain in util/state.py (`chrome_tracing_dump`,
+`ray_tpu timeline`); the two views compose — state.py tells you WHAT the
+runtime ran, this module tells you what the CHIP did during it.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator, Optional
+
+
+def start_device_trace(logdir: str) -> None:
+    """Begin capturing an XLA device trace into `logdir` (view with
+    TensorBoard's profile plugin)."""
+    import jax
+
+    jax.profiler.start_trace(logdir)
+
+
+def stop_device_trace() -> None:
+    import jax
+
+    jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def device_trace(logdir: str) -> Iterator[None]:
+    """Context manager form: everything dispatched inside is captured.
+    Remember to block_until_ready/fetch inside the block — work still in
+    flight when the trace stops is cut off."""
+    start_device_trace(logdir)
+    try:
+        yield
+    finally:
+        stop_device_trace()
+
+
+def start_profiler_server(port: int = 9999):
+    """Serve the live profiling endpoint (attach with TensorBoard:
+    capture profile -> 'localhost:<port>')."""
+    import jax
+
+    return jax.profiler.start_server(port)
+
+
+def annotate(name: str, **kwargs):
+    """Named host-side region that shows up in device traces
+    (jax.profiler.TraceAnnotation) — use around engine ticks/train steps
+    so runtime phases line up with HLO activity."""
+    import jax
+
+    return jax.profiler.TraceAnnotation(name, **kwargs)
+
+
+@contextlib.contextmanager
+def step_annotation(step: int, name: str = "train") -> Iterator[None]:
+    """StepTraceAnnotation wrapper: marks step boundaries so the profile
+    viewer's per-step breakdown works."""
+    import jax
+
+    with jax.profiler.StepTraceAnnotation(name, step_num=step):
+        yield

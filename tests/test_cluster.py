@@ -349,3 +349,96 @@ def test_remote_actor_error_and_node_death(cluster):
         ray_tpu.get(pending, timeout=60)
     with pytest.raises(ActorDiedError):
         ray_tpu.get(actor.slow.remote(), timeout=60)
+
+
+# --------------------------------------------------------- borrowed refs
+
+
+def test_nested_ref_borrowed_and_fetched_from_owner(cluster):
+    """An ObjectRef NESTED in a task argument (not resolved at dispatch)
+    crosses to the agent as a BORROWED reference: the agent pulls the
+    value straight from the owner, no object-directory entry needed."""
+
+    big = ray_tpu.put(np.arange(50_000, dtype=np.float64))
+
+    @ray_tpu.remote(num_cpus=1)
+    def consume(wrapped):
+        import ray_tpu as rt
+
+        ref = wrapped["ref"]  # unpickled inside the agent: borrow path
+        arr = rt.get(ref, timeout=30)
+        return float(arr.sum())
+
+    from ray_tpu.core.scheduler import NodeAffinitySchedulingStrategy
+
+    remote_nodes = [n for n in cluster.runtime.scheduler.nodes() if n.is_remote]
+    out = ray_tpu.get(
+        consume.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(
+                remote_nodes[0].node_id
+            )
+        ).remote({"ref": big}),
+        timeout=60,
+    )
+    assert out == float(np.arange(50_000, dtype=np.float64).sum())
+
+
+def test_borrow_pins_value_against_owner_gc(cluster):
+    """While an agent-held actor keeps a borrowed ref, the owner's last
+    handle dying must NOT free the value (the borrow pin); the value is
+    reclaimed only after the borrower releases."""
+    import gc
+
+    from ray_tpu.core.scheduler import NodeAffinitySchedulingStrategy
+
+    remote_nodes = [n for n in cluster.runtime.scheduler.nodes() if n.is_remote]
+
+    @ray_tpu.remote
+    class Holder:
+        def __init__(self):
+            self.held = None
+
+        def hold(self, wrapped):
+            self.held = wrapped["ref"]
+            return True
+
+        def value_sum(self):
+            import ray_tpu as rt
+
+            return float(rt.get(self.held, timeout=30).sum())
+
+        def release(self):
+            import gc as _gc
+
+            self.held = None
+            _gc.collect()
+            return True
+
+    holder = Holder.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(remote_nodes[0].node_id)
+    ).remote()
+    ref = ray_tpu.put(np.ones(10_000))
+    oid = ref.object_id
+    store = cluster.runtime.object_store
+    assert ray_tpu.get(holder.hold.remote({"ref": ref}), timeout=60) is True
+    # wait for the async borrow registration to pin the entry
+    deadline = time.monotonic() + 30
+    while store.entry(oid).pin_count == 0 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert store.entry(oid).pin_count >= 1, "borrow never registered"
+
+    del ref
+    gc.collect()
+    # last OWNER handle is gone, but the borrow pin keeps the value
+    entry = store.entry(oid)
+    assert entry is not None and entry.value is not None
+    assert ray_tpu.get(holder.value_sum.remote(), timeout=60) == 10_000.0
+
+    assert ray_tpu.get(holder.release.remote(), timeout=60) is True
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        entry = store.entry(oid)
+        if entry is None or entry.value is None:
+            break
+        time.sleep(0.05)
+    assert entry is None or entry.value is None, "unborrow never reclaimed"
