@@ -194,6 +194,18 @@ class Autoscaler:
     def step(self) -> None:
         self._scale_up()
         self._scale_down()
+        # demand that NO node and NO node type can ever cover must fail
+        # loudly, not queue forever (fail_fast_infeasible is off while we
+        # run, so the scheduler defers that judgment to us)
+        self.scheduler.fail_unprovisionable(self._can_ever_provision)
+
+    def _can_ever_provision(self, demand: ResourceDict) -> bool:
+        if self._fits_on_some_node(demand):
+            return True
+        return any(
+            all(t.resources.get(k, 0.0) >= v for k, v in demand.items())
+            for t in self.node_types  # max_workers ignored: slots free up
+        )
 
     def _fits_on_some_node(self, demand: ResourceDict) -> bool:
         for node in self.scheduler.nodes():

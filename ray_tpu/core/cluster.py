@@ -321,6 +321,9 @@ class ClusterContext:
         self._reply_clients: Dict[str, RpcClient] = {}
         self._free_queue: "queue.Queue[Tuple[str, str, str]]" = queue.Queue()
         self._borrow_queue: "queue.Queue[Tuple[str, str, str]]" = queue.Queue()
+        # (oid_hex, owner_addr) -> "queued" | "sent": the ordering latch
+        # between a borrow registration and its eventual unborrow
+        self._borrow_state: Dict[Tuple[str, str], str] = {}
         self._stop = threading.Event()
         self.shutdown_requested = threading.Event()
 
@@ -1013,24 +1016,36 @@ class ClusterContext:
 
     def enqueue_borrow(self, object_id: ObjectID, owner_addr: str) -> None:
         """Register this process as a borrower at the owner. Rides the
-        DEDICATED borrow channel (retrying clients, never queued behind
-        best-effort frees): the borrow/unborrow pair for one ref stays
-        FIFO on one queue, and the in-flight window before registration
-        stays bounded by this queue alone. An owner that GCs inside that
+        DEDICATED borrow channel (retrying, never queued behind
+        best-effort frees). Ordering with the eventual unborrow is kept
+        by a per-(object, owner) state latch — see _enqueue_unborrow: a
+        retried borrow can never land AFTER its own unborrow and pin the
+        owner forever. An owner that GCs inside the pre-registration
         window surfaces ObjectLostError at the borrower's get()."""
+        with self._lock:
+            self._borrow_state[(object_id.hex(), owner_addr)] = "queued"
         self._borrow_queue.put(("borrow_object", object_id.hex(), owner_addr))
 
     def _enqueue_unborrow(self, object_id: ObjectID, owner_addr: str) -> None:
-        self._borrow_queue.put(("unborrow_object", object_id.hex(), owner_addr))
+        key = (object_id.hex(), owner_addr)
+        with self._lock:
+            state = self._borrow_state.pop(key, None)
+        if state == "sent":
+            # the borrow reached the owner: release it
+            self._borrow_queue.put(("unborrow_object", object_id.hex(), owner_addr))
+        # "queued": the borrow is still in flight — popping the state makes
+        # the loop discard it when dequeued, so no pin ever lands and no
+        # unborrow is needed. None: the borrow failed permanently.
 
     def _borrow_loop(self) -> None:
         """Borrow registrations are correctness-bearing (they pin the
         owner's value), so unlike the free loop this one RETRIES: a
-        failed op re-enqueues with backoff rather than being dropped —
-        a lost unborrow would pin the owner's value for its lifetime,
-        a lost borrow would leave this process's ref unprotected."""
+        failed op re-enqueues with backoff rather than being dropped.
+        Client timeouts are SHORT (the outer loop is the retry budget) so
+        one unreachable owner cannot head-of-line-block registrations to
+        healthy owners for long."""
         clients: Dict[str, RpcClient] = {}
-        max_attempts = 5
+        max_attempts = 8
         while not self._stop.is_set():
             try:
                 item = self._borrow_queue.get(timeout=0.5)
@@ -1038,9 +1053,14 @@ class ClusterContext:
                 continue
             op, oid_hex, addr = item[:3]
             attempt = item[3] if len(item) > 3 else 0
+            key = (oid_hex, addr)
+            if op == "borrow_object":
+                with self._lock:
+                    if self._borrow_state.get(key) != "queued":
+                        continue  # ref already released: borrow cancelled
             client = clients.get(addr)
             if client is None:
-                client = RpcClient(addr, timeout=10.0, retries=2, token=self.token)
+                client = RpcClient(addr, timeout=3.0, retries=0, token=self.token)
                 clients[addr] = client
             try:
                 client.call(op, oid_hex, self.address)
@@ -1048,7 +1068,7 @@ class ClusterContext:
                 client.close()
                 clients.pop(addr, None)
                 if attempt + 1 < max_attempts and not self._stop.is_set():
-                    time.sleep(min(0.5 * (attempt + 1), 2.0))
+                    time.sleep(min(0.1 * (attempt + 1), 0.5))
                     self._borrow_queue.put((op, oid_hex, addr, attempt + 1))
                 else:
                     # owner plausibly dead: its death reclaims everything
@@ -1056,6 +1076,21 @@ class ClusterContext:
                         "%s for %s at %s dropped after %d attempts: %r",
                         op, oid_hex, addr, attempt + 1, exc,
                     )
+                    if op == "borrow_object":
+                        with self._lock:
+                            self._borrow_state.pop(key, None)
+                continue
+            if op == "borrow_object":
+                with self._lock:
+                    # unless released while we were sending (loop will
+                    # find no state and the unborrow path already ran —
+                    # send the unborrow it skipped)
+                    if self._borrow_state.get(key) == "queued":
+                        self._borrow_state[key] = "sent"
+                    else:
+                        self._borrow_queue.put(
+                            ("unborrow_object", oid_hex, addr)
+                        )
         for client in clients.values():
             client.close()
 

@@ -688,7 +688,11 @@ class ObjectStore:
         with entry.lock:
             entry.handle_count = max(0, entry.handle_count - 1)
             if entry.handle_count == 0:
-                if entry.event.is_set():
+                if entry.event.is_set() or entry.owner_addr is not None:
+                    # sealed, OR a borrowed foreign entry that was never
+                    # get() — nothing local will ever seal it, and its
+                    # unborrow must still reach the owner (releasing only
+                    # on seal would pin the owner's value forever)
                     gc_now = True
                 else:
                     entry.gc_on_seal = True

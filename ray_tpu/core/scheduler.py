@@ -362,6 +362,37 @@ class ClusterScheduler:
         with self._lock:
             return [dict(spec.resources) for spec in self._pending]
 
+    def fail_unprovisionable(self, can_provision) -> int:
+        """Fail queued tasks whose demand `can_provision(resources)`
+        rejects. The autoscaler calls this with its node-type coverage:
+        with fail_fast_infeasible off, demand no NodeType could EVER
+        cover would otherwise queue silently forever."""
+        # evaluate the predicate OUTSIDE the lock: it inspects cluster
+        # state through methods that take this same (non-reentrant) lock
+        with self._lock:
+            snapshot = list(self._pending)
+        doomed = [
+            spec for spec in snapshot
+            if not can_provision(dict(spec.resources))
+        ]
+        removed: List[TaskSpec] = []
+        with self._lock:
+            for spec in doomed:
+                try:
+                    self._pending.remove(spec)
+                    removed.append(spec)
+                except ValueError:
+                    pass  # dispatched while we judged it: not doomed
+        for spec in removed:
+            self._fail_returns(
+                spec,
+                OutOfResourcesError(
+                    f"Task {spec.name} requires {spec.resources}, which no "
+                    f"current node or provisionable node type can satisfy"
+                ),
+            )
+        return len(removed)
+
     def head_node(self) -> Node:
         with self._lock:
             for n in self._nodes.values():

@@ -178,8 +178,9 @@ def serving_shardings(model_config: TransformerConfig, mesh, rules=None):
         lambda s: NamedSharding(mesh, s), specs,
         is_leaf=lambda x: isinstance(x, PartitionSpec),
     )
+    # flat pool layout (Hkv, L*P, ps, D): kv heads lead
     kv_spec = NamedSharding(
-        mesh, PartitionSpec(None, "tp", None, None, None)
+        mesh, PartitionSpec("tp", None, None, None)
     )
     cache_sh = {"k": kv_spec, "v": kv_spec}
     replicated = NamedSharding(mesh, PartitionSpec())

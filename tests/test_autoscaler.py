@@ -88,3 +88,28 @@ def test_local_process_provider_spawns_real_agents():
         from ray_tpu.core.config import cfg
 
         cfg.reset()
+
+
+def test_unprovisionable_demand_fails_loudly():
+    """With a scaler attached, demand NO node type can ever cover must
+    raise OutOfResourcesError instead of queueing silently forever."""
+    from ray_tpu.core.exceptions import OutOfResourcesError
+
+    rt = ray_tpu.init(num_cpus=1, detect_accelerators=False)
+    try:
+        provider = FakeNodeProvider(rt.scheduler)
+        scaler = Autoscaler(
+            rt.scheduler, provider, [NodeType("cpu4", {"CPU": 4.0})],
+            poll_interval_s=0.05, idle_timeout_s=5.0,
+        )
+        scaler.start()
+
+        @ray_tpu.remote(num_cpus=64)
+        def impossible():
+            return "never"
+
+        with pytest.raises(OutOfResourcesError):
+            ray_tpu.get(impossible.remote(), timeout=30)
+        scaler.stop()
+    finally:
+        ray_tpu.shutdown()
