@@ -115,6 +115,24 @@ define_flag("remote_inline_max_bytes", 512 * 1024,
 define_flag("cluster_bind_host", "127.0.0.1",
             "Host address cluster services bind to (0.0.0.0 for multi-host; "
             "set a cluster token when leaving localhost).")
+define_flag("foreign_locate_max_s", 300.0,
+            "get() on a ref from another process gives up (ObjectLostError) "
+            "after polling the object directory this long with no location "
+            "registered. Raise it when cross-driver refs point at tasks "
+            "that legitimately run longer before sealing their result.")
+define_flag("agent_admission_queue", 0,
+            "Length of a node agent's admission queue for tasks its ledger "
+            "cannot admit yet (0 = 4x its CPU count, min 8); overflow "
+            "bounces dispatches back to the owner for rescheduling.")
+define_flag("result_delivery_attempts", 6,
+            "Delivery attempts for a task completion before the agent parks "
+            "the result for the owner's recovery poll.")
+define_flag("parked_result_ttl_s", 600.0,
+            "How long an agent keeps an undeliverable task result parked "
+            "for the owner to re-poll before dropping it.")
+define_flag("pending_task_poll_s", 10.0,
+            "Owner re-polls the executing agent about a dispatched task "
+            "after this long without a completion report.")
 
 # memory monitor / OOM
 define_flag("memory_monitor_interval_s", 0.25,

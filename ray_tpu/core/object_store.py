@@ -94,7 +94,7 @@ class ObjectEntry:
         "object_id", "state", "value", "error", "tier", "nbytes",
         "pin_count", "event", "callbacks", "spill_path", "owner_task",
         "last_access", "lock", "handle_count", "gc_on_seal", "remote_addr",
-        "foreign", "owner_addr", "gc_done",
+        "foreign", "owner_addr", "gc_done", "borrow_failed",
     )
 
     def __init__(self, object_id: ObjectID):
@@ -135,6 +135,10 @@ class ObjectEntry:
         # last-releasers (concurrent unborrows, unborrow vs decref) must
         # not double-run the non-idempotent accounting in _release_value.
         self.gc_done = False
+        # The borrow registration for this (borrowed) ref exhausted its
+        # retry budget: a later loss is plausibly the borrow protocol's
+        # fault, not the object's — surfaced in ObjectLostError's note.
+        self.borrow_failed = False
 
 
 class ObjectStore:
@@ -410,6 +414,12 @@ class ObjectStore:
         entry.event.set()
         for cb in callbacks:
             cb(entry)
+        if entry.gc_on_seal:
+            # every handle died while the task ran remotely: GC now — the
+            # _gc_entry path also frees the agent-side parked copy and the
+            # objdir entry via remote_addr (same contract as seal())
+            entry.gc_on_seal = False
+            self._gc_entry(entry)
 
     def _fetch_through(self, entry: ObjectEntry) -> Any:
         """Pull a REMOTE-tier value from its owner and cache it locally.
@@ -449,6 +459,9 @@ class ObjectStore:
         entry.event.set()
         for cb in callbacks:
             cb(entry)
+        if entry.gc_on_seal:
+            entry.gc_on_seal = False
+            self._gc_entry(entry)
 
     # ------------------------------------------------------------------- read
 
@@ -512,8 +525,16 @@ class ObjectStore:
             # POLL the directory while waiting: the producer may register
             # the location after this get() started (a task still
             # running, or the objdir write racing us by milliseconds).
-            # Locally-owned pending entries never pay this RPC.
+            # Locally-owned pending entries never pay this RPC. The poll
+            # is BOUNDED (foreign_locate_max_s): if no location is ever
+            # registered — producing node died pre-registration, or a
+            # stale ref was unpickled — the entry drops to LOST so the
+            # lineage/ObjectLostError path runs instead of spinning
+            # forever on an infinite timeout.
+            from .config import cfg
+
             poll = 0.02
+            give_up = time.monotonic() + cfg.foreign_locate_max_s
             while not entry.event.is_set():
                 try:
                     address = self._locate(object_id)
@@ -522,12 +543,19 @@ class ObjectStore:
                 if address:
                     self.seal_remote(object_id, address)
                     break
-                remaining = None if deadline is None else deadline - time.monotonic()
+                now = time.monotonic()
+                remaining = None if deadline is None else deadline - now
                 if remaining is not None and remaining <= 0:
                     raise GetTimeoutError(
                         f"Get timed out after {timeout}s waiting for "
                         f"{object_id} (no location registered)"
                     )
+                if now >= give_up:
+                    with entry.lock:
+                        if not entry.event.is_set():
+                            entry.state = ObjectState.LOST
+                            entry.event.set()
+                    break
                 wait_s = poll if remaining is None else min(poll, remaining)
                 entry.event.wait(wait_s)
                 poll = min(poll * 2, 1.0)
@@ -584,7 +612,13 @@ class ObjectStore:
                 ):
                     reconstructions += 1
                     continue
-                raise ObjectLostError(object_id)
+                raise ObjectLostError(
+                    object_id,
+                    "(The borrow registration to the owner failed after "
+                    "retries; the owner may have GC'd the value because "
+                    "this process's pin never landed.)"
+                    if entry.borrow_failed else "",
+                )
             # PENDING again (a reconstruction won the race): just re-wait.
         self.stats["gets"] += 1
         if restored:

@@ -35,7 +35,14 @@ MAX_FRAME = 1 << 31  # 2 GiB safety bound
 # Auth handshake prefix. The token check happens BEFORE any unpickling:
 # a pickle payload on the wire is arbitrary code execution, so a server
 # bound off-localhost must drop unauthenticated peers at the first frame.
-_AUTH_MAGIC = b"RAYTPU-AUTH1:"
+# Challenge-response (v2): the server sends a fresh nonce, the client
+# answers HMAC-SHA256(token, nonce) — the token itself never crosses the
+# wire, so an on-path observer cannot sniff-and-replay it (a replayed
+# digest is useless against the next connection's nonce). Multi-host
+# deployments still assume a trusted network for the pickle payloads
+# themselves (wrap in TLS/WireGuard otherwise) — this matches the
+# reference, whose gRPC channels are plaintext unless TLS is configured.
+_AUTH_MAGIC = b"RAYTPU-AUTH2:"
 
 
 class RpcError(RuntimeError):
@@ -101,14 +108,21 @@ class RpcServer:
                         outer._conns.discard(sock)
 
             def _authenticate(self, sock) -> bool:
-                """First frame must be the shared secret — checked with a
-                constant-time compare, with NO unpickling before success
-                (reference: redis password gating every `ray start` port)."""
+                """Challenge-response: send a fresh nonce, require
+                HMAC(token, nonce) back — constant-time compare, NO
+                unpickling before success (reference: redis password
+                gating every `ray start` port, minus the cleartext)."""
+                import os as _os
+
+                nonce = _os.urandom(32)
                 try:
+                    _send_frame(sock, _AUTH_MAGIC + nonce)
                     frame = _recv_frame(sock)
                 except (RpcError, OSError):
                     return False
-                expected = _AUTH_MAGIC + outer._token.encode()
+                expected = hmac.new(
+                    outer._token.encode(), nonce, "sha256"
+                ).digest()
                 if not hmac.compare_digest(frame, expected):
                     logger.warning(
                         "rpc: dropped unauthenticated connection from %s",
@@ -203,8 +217,21 @@ class RpcClient:
         sock = socket.create_connection(self._addr, timeout=self._timeout)
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         if self._token is not None:
-            _send_frame(sock, _AUTH_MAGIC + self._token.encode())
             try:
+                challenge = _recv_frame(sock)
+            except RpcError:
+                sock.close()
+                raise RpcAuthError(
+                    f"server {self._addr} sent no auth challenge (token "
+                    f"configured here but not there?)"
+                ) from None
+            if not challenge.startswith(_AUTH_MAGIC):
+                sock.close()
+                raise RpcAuthError(f"bad auth challenge from {self._addr}")
+            nonce = challenge[len(_AUTH_MAGIC):]
+            digest = hmac.new(self._token.encode(), nonce, "sha256").digest()
+            try:
+                _send_frame(sock, digest)
                 ack = _recv_frame(sock)
             except RpcError:
                 sock.close()
@@ -228,7 +255,17 @@ class RpcClient:
                         self._sock = self._connect()
                     _send_frame(self._sock, payload)
                     frame = _recv_frame(self._sock)
-                status, value = pickle.loads(frame)
+                if frame.startswith(_AUTH_MAGIC):
+                    # a tokenless client on an auth-requiring server: the
+                    # server's first frame is its challenge, not a reply
+                    self.close()
+                    raise RpcAuthError(
+                        f"server {self._addr} requires a cluster auth token"
+                    )
+                try:
+                    status, value = pickle.loads(frame)
+                except Exception as exc:
+                    raise RpcError(f"undecodable reply frame: {exc!r}") from None
             except RpcAuthError:
                 raise  # wrong/missing token: retrying cannot help
             except (OSError, RpcError) as exc:

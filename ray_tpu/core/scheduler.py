@@ -126,6 +126,8 @@ class TaskSpec:
     # resubmits caused by node/worker death (budgeted separately from user
     # max_retries, reference: task_manager system-failure retries)
     system_attempts: int = 0
+    # times an agent bounced this dispatch ("busy"): drives requeue backoff
+    bounces: int = 0
     cancelled: bool = False
     submitted_at: float = field(default_factory=time.monotonic)
     # observability (filled by the task runner; consumed by the timeline)
@@ -856,6 +858,25 @@ class ClusterScheduler:
         spec.end_ts = time.time()
         self._on_task_done(spec, error)
         self._wake.set()
+
+    def requeue_remote(self, spec: TaskSpec, node: Node, pool: ResourceSet) -> None:
+        """An agent bounced a dispatched task ("busy": its own admission
+        ledger is full and its queue overflowed — another driver is
+        saturating it). Not a failure and not a retry: release the
+        owner-side reservation and resubmit after a backoff, giving the
+        next heartbeat a chance to refresh the resource picture so the
+        task can spill elsewhere. The backoff grows per bounce: a stale
+        view that keeps picking the same saturated node must not turn
+        into a hot dispatch/bounce RPC loop."""
+        pool.release(spec.resources)
+        with node._lock:
+            node.running_tasks.pop(spec.task_id, None)
+        self.stats["spillbacks"] += 1
+        delay = min(0.2 * (2 ** min(spec.bounces, 4)), 2.0)
+        spec.bounces += 1
+        timer = threading.Timer(delay, lambda: self.submit(spec))
+        timer.daemon = True
+        timer.start()
 
     def finish_remote(self, spec: TaskSpec, node: Node, pool: ResourceSet,
                       error: Optional[BaseException] = None, error_tb: str = "",

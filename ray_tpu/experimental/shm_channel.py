@@ -21,6 +21,15 @@ and stores the new version into ITS OWN ack slot. Every shared word is
 an aligned 8-byte slot written by exactly one side, so plain coherent
 stores are enough — no futexes, no semaphores, and the payload bytes
 cross processes through the page cache with zero RPC round trips.
+
+MEMORY-ORDERING ASSUMPTION: the payload→data_len→version store order is
+published with plain stores, which the reader is guaranteed to observe
+in order only under TSO (x86/x86_64). On weakly-ordered hosts (ARM) a
+reader could observe the bumped version before the payload bytes and
+unpickle a torn buffer — creation therefore warns off-x86. TPU hosts
+are x86_64, so this is the honest trade for a dependency-free seqlock;
+a portable build would publish the version through a C11 atomic with
+release/acquire semantics (one small C helper).
 Same-host only by construction (cross-host traffic rides the RPC/object
 planes); in-process endpoints should prefer experimental.channel.Channel
 which passes references with no serialization at all.
@@ -67,10 +76,27 @@ class ShmChannel:
     readers (it pickles by path); each reader calls ``reader(i)`` for
     its dedicated ack slot."""
 
+    _warned_weak_ordering = False
+
     def __init__(self, capacity: int = 1 << 20, num_readers: int = 1,
                  path: Optional[str] = None, _create: bool = True):
         if num_readers < 1:
             raise ValueError("num_readers must be >= 1")
+        import platform
+
+        machine = platform.machine().lower()
+        if machine not in ("x86_64", "amd64", "i686", "i386") and (
+            not ShmChannel._warned_weak_ordering
+        ):
+            ShmChannel._warned_weak_ordering = True
+            import warnings
+
+            warnings.warn(
+                "ShmChannel's lock-free protocol assumes TSO (x86) store "
+                f"ordering; on {machine} a reader may observe a torn "
+                "payload. See the module docstring.",
+                RuntimeWarning,
+            )
         self.capacity = int(capacity)
         self.num_readers = int(num_readers)
         self._data_off = _HDR.size + _ACK.size * self.num_readers
