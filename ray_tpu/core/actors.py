@@ -93,6 +93,7 @@ class ActorRuntime:
         registered_namespace: str = "default",
         executor: str = "thread",
         runtime_env: Optional[Dict[str, Any]] = None,
+        placement_pool: Optional[ResourceSet] = None,
     ):
         self.actor_id = actor_id
         self.cls = cls
@@ -115,6 +116,10 @@ class ActorRuntime:
         # serialize even with max_concurrency > 1.
         self.executor = executor
         self.runtime_env = runtime_env  # normalized; process actors only
+        # Explicit lease source (cluster: a hosted PG bundle's reserved
+        # pool — the 2PC grant already holds these resources, so normal
+        # node selection must not double-acquire them from the ledger)
+        self.placement_pool = placement_pool
         self._worker = None  # WorkerProcess when executor == "process"
         self._incarnation = 0  # bumped on every (re)start; see _RestartSignal
 
@@ -147,7 +152,19 @@ class ActorRuntime:
             with self._lock:
                 if self.state == ActorState.DEAD:
                     return False
-            if isinstance(strategy, PlacementGroupSchedulingStrategy):
+            if self.placement_pool is not None:
+                # cluster-hosted PG bundle: lease straight from the
+                # reserved pool on this node's head
+                if not self.placement_pool.can_ever_fit(self.resources):
+                    self.death_cause = (
+                        f"reserved bundle cannot ever satisfy {self.resources}"
+                    )
+                    return False
+                if self.placement_pool.try_acquire(self.resources):
+                    self._node = self._scheduler.head_node()
+                    self._pool = self.placement_pool
+                    return True
+            elif isinstance(strategy, PlacementGroupSchedulingStrategy):
                 pg = strategy.placement_group
                 idx = strategy.placement_group_bundle_index
                 try:
@@ -155,16 +172,27 @@ class ActorRuntime:
                 except IndexError:
                     self.death_cause = f"bundle index {idx} out of range"
                     return False
+                had_remote = any(
+                    b.node is not None and b.node.is_remote for b in bundles
+                )
                 bundles = [
                     b for b in bundles
-                    if b.node is None or not b.node.is_remote  # actors stay local
+                    if b.node is None or not b.node.is_remote
+                    # remote bundles are handled by the cluster placement
+                    # path (can_place_actor_remotely) before this runs; a
+                    # remote bundle reaching here lost its host or lease
                 ]
                 if not any(
                     b.reserved is not None and b.reserved.can_ever_fit(self.resources)
                     for b in bundles
                 ):
                     self.death_cause = (
-                        f"no local bundle in placement group can ever satisfy {self.resources}"
+                        f"no local bundle in placement group can ever satisfy "
+                        f"{self.resources}"
+                        + (
+                            " (its remote bundles were unusable too — dead "
+                            "host or released lease)" if had_remote else ""
+                        )
                     )
                     return False
                 for bundle in bundles:

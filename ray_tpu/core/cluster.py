@@ -68,6 +68,7 @@ from .object_transfer import ObjectTransferServer, fetch_object, push_object
 from .rpc import RpcClient, RpcError
 from .scheduler import (
     NodeAffinitySchedulingStrategy,
+    PlacementGroupSchedulingStrategy,
     RemoteNode,
     TaskSpec,
     _resolve,
@@ -158,6 +159,9 @@ class RemoteActorProxy:
         self.death_reason = ""
         self.node: Optional[RemoteNode] = None
         self.resources: Dict[str, float] = {}
+        # the pool the owner-side reservation was drawn from: the node's
+        # resource view, or a PG bundle's reserved pool
+        self.pool = None
         # set when the owner registered a name for this actor; cleared
         # (and unregistered) on death so names never squat
         self.registered_name: Optional[str] = None
@@ -277,7 +281,7 @@ class RemoteActorProxy:
             self.death_reason = reason
             inflight = list(self._inflight.values())
             self._inflight.clear()
-            node, resources = self.node, self.resources
+            pool, resources = self.pool, self.resources
             self.resources = {}
         self._created.set()  # unblock the sender so it can drain/fail
         with self.ctx._lock:
@@ -286,8 +290,8 @@ class RemoteActorProxy:
         for call in inflight:
             self._fail_call(call, reason)
         # release the owner-side resource reservation exactly once
-        if node is not None and resources:
-            node.resources.release(resources)
+        if pool is not None and resources:
+            pool.release(resources)
         # release the name(s): a dead actor must not squat its name
         if self.registered_name:
             self.ctx.runtime.gcs.unregister_named_actor(
@@ -346,6 +350,8 @@ class ClusterContext:
         self.server.register("actor_state", self._agent_actor_state)
         self.server.register("actor_task_done", self._actor_task_done)
         self.server.register("poll_task_done", self._poll_task_done)
+        self.server.register("reserve_bundle", self._reserve_bundle)
+        self.server.register("release_bundle", self._release_bundle)
         self.address = self.server.address
 
         self.gcs = GcsClient(gcs_address, token=self.token)
@@ -375,6 +381,12 @@ class ClusterContext:
         # ANY release of this node's ledger (remote task, local task,
         # actor teardown, PG removal) may unblock queued admissions
         self._local_node.resources.on_release = self._drain_admission
+        # Placement-group bundles OTHER drivers reserved on this node
+        # (2PC phase-2 grants): (pg_hex, bundle_idx) -> reserved pool,
+        # drawn from this node's ledger at reserve time. Tasks/actors
+        # dispatched into a bundle lease from its pool, not the ledger.
+        self._hosted_bundles: Dict[Tuple[str, int], Any] = {}
+        self._bundle_owner: Dict[Tuple[str, int], str] = {}  # -> node hex
         # remote actors this process OWNS (proxies), and the in-flight
         # actor calls awaiting an actor_task_done reply
         self.remote_actors: Dict[ActorID, RemoteActorProxy] = {}
@@ -399,6 +411,8 @@ class ClusterContext:
             unborrow=self._enqueue_unborrow,
         )
         runtime.scheduler.remote_dispatcher = self._dispatch
+        runtime.scheduler.remote_bundle_reserver = self._reserve_remote_bundles
+        runtime.scheduler.remote_bundle_releaser = self._release_remote_bundles
 
         self._register()
         self._watch_thread = threading.Thread(
@@ -545,6 +559,12 @@ class ClusterContext:
         if released:
             logger.info("released %d borrows held by dead node %s",
                         released, node_hex[:12])
+        # ...and any placement-group bundles its driver reserved on THIS
+        # node go back to the ledger
+        freed = self._release_bundles_owned_by(node_hex)
+        if freed:
+            logger.info("released %d PG bundles reserved by dead node %s",
+                        freed, node_hex[:12])
 
     def nodes(self) -> List[Dict[str, Any]]:
         """Cluster membership as recorded in the GCS node table."""
@@ -572,6 +592,18 @@ class ClusterContext:
             # are already sealed (the scheduler gates dispatch on them).
             args = _resolve(spec.args, self.runtime.object_store)
             kwargs = _resolve(spec.kwargs, self.runtime.object_store)
+            # A task scheduled into a placement-group bundle leases from
+            # the agent's RESERVED bundle pool, not its ledger (the 2PC
+            # grant already holds those resources there).
+            bundle_key = None
+            strategy = spec.scheduling_strategy
+            if isinstance(strategy, PlacementGroupSchedulingStrategy):
+                pg = strategy.placement_group
+                idx = next(
+                    (b.index for b in pg.bundles if b.reserved is pool), None
+                )
+                if idx is not None:
+                    bundle_key = (pg.id.hex(), idx)
             blob = cloudpickle.dumps({
                 "task_hex": task_hex,
                 "name": spec.name,
@@ -581,6 +613,7 @@ class ClusterContext:
                 "num_returns": spec.num_returns,
                 "return_oids": [oid.hex() for oid in spec.return_ids],
                 "resources": dict(spec.resources),
+                "bundle": bundle_key,
                 "runtime_env": spec.runtime_env,
                 "executor": spec.executor,
                 "reply_addr": self.address,
@@ -766,18 +799,149 @@ class ClusterContext:
                 for oid in gone.return_ids:
                     self.runtime.object_store.seal_error(oid, err)
 
+    # ------------------------------------------- cluster-wide placement groups
+
+    def _reserve_remote_bundles(self, pg_hex: str, bundles) -> Optional[str]:
+        """2PC phase 2 (owner side): PREPARE each remote bundle at its
+        agent, in order; on any refusal roll back the ones already
+        granted and report the failure so the scheduler can replan
+        (reference: LeaseStatusTracker prepare/commit,
+        gcs_placement_group_scheduler.h:133)."""
+        prepared = []
+        for bundle in bundles:
+            try:
+                reply = bundle.node.client.call(
+                    "reserve_bundle", pg_hex, bundle.index,
+                    dict(bundle.resources), self.node_id.hex(),
+                )
+            except (RpcError, OSError) as exc:
+                reply = f"unreachable: {exc!r}"
+            if reply != "ok":
+                # roll back the failing bundle too: a TIMED-OUT grant may
+                # have landed on the agent after all (release is
+                # idempotent — False when nothing was reserved)
+                self._release_remote_bundles(pg_hex, prepared + [bundle])
+                return (
+                    f"agent {bundle.node.node_id.hex()[:12]} refused bundle "
+                    f"{bundle.index}: {reply}"
+                )
+            prepared.append(bundle)
+        return None
+
+    def _release_remote_bundles(self, pg_hex: str, bundles) -> None:
+        """Release remote bundle reservations (rollback or PG removal).
+        Best-effort: a dead agent's ledger dies with it."""
+        for bundle in bundles:
+            try:
+                bundle.node.client.call("release_bundle", pg_hex, bundle.index)
+            except (RpcError, OSError):
+                pass
+
+    def _reserve_bundle(self, pg_hex: str, index: int, resources: Dict[str, float],
+                        owner_hex: str) -> str:
+        """Agent side: grant a bundle lease against THIS node's ledger.
+        The reserved pool is what tasks/actors dispatched into the
+        bundle lease from; its releases drain the admission queue like
+        any other ledger release."""
+        from .resources import ResourceSet
+
+        if not self._local_node.resources.try_acquire(resources):
+            return "busy"
+        pool = ResourceSet(resources)
+        pool.on_release = self._drain_admission
+        with self._lock:
+            self._hosted_bundles[(pg_hex, index)] = pool
+            self._bundle_owner[(pg_hex, index)] = owner_hex
+        return "ok"
+
+    def _release_bundle(self, pg_hex: str, index: int) -> bool:
+        with self._lock:
+            pool = self._hosted_bundles.pop((pg_hex, index), None)
+            self._bundle_owner.pop((pg_hex, index), None)
+        if pool is None:
+            return False
+        # Exact-accounting detach: the UNUSED slice of the bundle returns
+        # to the ledger now; the slice still held by running tasks/actors
+        # flows back as they finish (reconcile hook below). The pool is
+        # closed so restarts/new leases cannot draw from detached
+        # capacity the ledger has re-admitted.
+        pool.closed = True
+        ledger = self._local_node.resources
+        returned = pool.available()
+        state = {"returned": dict(returned)}
+        reconcile_lock = threading.Lock()
+
+        def reconcile() -> None:
+            # a holder released into the closed pool: forward the delta
+            with reconcile_lock:
+                avail = pool.available()
+                delta = {
+                    k: avail.get(k, 0.0) - state["returned"].get(k, 0.0)
+                    for k in pool.total
+                }
+                pos = {k: v for k, v in delta.items() if v > 1e-9}
+                for k, v in pos.items():
+                    state["returned"][k] = state["returned"].get(k, 0.0) + v
+            if pos:
+                ledger.release(pos)
+
+        pool.on_release = reconcile
+        if returned:
+            ledger.release(returned)
+        return True
+
+    def _release_bundles_owned_by(self, node_hex: str) -> int:
+        """A node died: every bundle it reserved here returns to the
+        ledger (its driver can never release them now)."""
+        with self._lock:
+            doomed = [
+                key for key, owner in self._bundle_owner.items()
+                if owner == node_hex
+            ]
+        for key in doomed:
+            self._release_bundle(*key)
+        return len(doomed)
+
     # -------------------------------------------------------- remote actors
 
-    def can_place_actor_remotely(self, strategy, resources) -> Optional[RemoteNode]:
-        """Owner-side placement decision: explicit NodeAffinity to a live
-        remote node, or default-strategy spillover when NO local node can
-        ever satisfy the resources but a remote one can."""
+    def can_place_actor_remotely(self, strategy, resources):
+        """Owner-side placement decision. Returns None (stay local) or
+        (node, pool, bundle_key): explicit NodeAffinity to a live remote
+        node; a placement-group bundle reserved on a remote node (the
+        actor leases from the bundle's pool on both sides); or
+        default-strategy spillover when NO local node can ever satisfy
+        the resources but a remote one can."""
         if isinstance(strategy, NodeAffinitySchedulingStrategy):
             with self._lock:
                 node = self._remote_nodes.get(strategy.node_id.hex())
-            return node if node is not None and node.alive else None
+            if node is not None and node.alive:
+                return (node, node.resources, None)
+            return None
+        if isinstance(strategy, PlacementGroupSchedulingStrategy):
+            pg = strategy.placement_group
+            idx = strategy.placement_group_bundle_index
+            try:
+                bundles = pg.bundles if idx < 0 else [pg.bundles[idx]]
+            except IndexError:
+                return None  # the local path surfaces the error
+            # prefer a LOCAL bundle when one could ever host the actor
+            if any(
+                b.node is not None and not b.node.is_remote
+                and b.reserved is not None
+                and b.reserved.can_ever_fit(resources)
+                for b in bundles
+            ):
+                return None
+            for b in bundles:
+                if (
+                    b.node is not None and b.node.is_remote and b.node.alive
+                    and b.reserved is not None
+                    and b.reserved.can_ever_fit(resources)
+                ):
+                    return (b.node, b.reserved, (pg.id.hex(), b.index))
+            return None
         if not isinstance(strategy, str) or strategy not in ("DEFAULT", "SPREAD"):
-            return None  # placement groups stay local
+            return None
         local = [
             n for n in self.runtime.scheduler.nodes()
             if not n.is_remote and n.alive
@@ -789,17 +953,20 @@ class ClusterContext:
         feasible = [n for n in remotes if n.resources.can_ever_fit(resources)]
         if not feasible:
             return None
-        return min(feasible, key=lambda n: n.utilization())
+        node = min(feasible, key=lambda n: n.utilization())
+        return (node, node.resources, None)
 
     def create_remote_actor(
         self, node: RemoteNode, cls, args, kwargs, *, resources,
         max_restarts, max_concurrency, name, namespace, executor,
-        runtime_env,
+        runtime_env, pool=None, bundle=None,
     ) -> Tuple[ActorID, RemoteActorProxy]:
         """Host an actor on a node agent. Returns immediately with a
         PENDING proxy; method calls buffer until the agent confirms
         (reference: async actor creation through the GCS actor manager,
-        gcs_actor_manager.h:328)."""
+        gcs_actor_manager.h:328). `pool` is the owner-side reservation
+        source (node view, or a PG bundle's reserved pool) and `bundle`
+        the (pg_hex, index) the agent should lease from."""
         actor_id = ActorID.of(self.runtime.job_id)
         proxy = RemoteActorProxy(self, actor_id, name or getattr(cls, "__name__", "Actor"))
         with self._lock:
@@ -808,7 +975,8 @@ class ClusterContext:
             target=self._create_actor_worker,
             args=(proxy, node, cls, args, kwargs, dict(resources or {}),
                   max_restarts, max_concurrency, name, namespace, executor,
-                  runtime_env),
+                  runtime_env, pool if pool is not None else node.resources,
+                  bundle),
             daemon=True,
             name=f"ray_tpu-ractor-create-{actor_id.hex()[:8]}",
         ).start()
@@ -816,13 +984,14 @@ class ClusterContext:
 
     def _create_actor_worker(self, proxy, node, cls, args, kwargs, resources,
                              max_restarts, max_concurrency, name, namespace,
-                             executor, runtime_env) -> None:
+                             executor, runtime_env, pool, bundle) -> None:
         import cloudpickle
 
-        # owner-side reservation on the remote node's resource view —
-        # waits like local actor placement does (actors.py) so the view
-        # stays consistent with task dispatch
-        while not node.resources.try_acquire(resources):
+        # owner-side reservation on the remote node's resource view (or
+        # the PG bundle's reserved pool) — waits like local actor
+        # placement does (actors.py) so the view stays consistent with
+        # task dispatch
+        while not pool.try_acquire(resources):
             if proxy.state == "DEAD" or not node.alive:
                 proxy.die("node lost before actor placement")
                 return
@@ -831,9 +1000,10 @@ class ClusterContext:
             if proxy.state == "DEAD":
                 # killed while we were acquiring: die() saw empty
                 # resources, so WE release the acquisition
-                node.resources.release(resources)
+                pool.release(resources)
                 return
             proxy.resources = dict(resources)
+            proxy.pool = pool
             proxy.node = node
         try:
             blob = cloudpickle.dumps({
@@ -842,6 +1012,7 @@ class ClusterContext:
                 "args": args,
                 "kwargs": kwargs,
                 "resources": resources,
+                "bundle": bundle,
                 "max_restarts": max_restarts,
                 "max_concurrency": max_concurrency,
                 "executor": executor,
@@ -931,6 +1102,13 @@ class ClusterContext:
         import cloudpickle
 
         msg = cloudpickle.loads(blob)
+        placement_pool = None
+        bundle = msg.get("bundle")
+        if bundle is not None:
+            with self._lock:
+                placement_pool = self._hosted_bundles.get(tuple(bundle))
+            if placement_pool is None:
+                return f"no bundle {bundle} reserved here"
         handle = self.runtime.create_actor(
             msg["cls"], tuple(msg["args"]), dict(msg["kwargs"]),
             resources=msg["resources"],
@@ -938,6 +1116,7 @@ class ClusterContext:
             max_concurrency=msg["max_concurrency"],
             executor=msg["executor"],
             runtime_env=msg["runtime_env"],
+            placement_pool=placement_pool,
         )
         with self._lock:
             self._hosted_actors[msg["actor_hex"]] = handle
@@ -1110,12 +1289,40 @@ class ClusterContext:
         self.agent_stats["queued"] += 1
         return "accepted"
 
+    def _admit_pool(self, msg: Dict[str, Any]):
+        """The pool a task leases from: its PG bundle's reserved pool
+        when dispatched into one, else this node's ledger. None when the
+        named bundle is gone (PG removed mid-flight)."""
+        bundle = msg.get("bundle")
+        if bundle is None:
+            return self._local_node.resources
+        with self._lock:
+            return self._hosted_bundles.get(tuple(bundle))
+
     def _try_admit(self, msg: Dict[str, Any]) -> bool:
-        """Acquire the task's resources on the node ledger and start it
-        on a pooled thread. False = ledger full right now."""
+        """Acquire the task's resources on its admission pool and start
+        it on a pooled thread. False = pool full right now."""
+        pool = self._admit_pool(msg)
+        if pool is None:
+            # bundle vanished: fail the task back to its owner
+            self._task_pool().submit(
+                lambda m=msg: self._reply_error(
+                    m,
+                    WorkerCrashedError(
+                        f"placement-group bundle {m['bundle']} is no longer "
+                        f"reserved on node {self.node_id.hex()[:12]}"
+                    ),
+                    "",
+                )
+            )
+            return True
         res = msg.get("resources") or {}
-        if not self._local_node.resources.try_acquire(res):
+        if not pool.try_acquire(res):
             return False
+        # remember WHICH pool granted the lease: the release must go back
+        # there even if the bundle is removed mid-task (its reconcile
+        # hook forwards late releases to the ledger)
+        msg["_pool"] = pool
         self._task_pool().submit(lambda m=msg: self._run_agent_task(m))
         return True
 
@@ -1142,8 +1349,10 @@ class ClusterContext:
         try:
             self._run_agent_task_inner(msg)
         finally:
-            # release fires on_release -> _drain_admission
-            self._local_node.resources.release(msg.get("resources") or {})
+            # release into the pool the lease came from; its on_release
+            # hook drains the admission queue (ledger) or reconciles a
+            # removed bundle's capacity back to the ledger
+            msg["_pool"].release(msg.get("resources") or {})
 
     def _run_agent_task_inner(self, msg: Dict[str, Any]) -> None:
         from .config import cfg

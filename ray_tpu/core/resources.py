@@ -34,6 +34,10 @@ class ResourceSet:
         # LOCAL task/actor freeing this node's ledger also admits queued
         # remote arrivals — not only remote completions.
         self.on_release = None
+        # A closed pool admits nothing new (a removed PG bundle: running
+        # work may still release into it, but restarts/new leases must
+        # fail instead of drawing from detached capacity).
+        self.closed = False
 
     @property
     def total(self) -> ResourceDict:
@@ -44,10 +48,14 @@ class ResourceSet:
             return dict(self._available)
 
     def can_ever_fit(self, request: ResourceDict) -> bool:
+        if self.closed:
+            return False
         return all(self._total.get(k, 0.0) + _EPS >= v for k, v in request.items())
 
     def try_acquire(self, request: ResourceDict) -> bool:
         with self._lock:
+            if self.closed:
+                return False
             if all(self._available.get(k, 0.0) + _EPS >= v for k, v in request.items()):
                 for k, v in request.items():
                     self._available[k] = self._available.get(k, 0.0) - v

@@ -398,6 +398,7 @@ class Runtime:
         lifetime: Optional[str] = None,
         executor: str = "thread",
         runtime_env: Any = None,
+        placement_pool: Any = None,
     ) -> "ActorHandle":
         from . import runtime_env as _renv
 
@@ -407,19 +408,21 @@ class Runtime:
                 "actor runtime_env requires executor='process' (thread "
                 "actors share the driver's process environment)"
             )
-        # Cluster placement: NodeAffinity to a remote node, or default
-        # spillover when only a remote node can satisfy the resources —
-        # the agent hosts the actor, this process keeps a proxy handle
+        # Cluster placement: NodeAffinity to a remote node, a placement
+        # group bundle reserved on one, or default spillover when only a
+        # remote node can satisfy the resources — the agent hosts the
+        # actor, this process keeps a proxy handle
         # (core/cluster.py RemoteActorProxy).
-        if self.cluster is not None:
+        if self.cluster is not None and placement_pool is None:
             res = dict(resources or {"CPU": 1.0})
-            node = self.cluster.can_place_actor_remotely(scheduling_strategy, res)
-            if node is not None:
+            placed = self.cluster.can_place_actor_remotely(scheduling_strategy, res)
+            if placed is not None:
+                node, pool, bundle = placed
                 actor_id, proxy = self.cluster.create_remote_actor(
                     node, cls, args, kwargs, resources=res,
                     max_restarts=max_restarts, max_concurrency=max_concurrency,
                     name=name, namespace=namespace, executor=executor,
-                    runtime_env=renv,
+                    runtime_env=renv, pool=pool, bundle=bundle,
                 )
                 handle = ActorHandle(actor_id, self)
                 if name:
@@ -468,6 +471,7 @@ class Runtime:
                 registered_namespace=namespace,
                 executor=executor,
                 runtime_env=renv,
+                placement_pool=placement_pool,
             )
         except BaseException:
             if name:

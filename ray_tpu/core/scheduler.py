@@ -330,6 +330,11 @@ class ClusterScheduler:
         # ships a task to a RemoteNode's agent. Never raises — completion
         # (including dispatch failure) flows back through finish_remote.
         self.remote_dispatcher: Optional[Callable] = None
+        # Cluster hooks for 2PC placement-group reservation at agents:
+        # reserver(pg_hex, bundles) -> None | error string (rolls back its
+        # own partial progress); releaser(pg_hex, bundles) best-effort.
+        self.remote_bundle_reserver: Optional[Callable] = None
+        self.remote_bundle_releaser: Optional[Callable] = None
         # task execution threads: dedicated per running task (blocking
         # get() can never deadlock) but REUSED across tasks
         self._task_threads = _ReusableThreadPool()
@@ -473,35 +478,67 @@ class ClusterScheduler:
     def create_placement_group(
         self, bundles: Sequence[ResourceDict], strategy: str = "PACK", name: str = ""
     ) -> PlacementGroup:
+        """Reserve a gang of bundles, cluster-wide.
+
+        Two-phase commit across node agents (reference:
+        gcs_placement_group_scheduler.h:288 PREPARE on every raylet via
+        LeaseStatusTracker, COMMIT only when all granted, rollback
+        otherwise): phase 1 acquires each bundle on this process's view
+        of its node under the scheduler lock; phase 2 asks every REMOTE
+        bundle's agent to reserve against its own ledger
+        (remote_bundle_reserver hook, core/cluster.py). An agent refusal
+        — another driver got there first — rolls the whole group back
+        and replans, so reservation stays all-or-nothing even between
+        drivers that cannot see each other's in-flight dispatches."""
         strat = PlacementStrategy(strategy)
-        pg = PlacementGroup(
-            PlacementGroupID.from_random(),
-            [Bundle(i, dict(r)) for i, r in enumerate(bundles)],
-            strat,
-            name,
-        )
-        with self._lock:
-            placement = self._plan_placement_locked(pg)
-            if placement is None:
-                raise PlacementGroupUnschedulableError(
-                    f"Cannot fit bundles {list(bundles)} with strategy {strategy} "
-                    f"on nodes {[n.resources.total for n in self._nodes.values()]}"
-                )
+        last_err = f"Cannot fit bundles {list(bundles)} with strategy {strategy}"
+        for _attempt in range(3):
+            pg = PlacementGroup(
+                PlacementGroupID.from_random(),
+                [Bundle(i, dict(r)) for i, r in enumerate(bundles)],
+                strat,
+                name,
+            )
             acquired: List[Tuple[Node, ResourceDict]] = []
-            for bundle, node in zip(pg.bundles, placement):
-                if not node.resources.try_acquire(bundle.resources):
-                    # Roll back earlier bundles: reservation is all-or-nothing
-                    # (the reference's 2-phase commit guarantees the same,
-                    # gcs_placement_group_scheduler.h:288).
-                    for prev_node, prev_res in acquired:
-                        prev_node.resources.release(prev_res)
-                    raise PlacementGroupUnschedulableError("concurrent reservation lost")
-                acquired.append((node, bundle.resources))
-                bundle.node = node
-                bundle.reserved = ResourceSet(bundle.resources)
-            self._placement_groups[pg.id] = pg
-        pg.created.set()
-        return pg
+            with self._lock:
+                placement = self._plan_placement_locked(pg)
+                if placement is None:
+                    raise PlacementGroupUnschedulableError(
+                        f"Cannot fit bundles {list(bundles)} with strategy "
+                        f"{strategy} on nodes "
+                        f"{[n.resources.total for n in self._nodes.values()]}"
+                    )
+                retry = False
+                for bundle, node in zip(pg.bundles, placement):
+                    if not node.resources.try_acquire(bundle.resources):
+                        for prev_node, prev_res in acquired:
+                            prev_node.resources.release(prev_res)
+                        acquired.clear()
+                        retry = True
+                        break
+                    acquired.append((node, bundle.resources))
+                    bundle.node = node
+                    bundle.reserved = ResourceSet(bundle.resources)
+                if retry:
+                    last_err = "concurrent reservation lost"
+                    continue
+            # Phase 2 (outside the lock: these are RPCs): prepare remote
+            # bundles at their agents. The hook reserves in order and
+            # rolls back its own partial progress on failure.
+            remote = [b for b in pg.bundles if b.node is not None and b.node.is_remote]
+            if remote and self.remote_bundle_reserver is not None:
+                err = self.remote_bundle_reserver(pg.id.hex(), remote)
+                if err is not None:
+                    with self._lock:
+                        for node, res in acquired:
+                            node.resources.release(res)
+                    last_err = err
+                    continue
+            with self._lock:
+                self._placement_groups[pg.id] = pg
+            pg.created.set()
+            return pg
+        raise PlacementGroupUnschedulableError(last_err)
 
     def _plan_placement_locked(self, pg: PlacementGroup) -> Optional[List[Node]]:
         nodes = [n for n in self._nodes.values() if n.alive]
@@ -564,6 +601,9 @@ class ClusterScheduler:
             for bundle in pg.bundles:
                 if bundle.node is not None:
                     bundle.node.resources.release(bundle.resources)
+        remote = [b for b in pg.bundles if b.node is not None and b.node.is_remote]
+        if remote and self.remote_bundle_releaser is not None:
+            self.remote_bundle_releaser(pg.id.hex(), remote)
 
     # ----------------------------------------------------------- dispatch loop
 
@@ -615,13 +655,31 @@ class ClusterScheduler:
             pg = strategy.placement_group
             idx = strategy.placement_group_bundle_index
             bundles = pg.bundles if idx < 0 else [pg.bundles[idx]]
+            live = []
             for bundle in bundles:
+                if bundle.node is not None and not bundle.node.alive:
+                    continue  # its host died; never dispatch into a void
                 if bundle.node is not None and bundle.node.is_remote and not remotable:
                     continue
+                live.append(bundle)
                 if bundle.reserved is not None and bundle.reserved.try_acquire(spec.resources):
                     target, pool = bundle.node, bundle.reserved
                     break
             if target is None:
+                if not live and any(
+                    b.node is not None and not b.node.alive for b in bundles
+                ):
+                    # every eligible bundle's host is dead — a rejoined
+                    # node gets a NEW identity, so this never heals
+                    # (bundle rescheduling on node death is a tracked gap)
+                    self._fail_returns(
+                        spec,
+                        OutOfResourcesError(
+                            f"Task {spec.name}: every placement-group bundle "
+                            f"it targets lost its host node"
+                        ),
+                    )
+                    return True
                 return False
         elif isinstance(strategy, NodeAffinitySchedulingStrategy):
             with self._lock:

@@ -29,5 +29,6 @@ from .session import (  # noqa: F401
     load_trial_checkpoint,
     report,
 )
+from .cluster_gang import ClusterWorkerGroup  # noqa: F401
 from .trainer import LMTrainer, Trainer  # noqa: F401
 from .worker_group import TrainWorker, WorkerGroup  # noqa: F401

