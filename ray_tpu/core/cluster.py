@@ -43,10 +43,16 @@ until every borrower's copy dies, and a borrower's get() pulls straight
 from the owner — the reference's borrowed-reference protocol
 (reference_count.h:72) without the Cython plumbing.
 
+Actors with max_restarts > 0 survive node death: the owner re-creates
+them on a surviving feasible node (RESTARTING → ALIVE, in-flight calls
+fail, queued calls resume, named directory repoints) — the reference's
+actor FSM (gcs_actor_manager.h:328) with owner-driven placement.
+
 Known gaps (tracked for later rounds): streaming generators are
-local-only; no cross-node actor restart; the borrow registration is
-async, so an owner that GCs within the in-flight window surfaces
-ObjectLostError at the borrower's get().
+local-only; PG bundles are not rescheduled after their host dies (tasks
+targeting them fail fast instead); the borrow registration is async, so
+an owner that GCs within the in-flight window surfaces ObjectLostError
+at the borrower's get().
 """
 
 from __future__ import annotations
@@ -146,10 +152,13 @@ class RemoteActorProxy:
     cross-process calls keep exactly the local actor ordering contract.
 
     Lifecycle: PENDING (creation in flight; calls buffer) → ALIVE
-    (calls stream) → DEAD (calls fail with ActorDiedError). An agent
-    death kills every proxy on that node; there is no cross-node actor
-    restart (documented cluster gap — agent-local restarts still apply
-    via max_restarts on the hosting runtime)."""
+    (calls stream) → DEAD (calls fail with ActorDiedError). With
+    max_restarts > 0, a hosting-node death instead transitions
+    ALIVE → RESTARTING → ALIVE: the owner re-creates the actor on a
+    surviving feasible node, in-flight calls fail (the reference
+    replays nothing either, gcs_actor_manager.h:328
+    REGISTERED→RESTARTING), queued calls wait and then flow to the new
+    incarnation, and the named-actor directory repoints."""
 
     def __init__(self, ctx: "ClusterContext", actor_id: ActorID, name: str):
         self.ctx = ctx
@@ -162,6 +171,11 @@ class RemoteActorProxy:
         # the pool the owner-side reservation was drawn from: the node's
         # resource view, or a PG bundle's reserved pool
         self.pool = None
+        # everything needed to re-create the actor elsewhere (set by
+        # create_remote_actor when the owner built this proxy; absent on
+        # lookup-built proxies, which therefore never restart)
+        self.creation: Optional[Dict[str, Any]] = None
+        self.restarts_used = 0
         # set when the owner registered a name for this actor; cleared
         # (and unregistered) on death so names never squat
         self.registered_name: Optional[str] = None
@@ -217,6 +231,15 @@ class RemoteActorProxy:
                 # shutdown sentinel: fail anything enqueued behind it
                 self._drain_queue_failed()
                 return
+            # a cross-node restart is in flight: queued calls WAIT for
+            # the new incarnation instead of failing (reference: the
+            # actor task submitter holds tasks while RESTARTING)
+            while True:
+                with self._lock:
+                    state = self.state
+                if state != "RESTARTING":
+                    break
+                time.sleep(0.02)
             with self._lock:
                 if self.state != "ALIVE":
                     self._fail_call(call, self.death_reason or "actor is dead")
@@ -226,10 +249,10 @@ class RemoteActorProxy:
             with self.ctx._lock:
                 self.ctx._actor_calls[call.task_hex] = self
             try:
-                # args resolve HERE (owner side, in submission order) so
-                # ObjectRef arguments ship by value like task dispatch
-                args = _resolve(call.args, self.ctx.runtime.object_store)
-                kwargs = _resolve(call.kwargs, self.ctx.runtime.object_store)
+                # small args resolve HERE (owner side, in submission
+                # order); big/remote ones ship as refs like task dispatch
+                args = self.ctx._ship_args(call.args)
+                kwargs = self.ctx._ship_args(call.kwargs)
                 blob = cloudpickle.dumps({
                     "actor_hex": self.actor_id.hex(),
                     "task_hex": call.task_hex,
@@ -248,13 +271,47 @@ class RemoteActorProxy:
                     self._inflight.pop(call.task_hex, None)
                 with self.ctx._lock:
                     self.ctx._actor_calls.pop(call.task_hex, None)
-                self.die(f"actor call transport failed: {exc!r}")
-                self._fail_call(call, self.death_reason)
+                if not self._restart_budget():
+                    self.die(f"actor call transport failed: {exc!r}")
+                    self._fail_call(call, self.death_reason)
+                    continue
+                if node is not None and not node.alive:
+                    # the node's death was already declared (possibly
+                    # before a restart repointed here): recover NOW —
+                    # no further heartbeat event will ever fire for it
+                    self._recover_or_die(call, exc)
+                    continue
+                # Node still looks alive. Probe whether the agent still
+                # hosts the actor: a healthy node that lost it (agent
+                # state wiped) would otherwise zombie forever — each call
+                # failing while no heartbeat staleness ever triggers the
+                # restart.
+                probe = None
+                try:
+                    probe = node.client.call(
+                        "actor_state", self.actor_id.hex()
+                    )
+                except Exception:
+                    probe = None  # unreachable: heartbeats will decide
+                if probe == "DEAD":
+                    self._recover_or_die(call, exc)
+                else:
+                    # transient transport blip (or node death pending
+                    # heartbeat confirmation): fail only this call
+                    self._fail_call(
+                        call, f"actor call transport failed: {exc!r}"
+                    )
             except BaseException as exc:  # serialization errors: this call only
                 with self._lock:
                     self._inflight.pop(call.task_hex, None)
                 with self.ctx._lock:
                     self.ctx._actor_calls.pop(call.task_hex, None)
+                if isinstance(exc, KeyError) and "no hosted actor" in str(exc):
+                    # the agent answered but no longer hosts the actor
+                    # (its state was wiped, e.g. an agent restart):
+                    # recover instead of failing call-by-call forever
+                    self._recover_or_die(call, exc)
+                    continue
                 for oid in call.return_ids:
                     self.ctx.runtime.object_store.seal_error(oid, exc)
 
@@ -267,9 +324,77 @@ class RemoteActorProxy:
 
     def mark_alive(self, node: RemoteNode) -> None:
         with self._lock:
-            self.node = node
+            # only a PENDING proxy takes the creation worker's node: a
+            # restart that won the race already repointed elsewhere, and
+            # overwriting with the (possibly dead) original would undo it
             if self.state == "PENDING":
+                self.node = node
                 self.state = "ALIVE"
+        self._created.set()
+
+    def _restart_budget(self) -> bool:
+        c = self.creation
+        return (
+            c is not None and c["bundle"] is None
+            and self.restarts_used < c["max_restarts"]
+        )
+
+    def _recover_or_die(self, call: "_RemoteActorCall", exc) -> None:
+        """The hosting side can no longer serve this actor (node declared
+        dead, or a healthy agent that lost it): restart when budgeted,
+        else die. The triggering call fails either way (no replay)."""
+        why = f"actor lost: {exc!r}"
+        if self._restart_budget() and self.begin_restart(why):
+            self.restarts_used += 1
+            threading.Thread(
+                target=self.ctx._restart_proxy, args=(self, why),
+                daemon=True,
+                name=f"ray_tpu-ractor-restart-{self.actor_id.hex()[:8]}",
+            ).start()
+            self._fail_call(call, why)
+        elif self.state == "RESTARTING":
+            self._fail_call(call, why)  # another path owns the restart
+        else:
+            self.die(why)
+            self._fail_call(call, self.death_reason)
+
+    def begin_restart(self, reason: str) -> bool:
+        """ALIVE/PENDING → RESTARTING: fail in-flight calls (no replay),
+        release the old reservation, hold queued calls. False if the
+        actor is already dead OR a restart is already in flight (two
+        triggers — node-death scan and a failed call — must not spawn
+        two incarnations)."""
+        with self._lock:
+            if self.state in ("DEAD", "RESTARTING"):
+                return False
+            self.state = "RESTARTING"
+            inflight = list(self._inflight.values())
+            self._inflight.clear()
+            pool, resources = self.pool, self.resources
+            self.pool = None
+            self.resources = {}
+        with self.ctx._lock:
+            for call in inflight:
+                self.ctx._actor_calls.pop(call.task_hex, None)
+        for call in inflight:
+            self._fail_call(call, reason)
+        if pool is not None and resources:
+            pool.release(resources)
+        return True
+
+    def complete_restart(self, node: RemoteNode, pool, resources) -> None:
+        with self._lock:
+            if self.state != "RESTARTING":
+                # killed while restarting: the acquisition is ours to undo
+                if resources:
+                    pool.release(resources)
+                return
+            self.node = node
+            self.pool = pool
+            self.resources = dict(resources)
+            self.state = "ALIVE"
+        # a restart may beat the original creation worker (node died
+        # mid-create): the sender must not stay parked on _created
         self._created.set()
 
     def die(self, reason: str) -> None:
@@ -283,6 +408,7 @@ class RemoteActorProxy:
             self._inflight.clear()
             pool, resources = self.pool, self.resources
             self.resources = {}
+            self.creation = None  # drop the pinned creation payload
         self._created.set()  # unblock the sender so it can drain/fail
         with self.ctx._lock:
             for call in inflight:
@@ -545,14 +671,28 @@ class ClusterContext:
                 ),
                 system_failure=True,
             )
-        # remote actors hosted there die with it (no cross-node restart)
+        # Remote actors hosted there: restart elsewhere when budgeted
+        # (reference actor FSM: ALIVE→RESTARTING→ALIVE,
+        # gcs_actor_manager.h:328), else die. PG-bundle actors die with
+        # their bundle — the reservation was on the dead node.
         with self._lock:
             proxies = [
                 p for p in self.remote_actors.values()
                 if p.node is not None and p.node.node_id.hex() == node_hex
             ]
         for proxy in proxies:
-            proxy.die(f"hosting node {node_hex[:12]} died: {reason}")
+            why = f"hosting node {node_hex[:12]} died: {reason}"
+            if proxy._restart_budget():
+                if proxy.begin_restart(why):
+                    proxy.restarts_used += 1
+                    threading.Thread(
+                        target=self._restart_proxy, args=(proxy, why),
+                        daemon=True,
+                        name=f"ray_tpu-ractor-restart-{proxy.actor_id.hex()[:8]}",
+                    ).start()
+                # else: a restart is already in flight — leave it alone
+            else:
+                proxy.die(why)
         # its borrows will never be unregistered: release them here so a
         # crashed agent cannot pin our values forever
         released = self.runtime.object_store.release_borrows_from(node.agent_addr)
@@ -577,6 +717,40 @@ class ClusterContext:
 
     # -------------------------------------------------- driver-side dispatch
 
+    def _ship_args(self, container):
+        """Prepare task/actor-call args for the wire. SMALL sealed values
+        resolve here and ship inline; big or REMOTE-located values ship
+        as the ObjectRef itself — the executing agent pulls them over the
+        chunked transfer plane (from the peer that actually holds them,
+        when known) and registers as a borrower for the duration. The
+        owner never materializes bytes it doesn't hold (reference:
+        dependency_resolver.h:32 inlines only small objects;
+        pull_manager.h:57 pulls the rest at the executing raylet)."""
+        from .config import cfg
+        from .object_store import ObjectState, Tier
+        from .runtime import ObjectRef
+
+        store = self.runtime.object_store
+
+        def one(value):
+            if not isinstance(value, ObjectRef):
+                return value
+            entry = store.entry(value.object_id)
+            if (
+                entry is not None
+                and entry.event.is_set()
+                and entry.state == ObjectState.READY
+            ):
+                if entry.tier == Tier.REMOTE:
+                    return value  # lives elsewhere: peer-to-peer pull
+                if entry.nbytes > cfg.remote_inline_max_bytes:
+                    return value  # big: agent pulls from us, chunked
+            return store.get(value.object_id)
+
+        if isinstance(container, tuple):
+            return tuple(one(v) for v in container)
+        return {k: one(v) for k, v in container.items()}
+
     def _dispatch(self, spec: TaskSpec, node: RemoteNode, pool) -> None:
         """Ship one task to a node agent (runs in a dispatch thread; the
         scheduler already acquired resources on its RemoteNode view).
@@ -587,11 +761,12 @@ class ClusterContext:
         with self._lock:
             self._pending[task_hex] = _PendingTask(spec, node, pool)
         try:
-            # ObjectRef args resolve HERE (the owner), possibly pulling
-            # remote values; the agent receives plain values. Dependencies
-            # are already sealed (the scheduler gates dispatch on them).
-            args = _resolve(spec.args, self.runtime.object_store)
-            kwargs = _resolve(spec.kwargs, self.runtime.object_store)
+            # Small ObjectRef args resolve HERE (the owner); big/remote
+            # ones ship as refs and the agent pulls (arg locality).
+            # Dependencies are already sealed (the scheduler gates
+            # dispatch on them).
+            args = self._ship_args(spec.args)
+            kwargs = self._ship_args(spec.kwargs)
             # A task scheduled into a placement-group bundle leases from
             # the agent's RESERVED bundle pool, not its ledger (the 2PC
             # grant already holds those resources there).
@@ -687,10 +862,13 @@ class ClusterContext:
                 spec, node, pool, error=error, error_tb=tb
             )
             return "ok"
-        for oid, (kind, addr) in zip(spec.return_ids, statuses or ()):
-            if kind == "remote":
-                self.runtime.object_store.seal_remote(oid, addr)
-            # kind == "pushed": the push RPC already sealed the value
+        for oid, status in zip(spec.return_ids, statuses or ()):
+            if status[0] == "remote":
+                self.runtime.object_store.seal_remote(
+                    oid, status[1],
+                    nbytes=status[2] if len(status) > 2 else 0,
+                )
+            # "pushed": the push RPC already sealed the value
         self.runtime.scheduler.finish_remote(spec, node, pool)
         return "ok"
 
@@ -956,6 +1134,26 @@ class ClusterContext:
         node = min(feasible, key=lambda n: n.utilization())
         return (node, node.resources, None)
 
+    @staticmethod
+    def _actor_blob(actor_hex, c, *, resources, bundle, max_restarts):
+        """One encoder for create_actor payloads: the original creation
+        and a cross-node restart must ship identical semantics."""
+        import cloudpickle
+
+        return cloudpickle.dumps({
+            "actor_hex": actor_hex,
+            "cls": c["cls"],
+            "args": c["args"],
+            "kwargs": c["kwargs"],
+            "resources": resources,
+            "bundle": bundle,
+            "max_restarts": max_restarts,
+            "max_concurrency": c["max_concurrency"],
+            "executor": c["executor"],
+            "runtime_env": c["runtime_env"],
+            "name": c["name"],
+        })
+
     def create_remote_actor(
         self, node: RemoteNode, cls, args, kwargs, *, resources,
         max_restarts, max_concurrency, name, namespace, executor,
@@ -969,6 +1167,17 @@ class ClusterContext:
         the (pg_hex, index) the agent should lease from."""
         actor_id = ActorID.of(self.runtime.job_id)
         proxy = RemoteActorProxy(self, actor_id, name or getattr(cls, "__name__", "Actor"))
+        if max_restarts != 0:
+            # only restart-budgeted actors pin their creation payload
+            # (cls/args can be large; a max_restarts=0 proxy never needs
+            # them again)
+            proxy.creation = {
+                "cls": cls, "args": args, "kwargs": kwargs,
+                "resources": dict(resources or {}),
+                "max_restarts": max_restarts, "max_concurrency": max_concurrency,
+                "name": name, "namespace": namespace, "executor": executor,
+                "runtime_env": runtime_env, "bundle": bundle,
+            }
         with self._lock:
             self.remote_actors[actor_id] = proxy
         threading.Thread(
@@ -1006,23 +1215,24 @@ class ClusterContext:
             proxy.pool = pool
             proxy.node = node
         try:
-            blob = cloudpickle.dumps({
-                "actor_hex": proxy.actor_id.hex(),
-                "cls": cls,
-                "args": args,
-                "kwargs": kwargs,
-                "resources": resources,
-                "bundle": bundle,
-                "max_restarts": max_restarts,
-                "max_concurrency": max_concurrency,
-                "executor": executor,
-                "runtime_env": runtime_env,
-                "name": name,
-            })
+            blob = self._actor_blob(
+                proxy.actor_id.hex(),
+                {"cls": cls, "args": args, "kwargs": kwargs,
+                 "max_concurrency": max_concurrency, "executor": executor,
+                 "runtime_env": runtime_env, "name": name},
+                resources=resources, bundle=bundle, max_restarts=max_restarts,
+            )
             reply = node.client.call("create_actor", blob)
             if reply != "ok":
                 raise RpcError(f"agent rejected actor creation: {reply!r}")
         except BaseException as exc:  # noqa: BLE001 - creation failure boundary
+            with proxy._lock:
+                restarting = proxy.state == "RESTARTING"
+            if restarting:
+                # the hosting node died mid-create and the restart path
+                # already owns recovery (it released our reservation in
+                # begin_restart); this failed original must not die() it
+                return
             proxy.die(f"remote actor creation failed: {exc!r}")
             return
         if proxy.state == "DEAD":
@@ -1046,6 +1256,71 @@ class ClusterContext:
             except (RpcError, OSError):
                 pass
         proxy.mark_alive(node)
+
+    def _restart_proxy(self, proxy: RemoteActorProxy, why: str) -> None:
+        """Re-create a restartable actor on a surviving feasible node.
+        The handle stays valid: queued calls resume against the NEW
+        incarnation (fresh state — the reference restarts from __init__
+        too); the named-actor directory repoints."""
+        c = proxy.creation
+        resources = dict(c["resources"])
+        deadline = time.monotonic() + 30.0
+        node = None
+        pool = None
+        while time.monotonic() < deadline:
+            with proxy._lock:
+                if proxy.state != "RESTARTING":
+                    return  # killed while we searched
+            with self._lock:
+                candidates = [
+                    n for n in self._remote_nodes.values()
+                    if n.alive and n.resources.can_ever_fit(resources)
+                ]
+            candidates.sort(key=lambda n: n.utilization())
+            for cand in candidates:
+                if cand.resources.try_acquire(resources):
+                    node, pool = cand, cand.resources
+                    break
+            if node is not None:
+                break
+            time.sleep(0.2)
+        if node is None:
+            proxy.die(f"{why}; no surviving node can host a restart")
+            return
+        try:
+            blob = self._actor_blob(
+                proxy.actor_id.hex(), c,
+                resources=resources, bundle=None,
+                max_restarts=c["max_restarts"] - proxy.restarts_used,
+            )
+            reply = node.client.call("create_actor", blob)
+            if reply != "ok":
+                raise RpcError(f"agent rejected actor restart: {reply!r}")
+        except BaseException as exc:  # noqa: BLE001 - restart failure boundary
+            pool.release(resources)
+            proxy.die(f"{why}; restart failed: {exc!r}")
+            return
+        if c["name"]:
+            try:
+                self.gcs.kv_put(
+                    f"{c['namespace']}/{c['name']}",
+                    {"node_hex": node.node_id.hex(),
+                     "actor_hex": proxy.actor_id.hex()},
+                    namespace=ACTOR_NS,
+                )
+            except (RpcError, OSError):
+                pass
+        logger.warning(
+            "actor %s restarted on node %s (%s)",
+            proxy.display_name, node.node_id.hex()[:12], why,
+        )
+        proxy.complete_restart(node, pool, resources)
+        if proxy.state == "DEAD":
+            # killed while the restart RPC was in flight: reap the orphan
+            try:
+                node.client.call("kill_actor", proxy.actor_id.hex())
+            except (RpcError, OSError):
+                pass
 
     def submit_remote_actor_call(self, proxy: RemoteActorProxy, method: str,
                                  args, kwargs, return_ids) -> None:
@@ -1090,9 +1365,12 @@ class ClusterContext:
             for oid in call.return_ids:
                 store.seal_error(oid, error)
             return "ok"
-        for oid, (kind, addr) in zip(call.return_ids, statuses or ()):
-            if kind == "remote":
-                store.seal_remote(oid, addr)
+        for oid, status in zip(call.return_ids, statuses or ()):
+            if status[0] == "remote":
+                store.seal_remote(
+                    oid, status[1],
+                    nbytes=status[2] if len(status) > 2 else 0,
+                )
             # "pushed" already sealed via the transfer plane
         return "ok"
 
@@ -1181,10 +1459,14 @@ class ClusterContext:
                 else:
                     oid = ObjectID(oid_hex)
                     store = self.runtime.object_store
-                    store.create(oid)
+                    entry = store.create(oid)
+                    entry.custodial = True  # held for the owner; only its
+                    # free_object (or node death) releases the value
                     store.seal(oid, value)
                     self.gcs.kv_put(oid_hex, self.address, namespace=OBJDIR_NS)
-                    statuses.append(("remote", self.address))
+                    statuses.append(
+                        ("remote", self.address, _estimate_nbytes(value))
+                    )
             reply.call("actor_task_done", task_hex, statuses, None)
 
         self._deliver_with_retry(
@@ -1360,6 +1642,11 @@ class ClusterContext:
 
         task_hex = msg["task_hex"]
         try:
+            # Args that shipped as refs (big/remote: arg locality) pull
+            # NOW, on the executing node, over the transfer plane — the
+            # borrow registered at unpickle time pins them at the owner.
+            task_args = _resolve(tuple(msg["args"]), self.runtime.object_store)
+            task_kwargs = _resolve(dict(msg["kwargs"]), self.runtime.object_store)
             renv = msg.get("runtime_env")
             if msg.get("executor") == "process":
                 from .worker_pool import get_worker_pool
@@ -1372,12 +1659,12 @@ class ClusterContext:
                         list(py_modules) + ([existing] if existing else [])
                     )
                 result = get_worker_pool().execute(
-                    msg["func"], msg["args"], msg["kwargs"], env_vars=env_vars,
+                    msg["func"], task_args, task_kwargs, env_vars=env_vars,
                     working_dir=(renv or {}).get("working_dir"),
                 )
             else:
                 with _renv.applied(renv):
-                    result = msg["func"](*msg["args"], **msg["kwargs"])
+                    result = msg["func"](*task_args, **task_kwargs)
             if msg["num_returns"] == 1:
                 values = [result]
             else:
@@ -1405,10 +1692,14 @@ class ClusterContext:
                     # big result: stays here; the owner pulls on get()
                     oid = ObjectID(oid_hex)
                     store = self.runtime.object_store
-                    store.create(oid)
+                    entry = store.create(oid)
+                    entry.custodial = True  # held for the owner; only its
+                    # free_object (or node death) releases the value
                     store.seal(oid, value)
                     self.gcs.kv_put(oid_hex, self.address, namespace=OBJDIR_NS)
-                    statuses.append(("remote", self.address))
+                    statuses.append(
+                        ("remote", self.address, _estimate_nbytes(value))
+                    )
             reply.call("task_done", task_hex, statuses, None)
 
         self._deliver_with_retry(
@@ -1419,19 +1710,22 @@ class ClusterContext:
     def _park_values(self, msg: Dict[str, Any], values: List[Any]) -> None:
         """Seal every return value into THIS node's store (any size) and
         record a parked completion the owner's poll loop can claim."""
+        from .object_store import _estimate_nbytes
+
         store = self.runtime.object_store
         statuses: List[Tuple[str, Any]] = []
         oids: List[ObjectID] = []
         for oid_hex, value in zip(msg["return_oids"], values):
             oid = ObjectID(oid_hex)
-            store.create(oid)
+            entry = store.create(oid)
+            entry.custodial = True  # held for the owner (parked)
             store.seal(oid, value)
             oids.append(oid)
             try:
                 self.gcs.kv_put(oid_hex, self.address, namespace=OBJDIR_NS)
             except (RpcError, OSError):
                 pass  # poll reply carries the address anyway
-            statuses.append(("remote", self.address))
+            statuses.append(("remote", self.address, _estimate_nbytes(value)))
         self._park(msg["task_hex"], statuses, None, oids)
 
     def _park(self, task_hex: str, statuses, error_blob, oids) -> None:

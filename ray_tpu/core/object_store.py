@@ -94,7 +94,8 @@ class ObjectEntry:
         "object_id", "state", "value", "error", "tier", "nbytes",
         "pin_count", "event", "callbacks", "spill_path", "owner_task",
         "last_access", "lock", "handle_count", "gc_on_seal", "remote_addr",
-        "foreign", "owner_addr", "gc_done", "borrow_failed",
+        "foreign", "owner_addr", "gc_done", "borrow_failed", "fetch_addr",
+        "custodial",
     )
 
     def __init__(self, object_id: ObjectID):
@@ -139,6 +140,15 @@ class ObjectEntry:
         # retry budget: a later loss is plausibly the borrow protocol's
         # fault, not the object's — surfaced in ObjectLostError's note.
         self.borrow_failed = False
+        # Where the VALUE physically lives, when that differs from the
+        # owner (arg locality: pull peer-to-peer, borrow at the owner).
+        self.fetch_addr: Optional[str] = None
+        # This store holds the value ON THE OWNER'S BEHALF (a parked /
+        # big task result awaiting pulls): local handle death must not
+        # release it — only the owner's free_object (or node teardown)
+        # may. Without this, a ref unpickled in the producing agent
+        # would free the primary copy when the task's args were GC'd.
+        self.custodial = False
 
 
 class ObjectStore:
@@ -390,11 +400,14 @@ class ObjectStore:
         # local_object_manager.h:112).
         self._maybe_spill()
 
-    def seal_remote(self, object_id: ObjectID, address: str) -> None:
+    def seal_remote(self, object_id: ObjectID, address: str,
+                    nbytes: int = 0) -> None:
         """Seal an object as a remote placeholder: the value stays in the
         store of the node at `address` (its ObjectTransferServer); get()
         fetches through on first access and caches locally. No-op if the
-        value already arrived (e.g. a push raced the location reply)."""
+        value already arrived (e.g. a push raced the location reply).
+        `nbytes` (when the producer reported it) feeds arg-locality
+        scheduling before the value is ever pulled."""
         with self._lock:
             entry = self._entries.get(object_id)
             if entry is None:
@@ -404,6 +417,8 @@ class ObjectStore:
                 return
             entry.value = address
             entry.remote_addr = address
+            if nbytes:
+                entry.nbytes = nbytes
             entry.tier = Tier.REMOTE
             entry.state = ObjectState.READY
             entry.gc_done = False
@@ -430,11 +445,24 @@ class ObjectStore:
         try:
             value = self._fetch_remote(entry.object_id, address)
         except Exception:
-            entry.value = None
-            entry.remote_addr = None  # owner unreachable: nothing to free
-            entry.state = ObjectState.LOST
-            entry.event.set()
-            raise _RemoteFetchFailed(entry.object_id, address)
+            # a peer-located pull can fall back to the owner, which can
+            # always materialize its own object (the slow path we tried
+            # to avoid, but correct)
+            fallback = entry.owner_addr
+            if not (fallback and fallback != address):
+                entry.value = None
+                entry.remote_addr = None  # owner unreachable: nothing to free
+                entry.state = ObjectState.LOST
+                entry.event.set()
+                raise _RemoteFetchFailed(entry.object_id, address)
+            try:
+                value = self._fetch_remote(entry.object_id, fallback)
+            except Exception:
+                entry.value = None
+                entry.remote_addr = None
+                entry.state = ObjectState.LOST
+                entry.event.set()
+                raise _RemoteFetchFailed(entry.object_id, fallback)
         nbytes = _estimate_nbytes(value)
         with self._lock:
             entry.value = value
@@ -511,8 +539,10 @@ class ObjectStore:
                 entry.foreign = True  # no local producer registered it
         deadline = None if timeout is None else time.monotonic() + timeout
         if entry.owner_addr is not None and not entry.event.is_set():
-            # borrowed ref: the owner IS the location — no directory RPC
-            self.seal_remote(object_id, entry.owner_addr)
+            # borrowed ref: pull from where the value lives (a peer node
+            # when the dispatcher knew better, else the owner) — no
+            # directory RPC either way
+            self.seal_remote(object_id, entry.fetch_addr or entry.owner_addr)
         if (
             self._locate is not None
             and entry.foreign
@@ -739,6 +769,17 @@ class ObjectStore:
                 return  # a handle was recreated (incref) since the decref
             if entry.gc_done:
                 return  # a concurrent last-releaser already ran
+            if entry.custodial:
+                # held for the OWNER: the local handle's death releases
+                # only its borrow registration, never the value — the
+                # owner's free_object is the sole release path
+                if entry.owner_addr is not None and self._unborrow is not None:
+                    try:
+                        self._unborrow(entry.object_id, entry.owner_addr)
+                    except Exception:
+                        pass
+                    entry.owner_addr = None
+                return
             entry.gc_done = True
             self._release_value(entry)
             self.stats["gc"] += 1

@@ -72,13 +72,30 @@ class ObjectRef:
         # borrower protocol, reference_count.h:72).
         ctx = getattr(self._runtime, "cluster", None)
         if ctx is not None:
+            from .object_store import Tier
+
             entry = self._runtime.object_store.entry(self.object_id)
             owner = (
                 entry.owner_addr
                 if entry is not None and entry.owner_addr  # chained borrow
                 else ctx.address
             )
-            return (_rebind_cluster_ref, (self.object_id.hex(), owner))
+            # Arg locality (reference: pull_manager.h:57 pulls from any
+            # holder): when the value physically lives on ANOTHER node
+            # (REMOTE placeholder), ship that location so the receiver
+            # pulls peer-to-peer instead of routing the bytes through
+            # the owner (which would materialize a value it never needed).
+            location = None
+            if (
+                entry is not None
+                and entry.tier == Tier.REMOTE
+                and isinstance(entry.value, str)
+            ):
+                location = entry.value
+            return (
+                _rebind_cluster_ref,
+                (self.object_id.hex(), owner, location),
+            )
         return (_rebind_object_ref, (self.object_id.hex(),))
 
 
@@ -87,7 +104,8 @@ def _rebind_object_ref(hex_id: str) -> "ObjectRef":
     return ObjectRef(ObjectID(hex_id), rt)
 
 
-def _rebind_cluster_ref(hex_id: str, owner_addr: str) -> "ObjectRef":
+def _rebind_cluster_ref(hex_id: str, owner_addr: str,
+                        location: "Optional[str]" = None) -> "ObjectRef":
     rt = get_runtime()
     oid = ObjectID(hex_id)
     ctx = rt.cluster
@@ -103,6 +121,10 @@ def _rebind_cluster_ref(hex_id: str, owner_addr: str) -> "ObjectRef":
         # ref still lives. One borrow per (process, object).
         if entry.owner_addr is None:
             entry.owner_addr = owner_addr
+            # pull from where the bytes ARE (maybe a peer node), while
+            # the borrow protocol still runs against the owner
+            if location and location != ctx.address:
+                entry.fetch_addr = location
             ctx.enqueue_borrow(oid, owner_addr)
     return ObjectRef(oid, rt)
 

@@ -798,6 +798,31 @@ class ClusterScheduler:
             ]
         return nodes
 
+    def _arg_locality(self, spec: TaskSpec, nodes: List[Node]) -> Dict[NodeID, int]:
+        """Bytes of the task's REMOTE-located args per candidate node
+        (reference: the hybrid policy's locality-aware scheduling pulls
+        toward nodes already holding large dependencies)."""
+        from .object_store import Tier
+        from .runtime import ObjectRef
+
+        scores: Dict[NodeID, int] = {}
+        for value in itertools.chain(spec.args, spec.kwargs.values()):
+            if not isinstance(value, ObjectRef):
+                continue
+            entry = self._store.entry(value.object_id)
+            if (
+                entry is None
+                or entry.tier != Tier.REMOTE
+                or not isinstance(entry.value, str)
+            ):
+                continue
+            for node in nodes:
+                if getattr(node, "agent_addr", None) == entry.value:
+                    scores[node.node_id] = (
+                        scores.get(node.node_id, 0) + max(entry.nbytes, 1)
+                    )
+        return scores
+
     def _pick_node(self, spec: TaskSpec) -> Optional[Node]:
         import random
 
@@ -817,6 +842,12 @@ class ClusterScheduler:
             feasible = preferred or feasible
         if strategy == "SPREAD":
             return min(feasible, key=lambda n: n.utilization())
+        # Arg locality first: a feasible node already holding the task's
+        # large remote args wins (the pull it saves usually dwarfs any
+        # packing gain).
+        locality = self._arg_locality(spec, feasible)
+        if locality:
+            return max(feasible, key=lambda n: locality.get(n.node_id, 0))
         # Hybrid: pack onto busy-but-below-threshold nodes first, else
         # spread to the emptiest — randomized among the top-k candidates.
         below = [n for n in feasible if n.utilization() < self.HYBRID_THRESHOLD]

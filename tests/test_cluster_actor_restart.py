@@ -1,0 +1,161 @@
+"""Cross-node actor restart (round-4 verdict #3): a heartbeat-confirmed
+node death re-creates max_restarts>0 actors on a surviving feasible
+node — DEAD→RESTARTING→ALIVE with the handle staying valid — while
+max_restarts=0 actors die cleanly and in-flight calls fail (the
+reference replays nothing either: gcs_actor_manager.h:328).
+"""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.core.exceptions import ActorDiedError
+from ray_tpu.core.scheduler import NodeAffinitySchedulingStrategy
+
+
+@pytest.fixture
+def cluster():
+    c = Cluster(
+        head_node_args={
+            "num_cpus": 1,
+            "_system_config": {"node_stale_s": 2.5, "node_heartbeat_s": 0.2},
+        }
+    )
+    c.add_node(num_cpus=2, resources={"slot": 1},
+               system_config={"node_heartbeat_s": 0.2})
+    c.add_node(num_cpus=2, resources={"slot": 1},
+               system_config={"node_heartbeat_s": 0.2})
+    c.wait_for_nodes(3)
+    yield c
+    c.shutdown()
+    from ray_tpu.core.config import cfg
+
+    cfg.reset()
+
+
+def _agent_handle_for(cluster, node):
+    """The NodeHandle of the subprocess backing a RemoteNode."""
+    recs = cluster.runtime.cluster.nodes()
+    pid = next(
+        rec["pid"] for rec in recs if rec["node_id"] == node.node_id.hex()
+    )
+    return next(h for h in cluster._nodes if h.pid == pid)
+
+
+def test_actor_restarts_on_surviving_node(cluster):
+    """Kill the hosting agent: the next .remote() call succeeds on
+    another node, with state rebuilt from __init__, and the named-actor
+    directory repoints."""
+    remote_nodes = [n for n in cluster.runtime.scheduler.nodes() if n.is_remote]
+
+    @ray_tpu.remote(num_cpus=1, resources={"slot": 1}, max_restarts=1)
+    class Survivor:
+        def __init__(self):
+            self.calls = 0
+
+        def bump(self):
+            self.calls += 1
+            return (os.getpid(), self.calls)
+
+    target = remote_nodes[0]
+    actor = Survivor.options(
+        name="survivor",
+        scheduling_strategy=NodeAffinitySchedulingStrategy(target.node_id),
+    ).remote()
+    pid1, calls = ray_tpu.get(actor.bump.remote(), timeout=60)
+    assert calls == 1
+
+    victim = _agent_handle_for(cluster, target)
+    cluster.remove_node(victim, allow_graceful=False)
+
+    # the handle keeps working: the call may land during RESTARTING (it
+    # queues) or after; either way it executes on the OTHER agent
+    deadline = time.monotonic() + 60
+    pid2 = None
+    while time.monotonic() < deadline:
+        try:
+            pid2, calls2 = ray_tpu.get(actor.bump.remote(), timeout=60)
+            break
+        except ActorDiedError:
+            # the death raced the restart transition; retry briefly
+            time.sleep(0.2)
+    assert pid2 is not None, "actor never came back"
+    assert pid2 != pid1
+    assert calls2 == 1, "restarted actor must rebuild from __init__"
+
+    # the survivor node hosts it now
+    live = {rec["pid"] for rec in cluster.runtime.cluster.nodes()}
+    assert pid2 in live
+
+    # named lookup resolves to the restarted incarnation
+    again = ray_tpu.get_actor("survivor")
+    pid3, _ = ray_tpu.get(again.bump.remote(), timeout=60)
+    assert pid3 == pid2
+
+
+def test_zero_restart_actor_dies_cleanly(cluster):
+    remote_nodes = [n for n in cluster.runtime.scheduler.nodes() if n.is_remote]
+
+    @ray_tpu.remote(num_cpus=1)
+    class Mortal:
+        def ping(self):
+            return os.getpid()
+
+    target = remote_nodes[1]
+    actor = Mortal.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(target.node_id)
+    ).remote()
+    ray_tpu.get(actor.ping.remote(), timeout=60)
+
+    victim = _agent_handle_for(cluster, target)
+    cluster.remove_node(victim, allow_graceful=False)
+
+    with pytest.raises(ActorDiedError):
+        # retries make no difference: max_restarts defaults to 0
+        deadline = time.monotonic() + 60
+        while True:
+            ray_tpu.get(actor.ping.remote(), timeout=60)
+            assert time.monotonic() < deadline
+            time.sleep(0.2)
+
+
+def test_inflight_call_fails_but_handle_survives(cluster):
+    """An in-flight call on the dying node fails (no replay), yet the
+    restarted actor serves subsequent calls."""
+    remote_nodes = [n for n in cluster.runtime.scheduler.nodes() if n.is_remote]
+
+    @ray_tpu.remote(num_cpus=1, resources={"slot": 1}, max_restarts=2)
+    class Slow:
+        def nap(self, s):
+            time.sleep(s)
+            return "rested"
+
+        def quick(self):
+            return os.getpid()
+
+    target = remote_nodes[0]
+    actor = Slow.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(target.node_id)
+    ).remote()
+    assert ray_tpu.get(actor.quick.remote(), timeout=60) != os.getpid()
+
+    pending = actor.nap.remote(30)
+    time.sleep(0.5)  # let it land on the agent
+    victim = _agent_handle_for(cluster, target)
+    cluster.remove_node(victim, allow_graceful=False)
+
+    with pytest.raises(ActorDiedError):
+        ray_tpu.get(pending, timeout=60)
+
+    deadline = time.monotonic() + 60
+    pid = None
+    while time.monotonic() < deadline:
+        try:
+            pid = ray_tpu.get(actor.quick.remote(), timeout=60)
+            break
+        except ActorDiedError:
+            time.sleep(0.2)
+    assert pid is not None and pid != os.getpid()

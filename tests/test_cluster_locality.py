@@ -1,0 +1,138 @@
+"""Ship-refs-pull-at-executor and arg-locality scheduling (round-4
+verdict #4). Reference: dependency_resolver.h:32 inlines only small
+args; pull_manager.h:57 pulls large ones at the executing raylet; the
+hybrid policy prefers nodes already holding the dependencies.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.core.object_store import Tier
+from ray_tpu.core.scheduler import NodeAffinitySchedulingStrategy
+
+
+@pytest.fixture
+def cluster():
+    c = Cluster(
+        head_node_args={
+            "num_cpus": 1,
+            "_system_config": {"node_stale_s": 5.0, "node_heartbeat_s": 0.2},
+        }
+    )
+    c.add_node(num_cpus=2, system_config={"node_heartbeat_s": 0.2})
+    c.add_node(num_cpus=2, system_config={"node_heartbeat_s": 0.2})
+    c.wait_for_nodes(3)
+    yield c
+    c.shutdown()
+    from ray_tpu.core.config import cfg
+
+    cfg.reset()
+
+
+def _remote_nodes(cluster):
+    return [n for n in cluster.runtime.scheduler.nodes() if n.is_remote]
+
+
+def _pid_of(cluster, node):
+    return next(
+        rec["pid"] for rec in cluster.runtime.cluster.nodes()
+        if rec["node_id"] == node.node_id.hex()
+    )
+
+
+def test_peer_to_peer_arg_transfer_owner_never_materializes(cluster):
+    """A big result living on agent A, passed to a task pinned to agent
+    B: B pulls the bytes (necessarily from A — the owner never held
+    them), and the owner's entry STAYS a remote placeholder, proving
+    the bytes did not route through the owner."""
+    nodes = _remote_nodes(cluster)
+    a, b = nodes[0], nodes[1]
+
+    @ray_tpu.remote(num_cpus=1)
+    def produce():
+        return np.arange(2_000_000, dtype=np.float64)  # 16 MB
+
+    @ray_tpu.remote(num_cpus=1)
+    def consume(arr):
+        return float(arr[1_234_567])
+
+    ref = produce.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(a.node_id)
+    ).remote()
+    # wait until the result is sealed (REMOTE placeholder at the owner)
+    store = cluster.runtime.object_store
+    deadline = time.monotonic() + 60
+    while not store.is_ready(ref.object_id) and time.monotonic() < deadline:
+        time.sleep(0.02)
+    entry = store.entry(ref.object_id)
+    assert entry.tier == Tier.REMOTE
+    assert entry.nbytes == 16_000_000  # producer reported the size
+
+    out = ray_tpu.get(
+        consume.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(b.node_id)
+        ).remote(ref),
+        timeout=120,
+    )
+    assert out == 1_234_567.0
+    # the owner never fetched the value through its own store: the
+    # placeholder is untouched (a pull routed through the owner would
+    # have materialized it here)
+    assert store.entry(ref.object_id).tier == Tier.REMOTE
+
+
+def test_big_local_arg_ships_as_ref_and_resolves_on_agent(cluster):
+    """An owner-held arg above remote_inline_max_bytes ships as a ref;
+    the agent pulls it over the chunked plane and the task sees the
+    value."""
+    nodes = _remote_nodes(cluster)
+    big = ray_tpu.put(np.ones(1_500_000, dtype=np.float64))  # 12 MB
+
+    @ray_tpu.remote(num_cpus=1)
+    def total(arr):
+        return float(arr.sum())
+
+    out = ray_tpu.get(
+        total.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(nodes[0].node_id)
+        ).remote(big),
+        timeout=120,
+    )
+    assert out == 1_500_000.0
+
+
+def test_default_strategy_prefers_arg_locality(cluster):
+    """With free node choice, a task consuming a big remote-located arg
+    lands on the node already holding it."""
+    nodes = _remote_nodes(cluster)
+    a = nodes[0]
+    a_pid = _pid_of(cluster, a)
+
+    @ray_tpu.remote(num_cpus=1)
+    def produce():
+        return np.arange(2_000_000, dtype=np.float64)
+
+    @ray_tpu.remote(num_cpus=1)
+    def consume(arr):
+        return os.getpid()
+
+    ref = produce.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(a.node_id)
+    ).remote()
+    store = cluster.runtime.object_store
+    deadline = time.monotonic() + 60
+    while not store.is_ready(ref.object_id) and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert store.entry(ref.object_id).tier == Tier.REMOTE
+
+    # run several times SEQUENTIALLY (so A always has a free slot):
+    # locality must consistently pick A over the equally-idle B/head
+    pids = [
+        ray_tpu.get(consume.remote(ref), timeout=120) for _ in range(4)
+    ]
+    assert all(p == a_pid for p in pids), (pids, a_pid)
