@@ -33,7 +33,14 @@ def main() -> None:
                     help="tensor-parallel degree: shard the engine over a "
                          "tp mesh of this many devices (1 = single device)")
     ap.add_argument("--model", default="gpt2-small")
+    ap.add_argument("--openai", action="store_true",
+                    help="drive the workload through the OpenAI-compatible "
+                         "HTTP endpoint (/v1/completions) instead of the "
+                         "engine API")
     args = ap.parse_args()
+    if args.openai:
+        bench_openai(args)
+        return
 
     from ray_tpu.models import get_config, init_params
     from ray_tpu.serve.llm.paged import PagedConfig
@@ -107,6 +114,71 @@ def main() -> None:
         )
     finally:
         engine.shutdown()
+
+
+def bench_openai(args) -> None:
+    """Same burst, driven through the OpenAI HTTP surface: measures the
+    full ingress path (HTTP + schema translation + serve routing +
+    engine). TTFT is not observable per-request without SSE timing, so
+    this reports req/s and decode tok/s through the endpoint."""
+    import threading
+    import urllib.request
+
+    import ray_tpu
+    from ray_tpu import serve as serve_mod
+    from ray_tpu.serve.llm import serve_openai
+
+    ray_tpu.init(detect_accelerators=True)
+    frontend = serve_openai(
+        model=args.model, paged=True, max_slots=8, tensor_parallel=args.tp
+    )
+    url = f"http://127.0.0.1:{frontend.port}/v1/completions"
+    rng = np.random.default_rng(0)
+    vocab = 50257 if "gpt2" in args.model else 256
+
+    def post(i, results):
+        prompt = [int(t) for t in rng.integers(1, vocab, size=PROMPT_LEN)]
+        req = urllib.request.Request(
+            url,
+            data=json.dumps({
+                "model": args.model, "prompt": prompt,
+                "max_tokens": MAX_TOKENS, "temperature": 0.0,
+            }).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=600) as r:
+            results[i] = json.loads(r.read())
+
+    try:
+        results: dict = {}
+        post(-1, results)  # warmup compiles
+        threads = []
+        t0 = time.perf_counter()
+        for i in range(N_REQUESTS):
+            t = threading.Thread(target=post, args=(i, results))
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join(timeout=600)
+        elapsed = time.perf_counter() - t0
+        done = [results[i] for i in range(N_REQUESTS) if i in results]
+        assert len(done) == N_REQUESTS, f"only {len(done)} completed"
+        assert all(
+            r["usage"]["completion_tokens"] == MAX_TOKENS for r in done
+        )
+        print(json.dumps({
+            "metric": "gpt2_124m_openai_http_req_per_s",
+            "value": round(N_REQUESTS / elapsed, 2),
+            "unit": "req/s",
+            "vs_baseline": 0.0,
+            "decode_tokens_per_s": round(N_REQUESTS * MAX_TOKENS / elapsed, 1),
+            "device_kind": getattr(jax.devices()[0], "device_kind", "unknown"),
+            "tp": args.tp,
+        }))
+    finally:
+        frontend.stop()
+        serve_mod.shutdown()
+        ray_tpu.shutdown()
 
 
 if __name__ == "__main__":

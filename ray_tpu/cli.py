@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -50,6 +51,20 @@ def _cmd_start(args) -> int:
     if bool(args.head) == bool(args.address):
         print("pass exactly one of --head or --address", file=sys.stderr)
         return 2
+    if args.snapshot_path:
+        from .core.config import cfg
+
+        cfg.set(gcs_snapshot_path=args.snapshot_path)
+    if args.restore:
+        from .core.config import cfg
+
+        path = cfg.gcs_snapshot_path
+        if not args.head:
+            print("--restore only applies to --head", file=sys.stderr)
+            return 2
+        if not path or not os.path.exists(path):
+            print(f"--restore: no snapshot at {path!r}", file=sys.stderr)
+            return 2
     rt = ray_tpu.init(
         num_cpus=args.num_cpus,
         resources=json.loads(args.resources) if args.resources else None,
@@ -179,6 +194,13 @@ def build_parser() -> argparse.ArgumentParser:
                     help='node labels as JSON, e.g. \'{"zone": "us-a"}\'')
     st.add_argument("--token", default=None,
                     help="cluster auth token (required off-localhost)")
+    st.add_argument("--snapshot-path", default=None,
+                    help="GCS snapshot file: the head persists its tables "
+                         "here (same as RAY_TPU_GCS_SNAPSHOT_PATH)")
+    st.add_argument("--restore", action="store_true",
+                    help="with --head: require + replay the snapshot at "
+                         "--snapshot-path so surviving agents re-register "
+                         "(reference: Redis-backed GCS restart)")
 
     jp = sub.add_parser("job", help="submit/inspect driver jobs")
     jsub = jp.add_subparsers(dest="job_cmd", required=True)

@@ -22,10 +22,13 @@ from .dataset import (  # noqa: F401
     from_items,
     from_numpy,
     range,
+    read_binary_files,
     read_csv,
+    read_images,
     read_json,
     read_npy,
     read_parquet,
     read_text,
+    read_tfrecord,
 )
 from .lm import lm_batch_iterator, pack_tokens  # noqa: F401

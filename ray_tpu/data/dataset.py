@@ -38,8 +38,10 @@ from .block import (
     block_to_items,
 )
 from .datasource import (
+    BinaryFilesSource,
     CsvSource,
     Datasource,
+    ImageDirSource,
     ItemsSource,
     JsonlSource,
     NpyFileSource,
@@ -47,6 +49,7 @@ from .datasource import (
     ParquetSource,
     RangeSource,
     TextSource,
+    TFRecordSource,
 )
 
 
@@ -539,6 +542,31 @@ def read_csv(paths) -> Dataset:
 def read_json(paths) -> Dataset:
     """Line-delimited JSON (one object per line ⇒ one row)."""
     return Dataset([_Op("read", source=JsonlSource(paths))])
+
+
+def read_tfrecord(paths, *, parse: bool = True) -> Dataset:
+    """TFRecord files; parse=True decodes tf.train.Example records into
+    columns via the built-in wire-format parser (no tensorflow/protobuf
+    runtime needed), parse=False yields raw record bytes."""
+    return Dataset([_Op("read", source=TFRecordSource(paths, parse=parse))])
+
+
+def read_images(paths, *, size=None, mode: str = "RGB",
+                images_per_block: int = 64) -> Dataset:
+    """Decode a directory/glob of images into 'image' + 'path' columns
+    (PIL-gated)."""
+    try:
+        import PIL  # noqa: F401
+    except ImportError as e:
+        raise ImportError("read_images requires Pillow") from e
+    return Dataset([_Op("read", source=ImageDirSource(
+        paths, size=size, mode=mode, images_per_block=images_per_block))])
+
+
+def read_binary_files(paths, *, files_per_block: int = 32) -> Dataset:
+    """Whole files as rows: 'bytes' + 'path' columns."""
+    return Dataset([_Op("read", source=BinaryFilesSource(
+        paths, files_per_block=files_per_block))])
 
 
 def from_generator(gen_fn: Callable[[], Iterator[Any]]) -> Dataset:

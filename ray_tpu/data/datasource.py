@@ -229,3 +229,261 @@ class JsonlSource(Datasource):
             return read
 
         return [make(p) for p in self.paths]
+
+
+# --------------------------------------------------------------- tfrecord
+
+def _read_uvarint(buf: bytes, pos: int):
+    result = 0
+    shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def _walk_fields(buf: bytes):
+    """Yield (field_number, wire_type, value) over a protobuf message.
+    Length-delimited values yield the raw bytes; varints the int."""
+    pos = 0
+    end = len(buf)
+    while pos < end:
+        tag, pos = _read_uvarint(buf, pos)
+        field, wire = tag >> 3, tag & 7
+        if wire == 0:  # varint
+            value, pos = _read_uvarint(buf, pos)
+        elif wire == 1:  # fixed64
+            value = buf[pos:pos + 8]
+            pos += 8
+        elif wire == 2:  # length-delimited
+            length, pos = _read_uvarint(buf, pos)
+            value = buf[pos:pos + length]
+            pos += length
+        elif wire == 5:  # fixed32
+            value = buf[pos:pos + 4]
+            pos += 4
+        else:
+            raise ValueError(f"unsupported protobuf wire type {wire}")
+        yield field, wire, value
+
+
+def _parse_example(data: bytes):
+    """Minimal tf.train.Example parser over the protobuf wire format —
+    no protobuf runtime needed (reference: the tfrecords datasource
+    parses Examples via tensorflow; this image has neither, so the ~60
+    lines of TLV walking live here). Schema: Example{1: Features},
+    Features{1: map<string, Feature>}, Feature{1: BytesList, 2:
+    FloatList, 3: Int64List}, each *List{1: repeated value} (floats
+    packed little-endian, ints packed varints)."""
+    features = {}
+    for field, _, value in _walk_fields(data):
+        if field != 1:
+            continue
+        for f2, _, entry in _walk_fields(value):  # map entries
+            if f2 != 1:
+                continue
+            key = None
+            feat = b""
+            for f3, _, v3 in _walk_fields(entry):
+                if f3 == 1:
+                    key = v3.decode("utf-8")
+                elif f3 == 2:
+                    feat = v3
+            if key is None:
+                continue
+            for f4, wire4, v4 in _walk_fields(feat):  # the oneof list
+                if f4 == 1:  # BytesList
+                    vals = [v for f5, _, v in _walk_fields(v4) if f5 == 1]
+                    features[key] = vals
+                elif f4 == 2:  # FloatList
+                    floats: List[float] = []
+                    for f5, w5, v5 in _walk_fields(v4):
+                        if f5 != 1:
+                            continue
+                        if w5 == 2:  # packed
+                            floats.extend(
+                                np.frombuffer(v5, dtype="<f4").tolist()
+                            )
+                        else:  # unpacked fixed32
+                            floats.append(
+                                float(np.frombuffer(v5, dtype="<f4")[0])
+                            )
+                    features[key] = np.asarray(floats, dtype=np.float32)
+                elif f4 == 3:  # Int64List
+                    def _signed(n: int) -> int:
+                        # protobuf int64 varints are two's-complement in
+                        # 64 bits: fold the unsigned decode back down so
+                        # negative labels/offsets round-trip
+                        return n - (1 << 64) if n >= (1 << 63) else n
+
+                    ints: List[int] = []
+                    for f5, w5, v5 in _walk_fields(v4):
+                        if f5 != 1:
+                            continue
+                        if w5 == 2:  # packed varints
+                            p = 0
+                            while p < len(v5):
+                                n, p = _read_uvarint(v5, p)
+                                ints.append(_signed(n))
+                        else:
+                            ints.append(_signed(v5))
+                    features[key] = np.asarray(ints, dtype=np.int64)
+    return features
+
+
+def _tfrecord_records(path: str):
+    """Iterate raw record payloads of one TFRecord file: 8-byte LE
+    length | 4-byte length crc | payload | 4-byte payload crc. CRCs are
+    crc32c; they are skipped rather than verified (no crc32c in the
+    stdlib — truncation still surfaces as a short read)."""
+    import struct
+
+    with open(path, "rb") as f:
+        while True:
+            header = f.read(12)
+            if len(header) < 12:
+                return
+            (length,) = struct.unpack("<Q", header[:8])
+            payload = f.read(length)
+            if len(payload) < length:
+                raise ValueError(f"truncated TFRecord in {path}")
+            f.read(4)  # payload crc
+            yield payload
+
+
+class TFRecordSource(Datasource):
+    """One block per TFRecord file (reference
+    _internal/datasource/tfrecords_datasource.py). parse=True decodes
+    tf.train.Example records into columns; parse=False yields raw
+    payload bytes in a 'bytes' column."""
+
+    def __init__(self, paths: Sequence[str], parse: bool = True):
+        self.paths = _expand(paths)
+        self.parse = parse
+
+    def read_tasks(self) -> List[ReadTask]:
+        parse = self.parse
+
+        def make(path: str) -> ReadTask:
+            def read() -> Block:
+                records = list(_tfrecord_records(path))
+                if not parse:
+                    return {"bytes": np.asarray(records, dtype=object)}
+                rows = [_parse_example(r) for r in records]
+                names: List[str] = []
+                for r in rows:
+                    for k in r:
+                        if k not in names:
+                            names.append(k)
+                block: Block = {}
+                for name in names:
+                    col = [r.get(name) for r in rows]
+                    scalars = [
+                        v[0] if v is not None and len(v) == 1 else v
+                        for v in col
+                    ]
+                    try:
+                        block[name] = np.asarray(scalars)
+                    except Exception:
+                        block[name] = np.asarray(scalars, dtype=object)
+                return block
+
+            return read
+
+        return [make(p) for p in self.paths]
+
+
+class ImageDirSource(Datasource):
+    """Decode a directory (or glob) of images: columns 'image' (HWC
+    uint8) and 'path'; `size` center-resizes so blocks stack densely
+    (reference _internal/datasource/image_datasource.py). PIL-gated."""
+
+    def __init__(self, paths: Sequence[str], size=None, mode: str = "RGB",
+                 images_per_block: int = 64):
+        exts = (".png", ".jpg", ".jpeg", ".bmp", ".gif", ".webp")
+        self.paths = [
+            p for p in _expand(paths) if p.lower().endswith(exts)
+        ]
+        if not self.paths:
+            raise FileNotFoundError(f"no image files under {paths!r}")
+        self.size = size
+        self.mode = mode
+        self.images_per_block = images_per_block
+
+    def read_tasks(self) -> List[ReadTask]:
+        size, mode = self.size, self.mode
+        groups = [
+            self.paths[i:i + self.images_per_block]
+            for i in range(0, len(self.paths), self.images_per_block)
+        ]
+
+        def make(group: List[str]) -> ReadTask:
+            def read() -> Block:
+                from PIL import Image  # gated import
+
+                images = []
+                for p in group:
+                    with Image.open(p) as im:
+                        im = im.convert(mode)
+                        if size is not None:
+                            im = im.resize(size)
+                        images.append(np.asarray(im))
+                stackable = size is not None or len(
+                    {im.shape for im in images}
+                ) == 1
+                if stackable:
+                    col = np.stack(images)
+                else:
+                    # elementwise assign: np.asarray(..., dtype=object)
+                    # raises on partially-aligned shapes (same height,
+                    # different widths)
+                    col = np.empty(len(images), dtype=object)
+                    for i, im in enumerate(images):
+                        col[i] = im
+                return {
+                    "image": col,
+                    "path": np.asarray(group, dtype=object),
+                }
+
+            return read
+
+        return [make(g) for g in groups]
+
+    def estimated_num_rows(self) -> Optional[int]:
+        return len(self.paths)
+
+
+class BinaryFilesSource(Datasource):
+    """Whole files as rows: columns 'bytes' and 'path' (reference
+    _internal/datasource/binary_datasource.py)."""
+
+    def __init__(self, paths: Sequence[str], files_per_block: int = 32):
+        self.paths = _expand(paths)
+        self.files_per_block = files_per_block
+
+    def read_tasks(self) -> List[ReadTask]:
+        groups = [
+            self.paths[i:i + self.files_per_block]
+            for i in range(0, len(self.paths), self.files_per_block)
+        ]
+
+        def make(group: List[str]) -> ReadTask:
+            def read() -> Block:
+                blobs = []
+                for p in group:
+                    with open(p, "rb") as f:
+                        blobs.append(f.read())
+                return {
+                    "bytes": np.asarray(blobs, dtype=object),
+                    "path": np.asarray(group, dtype=object),
+                }
+
+            return read
+
+        return [make(g) for g in groups]
+
+    def estimated_num_rows(self) -> Optional[int]:
+        return len(self.paths)

@@ -65,6 +65,28 @@ def shutdown() -> None:
 # ------------------------------------------------------------------ HTTP proxy
 
 
+class EgresslessHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer for zero-egress hosts: the default
+    server_bind calls socket.getfqdn() — a reverse-DNS lookup that
+    hangs without egress. Shared by the serve proxy and the OpenAI
+    frontend."""
+
+    daemon_threads = True
+
+    def server_bind(self):
+        import socketserver
+
+        socketserver.TCPServer.server_bind(self)
+        self.server_name = self.server_address[0]
+        self.server_port = self.server_address[1]
+
+
+def write_chunk(wfile, data: bytes) -> None:
+    """One HTTP/1.1 chunked-transfer frame."""
+    wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+    wfile.flush()
+
+
 class _HttpProxy:
     def __init__(self, controller: ServeController, host: str, port: int):
         proxy = self
@@ -125,8 +147,7 @@ class _HttpProxy:
                 self.end_headers()
 
                 def chunk(data: bytes) -> None:
-                    self.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
-                    self.wfile.flush()
+                    write_chunk(self.wfile, data)
 
                 try:
                     for ref in stream:
@@ -139,19 +160,7 @@ class _HttpProxy:
             def log_message(self, *args):  # silence request logs
                 pass
 
-        class Server(ThreadingHTTPServer):
-            daemon_threads = True
-
-            def server_bind(self):
-                # default server_bind calls socket.getfqdn() — a reverse-DNS
-                # lookup that hangs in egress-less environments
-                import socketserver
-
-                socketserver.TCPServer.server_bind(self)
-                self.server_name = self.server_address[0]
-                self.server_port = self.server_address[1]
-
-        self.server = Server((host, port), Handler)
+        self.server = EgresslessHTTPServer((host, port), Handler)
         self.port = self.server.server_address[1]
         self.thread = threading.Thread(
             target=self.server.serve_forever, daemon=True, name="serve-http"

@@ -7,4 +7,10 @@ from .paged_engine import (  # noqa: F401
     PagedLLMEngine,
     serving_shardings,
 )
+from .openai import (  # noqa: F401
+    ByteTokenizer,
+    OpenAIFrontend,
+    build_openai_app,
+    serve_openai,
+)
 from .server import LLMServer, build_llm_app  # noqa: F401

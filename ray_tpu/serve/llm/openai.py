@@ -1,0 +1,304 @@
+"""OpenAI-compatible serving surface: /v1/completions,
+/v1/chat/completions (SSE streaming), /v1/models.
+
+Reference parity: build_openai_app
+(/root/reference/python/ray/llm/_internal/serve/ → serve/llm/__init__.py)
+which mounts an OpenAI-schema FastAPI app over LLMServer deployments.
+TPU-image inversion: zero egress means no tokenizer vocab files, so text
+is encoded with a built-in byte-level tokenizer (UTF-8 bytes = token ids
+< 256 — an exact fit for the *-tiny model family's vocab of 256; larger
+models accept OpenAI's token-array `prompt` form directly, which the
+real OpenAI API also supports). The HTTP layer is the same stdlib
+threaded server as serve's proxy — no ASGI dependency.
+
+Routing: the request's `model` field resolves to a serve deployment
+(one app per model), so multiple models can be mounted on one port,
+mirroring how build_openai_app routes by model id.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import uuid
+from http.server import BaseHTTPRequestHandler
+from typing import Any, Dict, List, Optional
+
+from .. import api as serve_api
+from ..api import EgresslessHTTPServer, write_chunk
+
+
+class ByteTokenizer:
+    """UTF-8 byte-level fallback tokenizer (token id == byte value)."""
+
+    @staticmethod
+    def encode(text: str) -> List[int]:
+        return list(text.encode("utf-8"))
+
+    @staticmethod
+    def decode(tokens: List[int]) -> str:
+        return bytes(t for t in tokens if 0 <= t < 256).decode(
+            "utf-8", errors="replace"
+        )
+
+
+def _chat_prompt(messages: List[Dict[str, str]]) -> str:
+    """Minimal chat template (the reference applies the model's own
+    template from its tokenizer config; none ships in this image)."""
+    lines = [f"{m.get('role', 'user')}: {m.get('content', '')}"
+             for m in messages]
+    lines.append("assistant:")
+    return "\n".join(lines)
+
+
+class OpenAIFrontend:
+    """HTTP frontend translating the OpenAI schema onto LLMServer
+    deployment handles. `models` maps a model id (the request's `model`
+    field) to a serve deployment name hosting it."""
+
+    def __init__(self, models: Dict[str, str], host: str = "127.0.0.1",
+                 port: int = 0):
+        self.models = dict(models)
+        self.created = int(time.time())
+        frontend = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args):
+                pass
+
+            def _json(self, code: int, payload: Dict[str, Any]) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _error(self, code: int, message: str, etype: str) -> None:
+                self._json(code, {"error": {
+                    "message": message, "type": etype, "param": None,
+                    "code": None,
+                }})
+
+            def do_GET(self):  # noqa: N802 - /v1/models
+                if self.path.rstrip("/") == "/v1/models":
+                    self._json(200, {
+                        "object": "list",
+                        "data": [
+                            {"id": mid, "object": "model",
+                             "created": frontend.created,
+                             "owned_by": "ray_tpu"}
+                            for mid in frontend.models
+                        ],
+                    })
+                else:
+                    self._error(404, f"no route {self.path}", "invalid_request_error")
+
+            def do_POST(self):  # noqa: N802
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    req = json.loads(self.rfile.read(length) or b"{}")
+                except Exception:
+                    self._error(400, "request body is not valid JSON",
+                                "invalid_request_error")
+                    return
+                path = self.path.rstrip("/")
+                try:
+                    if path == "/v1/completions":
+                        frontend._completions(self, req, chat=False)
+                    elif path == "/v1/chat/completions":
+                        frontend._completions(self, req, chat=True)
+                    else:
+                        self._error(404, f"no route {path}",
+                                    "invalid_request_error")
+                except KeyError as e:
+                    self._error(404, f"model not found: {e}",
+                                "invalid_request_error")
+                except ValueError as e:
+                    self._error(400, str(e), "invalid_request_error")
+                except Exception as e:  # noqa: BLE001 - schema'd 500
+                    self._error(500, repr(e), "internal_error")
+
+        self._server = EgresslessHTTPServer((host, port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="openai-http",
+        )
+        self._thread.start()
+
+    # ------------------------------------------------------------ translate
+
+    def _handle_for(self, model_id: str):
+        if model_id not in self.models:
+            raise KeyError(model_id)
+        return serve_api.get_handle(self.models[model_id])
+
+    @staticmethod
+    def _to_payload(req: Dict[str, Any], chat: bool) -> Dict[str, Any]:
+        if chat:
+            messages = req.get("messages")
+            if not isinstance(messages, list) or not messages:
+                raise ValueError("'messages' must be a non-empty list")
+            prompt_tokens = ByteTokenizer.encode(_chat_prompt(messages))
+        else:
+            prompt = req.get("prompt")
+            if isinstance(prompt, str):
+                prompt_tokens = ByteTokenizer.encode(prompt)
+            elif isinstance(prompt, list) and all(
+                isinstance(t, int) for t in prompt
+            ):
+                prompt_tokens = prompt  # OpenAI's token-array form
+            else:
+                raise ValueError("'prompt' must be a string or token list")
+        payload: Dict[str, Any] = {
+            "prompt_tokens": prompt_tokens,
+            "max_tokens": int(req.get("max_tokens", 16)),
+            "temperature": float(req.get("temperature", 1.0)),
+        }
+        if "top_p" in req:
+            payload["top_p"] = float(req["top_p"])
+        if "stop_token_ids" in req:
+            payload["stop_token_ids"] = list(req["stop_token_ids"])
+        if isinstance(req.get("stop"), str):
+            # single-string stop sequence of one byte-tokenized char maps
+            # onto stop_token_ids; longer sequences are not supported by
+            # the engine's per-token stop check
+            ids = ByteTokenizer.encode(req["stop"])
+            if len(ids) == 1:
+                payload.setdefault("stop_token_ids", []).extend(ids)
+        return payload
+
+    def _completions(self, http, req: Dict[str, Any], chat: bool) -> None:
+        from ... import api as core_api
+
+        model_id = req.get("model") or next(iter(self.models))
+        handle = self._handle_for(model_id)
+        payload = self._to_payload(req, chat)
+        rid = ("chatcmpl-" if chat else "cmpl-") + uuid.uuid4().hex[:24]
+        created = int(time.time())
+        obj = "chat.completion" if chat else "text_completion"
+
+        if req.get("stream"):
+            self._stream_sse(http, handle, payload, rid, created, model_id, chat)
+            return
+        result = core_api.get(handle.generate.remote(payload), timeout=300)
+        text = ByteTokenizer.decode(result["tokens"])
+        finish = (
+            "length"
+            if result["usage"]["completion_tokens"] >= payload["max_tokens"]
+            else "stop"
+        )
+        choice: Dict[str, Any] = {"index": 0, "finish_reason": finish,
+                                  "logprobs": None}
+        if chat:
+            choice["message"] = {"role": "assistant", "content": text}
+        else:
+            choice["text"] = text
+        http._json(200, {
+            "id": rid, "object": obj, "created": created, "model": model_id,
+            "choices": [choice], "usage": result["usage"],
+        })
+
+    def _stream_sse(self, http, handle, payload, rid, created, model_id,
+                    chat) -> None:
+        """Server-sent events, OpenAI stream shape: one chunk per token,
+        a final usage-bearing chunk, then `data: [DONE]`."""
+        from ... import api as core_api
+
+        obj = "chat.completion.chunk" if chat else "text_completion"
+        stream = handle.options(stream=True).stream_generate.remote(payload)
+        http.send_response(200)
+        http.send_header("Content-Type", "text/event-stream")
+        http.send_header("Cache-Control", "no-cache")
+        http.send_header("Transfer-Encoding", "chunked")
+        http.end_headers()
+
+        def send(data: str) -> None:
+            write_chunk(http.wfile, f"data: {data}\n\n".encode())
+
+        def chunk_body(choice: Dict[str, Any], usage=None) -> str:
+            body = {
+                "id": rid, "object": obj, "created": created,
+                "model": model_id, "choices": [choice],
+            }
+            if usage is not None:
+                body["usage"] = usage
+            return json.dumps(body)
+
+        try:
+            for ref in stream:
+                item = core_api.get(ref, timeout=300)
+                if "token" in item:
+                    text = ByteTokenizer.decode([item["token"]])
+                    if chat:
+                        choice = {"index": 0, "finish_reason": None,
+                                  "delta": {"content": text}}
+                    else:
+                        choice = {"index": 0, "finish_reason": None,
+                                  "logprobs": None, "text": text}
+                    send(chunk_body(choice))
+                elif item.get("done"):
+                    final = {"index": 0, "finish_reason": "stop"}
+                    if chat:
+                        final["delta"] = {}
+                    else:
+                        final["text"] = ""
+                        final["logprobs"] = None
+                    send(chunk_body(final, usage=item.get("usage")))
+        except Exception as e:  # noqa: BLE001 - surfaces as an SSE error event
+            send(json.dumps({"error": {"message": repr(e),
+                                       "type": "internal_error"}}))
+        send("[DONE]")
+        http.wfile.write(b"0\r\n\r\n")
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+
+def build_openai_app(
+    models: Optional[Dict[str, Any]] = None,
+    *,
+    model: Any = "gpt2-tiny",
+    paged: bool = True,
+    max_slots: int = 8,
+    num_replicas: int = 1,
+    tensor_parallel: int = 1,
+):
+    """Deploy LLM app(s) and return the (not-yet-served) route table.
+    `models` maps model ids to model names/configs; the single-`model`
+    form mirrors the reference's one-model build_openai_app. Run with
+    `serve_openai(...)` or serve.run + OpenAIFrontend."""
+    from .server import build_llm_app
+
+    specs = models or {str(model): model}
+    routes: Dict[str, str] = {}
+    apps = []
+    for model_id, m in specs.items():
+        name = f"openai-{model_id}".replace("/", "-")
+        apps.append(build_llm_app(
+            m, name=name, num_replicas=num_replicas, max_slots=max_slots,
+            paged=paged, tensor_parallel=tensor_parallel,
+        ))
+        routes[model_id] = name
+    return apps, routes
+
+
+def serve_openai(
+    models: Optional[Dict[str, Any]] = None,
+    *,
+    model: Any = "gpt2-tiny",
+    host: str = "127.0.0.1",
+    port: int = 0,
+    **build_kwargs,
+) -> OpenAIFrontend:
+    """One-call OpenAI endpoint: deploy the app(s) and serve /v1/* on
+    `port`. Returns the frontend (``.port``, ``.stop()``)."""
+    apps, routes = build_openai_app(models, model=model, **build_kwargs)
+    for app, name in zip(apps, routes.values()):
+        serve_api.run(app, name=name)
+    return OpenAIFrontend(routes, host=host, port=port)

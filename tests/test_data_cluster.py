@@ -1,0 +1,93 @@
+"""Data pipelines ON the cluster (round-4 verdict #9): read + map tasks
+spill to agent nodes — blocks flow as refs pulled where consumed, and a
+multi-node cluster actually adds data throughput.
+
+Reference model: task_pool_map_operator.py dispatches cluster-wide.
+"""
+
+import os
+import tempfile
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rdata
+from ray_tpu.cluster_utils import Cluster
+
+
+@pytest.fixture
+def cluster():
+    c = Cluster(
+        head_node_args={
+            "num_cpus": 1,
+            "_system_config": {"node_stale_s": 5.0, "node_heartbeat_s": 0.2},
+        }
+    )
+    c.add_node(num_cpus=2, system_config={"node_heartbeat_s": 0.2})
+    c.add_node(num_cpus=2, system_config={"node_heartbeat_s": 0.2})
+    c.wait_for_nodes(3)
+    yield c
+    c.shutdown()
+    from ray_tpu.core.config import cfg
+
+    cfg.reset()
+
+
+def test_map_batches_spans_agents(cluster):
+    """A 12-block map pipeline on a 3-node cluster: results are exact
+    and the map tasks executed on >= 2 distinct agent processes."""
+    fd, log = tempfile.mkstemp(prefix="ray_tpu_datapids_")
+    os.close(fd)
+
+    def square_and_log(block, _log=log):
+        import os as _os
+
+        fdl = _os.open(_log, _os.O_WRONLY | _os.O_APPEND)
+        try:
+            _os.write(fdl, f"{_os.getpid()}\n".encode())
+        finally:
+            _os.close(fdl)
+        # hold briefly so blocks overlap across nodes
+        time.sleep(0.15)
+        return {"item": block["item"] ** 2}
+
+    ctx = rdata.DataContext.get_current()
+    old_prefetch = ctx.prefetch_blocks
+    ctx.prefetch_blocks = 8  # enough in-flight tasks to need both agents
+    try:
+        ds = rdata.range(1200, num_blocks=12).map_batches(square_and_log)
+        total = sum(int(r) for r in ds.take(2000))
+    finally:
+        ctx.prefetch_blocks = old_prefetch
+    assert total == sum(i * i for i in range(1200))
+
+    with open(log) as f:
+        pids = {int(line) for line in f if line.strip()}
+    agent_pids = {
+        rec["pid"] for rec in cluster.runtime.cluster.nodes()
+        if not rec["is_head"]
+    }
+    assert len(pids & agent_pids) >= 2, (
+        f"map tasks used {pids}, agents are {agent_pids}"
+    )
+    os.unlink(log)
+
+
+def test_actor_pool_udf_on_cluster(cluster):
+    """Stateful ActorPoolStrategy udfs place across the cluster too
+    (actors spill when the head cannot host the whole pool)."""
+
+    class AddOffset:
+        def __init__(self, offset):
+            self.offset = offset
+
+        def __call__(self, block):
+            return {"item": block["item"] + self.offset}
+
+    ds = rdata.range(100, num_blocks=4).map_batches(
+        AddOffset, compute=rdata.ActorPoolStrategy(size=2),
+        fn_constructor_args=(1000,),
+    )
+    vals = sorted(int(r) for r in ds.take(200))
+    assert vals == [i + 1000 for i in range(100)]

@@ -1,0 +1,144 @@
+"""New datasources (tfrecord / image dir / binary files) and Data
+running ON the cluster (round-4 verdict #9): map tasks spill to agent
+nodes with blocks flowing as refs pulled where consumed.
+
+Reference: _internal/datasource/tfrecords_datasource.py,
+image_datasource.py, binary_datasource.py; task_pool_map_operator.py
+dispatches cluster-wide tasks.
+"""
+
+import os
+import struct
+import tempfile
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rdata
+
+
+# ------------------------------------------------- tf.train.Example writer
+# Minimal protobuf ENCODER (the parser under test lives in datasource.py;
+# writing through an independent encoder makes the round-trip honest).
+
+def _varint(n: int) -> bytes:
+    if n < 0:
+        n += 1 << 64  # protobuf int64: two's complement in 64 bits
+    out = b""
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        out += bytes([b | (0x80 if n else 0)])
+        if not n:
+            return out
+
+
+def _ld(field: int, payload: bytes) -> bytes:
+    return _varint((field << 3) | 2) + _varint(len(payload)) + payload
+
+
+def _feature_int64(values) -> bytes:
+    packed = b"".join(_varint(int(v)) for v in values)
+    return _ld(3, _ld(1, packed))
+
+
+def _feature_float(values) -> bytes:
+    packed = np.asarray(values, dtype="<f4").tobytes()
+    return _ld(2, _ld(1, packed))
+
+
+def _feature_bytes(values) -> bytes:
+    body = b"".join(_ld(1, v) for v in values)
+    return _ld(1, body)
+
+
+def _example(features: dict) -> bytes:
+    entries = b""
+    for key, feat in features.items():
+        entry = _ld(1, key.encode()) + _ld(2, feat)
+        entries += _ld(1, entry)
+    return _ld(1, entries)
+
+
+def _write_tfrecord(path: str, records) -> None:
+    with open(path, "wb") as f:
+        for rec in records:
+            f.write(struct.pack("<Q", len(rec)))
+            f.write(b"\x00" * 4)  # length crc (parser skips)
+            f.write(rec)
+            f.write(b"\x00" * 4)  # data crc
+
+
+@pytest.fixture(autouse=True)
+def runtime():
+    ray_tpu.init(num_cpus=4, detect_accelerators=False)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_read_tfrecord_examples(tmp_path):
+    path = str(tmp_path / "shard-0.tfrecord")
+    _write_tfrecord(path, [
+        _example({
+            "label": _feature_int64([i]),
+            "offset": _feature_int64([-i - 1]),  # negative: sign folding
+            "score": _feature_float([i * 0.5, i * 0.25]),
+            "name": _feature_bytes([f"row{i}".encode()]),
+        })
+        for i in range(5)
+    ])
+    rows = rdata.read_tfrecord(path).take(1000)
+    assert len(rows) == 5
+    assert [int(r["label"]) for r in rows] == list(range(5))
+    assert [int(r["offset"]) for r in rows] == [-1, -2, -3, -4, -5]
+    assert rows[3]["score"] == pytest.approx([1.5, 0.75])
+    assert rows[2]["name"] == b"row2"
+
+
+def test_read_tfrecord_raw(tmp_path):
+    path = str(tmp_path / "raw.tfrecord")
+    _write_tfrecord(path, [b"alpha", b"beta"])
+    rows = rdata.read_tfrecord(path, parse=False).take(1000)
+    assert [r["bytes"] for r in rows] == [b"alpha", b"beta"]
+
+
+def test_read_images_dir(tmp_path):
+    from PIL import Image
+
+    for i in range(4):
+        Image.fromarray(
+            np.full((8 + i, 6, 3), i * 10, dtype=np.uint8)
+        ).save(tmp_path / f"img{i}.png")
+    # ragged decode first: same height, DIFFERENT widths -> object column
+    ragged = rdata.read_images(str(tmp_path)).take(1000)
+    assert len(ragged) == 4
+    assert {r["image"].shape[0] for r in ragged} == {8, 9, 10, 11}
+    ds = rdata.read_images(str(tmp_path), size=(6, 8))
+    rows = ds.take(1000)
+    assert len(rows) == 4
+    assert all(r["image"].shape == (8, 6, 3) for r in rows)
+    assert sorted(int(r["image"][0, 0, 0]) for r in rows) == [0, 10, 20, 30]
+    assert all(r["path"].endswith(".png") for r in rows)
+
+
+def test_read_binary_files(tmp_path):
+    (tmp_path / "a.bin").write_bytes(b"\x01\x02")
+    (tmp_path / "b.bin").write_bytes(b"\x03")
+    rows = rdata.read_binary_files(str(tmp_path)).take(1000)
+    assert sorted(r["bytes"] for r in rows) == [b"\x01\x02", b"\x03"]
+
+
+def test_read_parquet_sharded_dir(tmp_path):
+    pa = pytest.importorskip("pyarrow")
+    import pyarrow.parquet as pq
+
+    for shard in range(3):
+        table = pa.table({
+            "x": np.arange(shard * 10, shard * 10 + 10),
+        })
+        pq.write_table(table, tmp_path / f"part-{shard}.parquet")
+    ds = rdata.read_parquet(str(tmp_path))
+    vals = sorted(int(r["x"]) for r in ds.take(1000))
+    assert vals == list(range(30))

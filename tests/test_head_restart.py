@@ -1,0 +1,139 @@
+"""Head restart from snapshot (round-4 verdict #10): kill the head,
+restart it with --restore on the same port, and a surviving agent —
+never restarted — re-registers via its retrying heartbeat loop, its
+resources and parked state reappearing in the cluster view.
+
+Reference: Redis-backed GCS restart (gcs_table_storage.h:275,
+gcs_redis_failure_detector.h:35) where raylets outlive the GCS.
+"""
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import textwrap
+import time
+
+import pytest
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+_ENV = {**os.environ, "JAX_PLATFORMS": "cpu",
+        "RAY_TPU_NODE_HEARTBEAT_S": "0.2", "RAY_TPU_NODE_STALE_S": "2.5",
+        "RAY_TPU_GCS_SNAPSHOT_INTERVAL_S": "0.5"}
+
+_OBSERVER = textwrap.dedent(
+    """
+    import sys, time
+    import ray_tpu
+
+    address, resource, want = sys.argv[1], sys.argv[2], float(sys.argv[3])
+    ray_tpu.init(address=address, num_cpus=0, detect_accelerators=False)
+    deadline = time.monotonic() + 60
+    while ray_tpu.cluster_resources().get(resource, 0) < want:
+        assert time.monotonic() < deadline, (
+            f"never saw {resource}>={want}: {ray_tpu.cluster_resources()}"
+        )
+        time.sleep(0.2)
+
+    @ray_tpu.remote(num_cpus=0, resources={resource: 1})
+    def where():
+        import os
+        return os.getpid()
+
+    pid = ray_tpu.get(where.remote(), timeout=60)
+    ray_tpu.shutdown()
+    print(f"OBSERVER-OK {pid}")
+    """
+)
+
+
+def _spawn(cmd, log):
+    return subprocess.Popen(
+        cmd, env=_ENV, stdout=log, stderr=subprocess.STDOUT, text=True
+    )
+
+
+def _wait_line(path, needle, timeout=90, proc=None):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if proc is not None and proc.poll() is not None:
+            break
+        with open(path) as f:
+            if needle in f.read():
+                return
+        time.sleep(0.2)
+    with open(path) as f:
+        raise AssertionError(f"never saw {needle!r} in:\n{f.read()}")
+
+
+def test_head_restart_restores_surviving_agent():
+    tmp = tempfile.mkdtemp(prefix="ray_tpu_headrestart_")
+    snap = os.path.join(tmp, "gcs.snap")
+    port = _free_port()
+    address = f"127.0.0.1:{port}"
+    head_log = os.path.join(tmp, "head.log")
+    agent_log = os.path.join(tmp, "agent.log")
+
+    head_cmd = [
+        sys.executable, "-m", "ray_tpu", "--no-tpu", "start", "--head",
+        "--port", str(port), "--num-cpus", "1", "--snapshot-path", snap,
+    ]
+    head = _spawn(head_cmd, open(head_log, "w"))
+    agent = None
+    try:
+        _wait_line(head_log, "head up", proc=head)
+        agent = _spawn(
+            [sys.executable, "-m", "ray_tpu", "--no-tpu", "start",
+             "--address", address, "--num-cpus", "2",
+             "--resources", '{"pet": 3}'],
+            open(agent_log, "w"),
+        )
+        _wait_line(agent_log, "joined", proc=agent)
+
+        # observer 1: the agent's resources are visible pre-kill
+        out = subprocess.run(
+            [sys.executable, "-c", _OBSERVER, address, "pet", "3"],
+            env=_ENV, capture_output=True, text=True, timeout=120,
+        )
+        assert "OBSERVER-OK" in out.stdout, out.stdout + out.stderr
+        agent_pid_1 = int(out.stdout.split("OBSERVER-OK")[1].strip())
+        assert agent_pid_1 == agent.pid
+
+        # give the snapshot loop a beat to persist the node table
+        time.sleep(2.0)
+
+        # kill the head hard; the agent keeps running (heartbeats warn)
+        head.send_signal(signal.SIGKILL)
+        head.wait(timeout=30)
+        time.sleep(1.0)
+        assert agent.poll() is None, "agent must survive head death"
+
+        # restart the head from the snapshot, same port
+        head = _spawn(head_cmd + ["--restore"], open(head_log, "a"))
+        _wait_line(head_log, "head up", proc=head)
+
+        # observer 2: the surviving agent (same pid!) re-registered and
+        # still executes work — no agent restart happened
+        out = subprocess.run(
+            [sys.executable, "-c", _OBSERVER, address, "pet", "3"],
+            env=_ENV, capture_output=True, text=True, timeout=120,
+        )
+        assert "OBSERVER-OK" in out.stdout, out.stdout + out.stderr
+        agent_pid_2 = int(out.stdout.split("OBSERVER-OK")[1].strip())
+        assert agent_pid_2 == agent.pid == agent_pid_1
+    finally:
+        for proc in (head, agent):
+            if proc is not None and proc.poll() is None:
+                proc.terminate()
+                try:
+                    proc.wait(timeout=15)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
