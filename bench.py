@@ -31,6 +31,54 @@ _PEAK_FLOPS = {
 }
 
 
+def ring_kernel_bench() -> dict:
+    """Fused-Pallas vs einsum ring-attention LOCAL BLOCK on the real
+    chip (the long-context kernel claim, runnable single-chip: the ring
+    collective is free under XLA; the per-step kernel is what differs).
+    Same chained-inside-one-jit methodology as the train bench — per
+    -call timing through the tunnel measures RTT, not compute."""
+    from ray_tpu.ops.attention import flash_attention_with_lse
+
+    b, h, s, d = 4, 8, 2048, 128
+    n_iters = 40
+    keys = jax.random.split(jax.random.PRNGKey(0), 3)
+    q, k, v = (jax.random.normal(kk, (b, h, s, d), jnp.bfloat16) for kk in keys)
+
+    def einsum_block(q, k, v):
+        s_ = jnp.einsum(
+            "bhqd,bhkd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)
+        ) / (d ** 0.5)
+        m = jnp.max(s_, axis=-1, keepdims=True)
+        p = jnp.exp(s_ - m)
+        l = jnp.sum(p, axis=-1, keepdims=True)
+        return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)) / l
+
+    def chained(block):
+        def f(q, k, v):
+            def body(_, qq):
+                return block(qq, k, v).astype(jnp.bfloat16)
+            return jnp.sum(
+                jax.lax.fori_loop(0, n_iters, body, q).astype(jnp.float32)
+            )
+        return jax.jit(f)
+
+    fused = chained(lambda q, k, v: flash_attention_with_lse(q, k, v)[0])
+    ein = chained(einsum_block)
+
+    def bench(fn):
+        float(fn(q, k, v))  # compile + sync
+        t0 = time.perf_counter()
+        float(fn(q, k, v))  # host read = true sync
+        return (time.perf_counter() - t0) / n_iters * 1e3
+
+    fused_ms, ein_ms = bench(fused), bench(ein)
+    return {
+        "ring_fused_block_ms": round(fused_ms, 3),
+        "ring_einsum_block_ms": round(ein_ms, 3),
+        "ring_fused_speedup": round(ein_ms / fused_ms, 2),
+    }
+
+
 def main() -> None:
     from ray_tpu.models import count_params, get_config
     from ray_tpu.parallel import MeshSpec, build_mesh
@@ -69,6 +117,10 @@ def main() -> None:
     device_kind = getattr(devices[0], "device_kind", "unknown")
     peak = _PEAK_FLOPS.get(device_kind, 197e12)
     mfu = tokens_per_sec * flops_per_token / peak
+    try:
+        ring = ring_kernel_bench()
+    except Exception:  # noqa: BLE001 - the headline number must still print
+        ring = {}
     print(
         json.dumps(
             {
@@ -82,6 +134,7 @@ def main() -> None:
                 "mfu": round(mfu, 4),
                 "batch": BATCH,
                 "seq": SEQ,
+                **ring,
             }
         )
     )

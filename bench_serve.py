@@ -133,8 +133,10 @@ def bench_openai(args) -> None:
         model=args.model, paged=True, max_slots=8, tensor_parallel=args.tp
     )
     url = f"http://127.0.0.1:{frontend.port}/v1/completions"
+    from ray_tpu.models import get_config as _get_config
+
     rng = np.random.default_rng(0)
-    vocab = 50257 if "gpt2" in args.model else 256
+    vocab = _get_config(args.model).vocab_size
 
     def post(i, results):
         prompt = [int(t) for t in rng.integers(1, vocab, size=PROMPT_LEN)]

@@ -344,8 +344,11 @@ def _tfrecord_records(path: str):
     with open(path, "rb") as f:
         while True:
             header = f.read(12)
-            if len(header) < 12:
+            if not header:
                 return
+            if len(header) < 12:
+                # mid-header truncation must be as loud as mid-payload
+                raise ValueError(f"truncated TFRecord header in {path}")
             (length,) = struct.unpack("<Q", header[:8])
             payload = f.read(length)
             if len(payload) < length:

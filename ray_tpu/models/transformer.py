@@ -263,14 +263,17 @@ def _block(
     return mlp_sublayer(x, lp, config)
 
 
-def forward(
+def forward_hidden(
     params: Params,
     tokens: jax.Array,
     config: TransformerConfig,
     *,
     positions: Optional[jax.Array] = None,
 ) -> jax.Array:
-    """Full-sequence forward (training / prefill): (B, S) → (B, S, V)."""
+    """Forward up to (but excluding) the LM head: (B, S) → (B, S, E).
+    The chunked fused-loss path (ops/losses.py
+    fused_linear_cross_entropy) consumes this so the full logits tensor
+    never materializes."""
     c = config
     dt = c.dtype
     _, s = tokens.shape
@@ -291,12 +294,28 @@ def forward(
         block_fn = jax.checkpoint(block_fn)
     x, _ = jax.lax.scan(block_fn, x, params["blocks"], unroll=c.scan_unroll)
 
-    x = _norm(x, params["lnf_scale"], params.get("lnf_bias"), c.norm)
+    return _norm(x, params["lnf_scale"], params.get("lnf_bias"), c.norm)
+
+
+def lm_head_weights(params: Params, config: TransformerConfig) -> jax.Array:
+    """(E, V) output projection — tied to wte unless a separate lm_head
+    exists."""
     head = params.get("lm_head", None)
     if head is None:
         head = params["wte"].T
-    logits = jnp.einsum("bse,ev->bsv", x, head.astype(dt))
-    return logits
+    return head.astype(config.dtype)
+
+
+def forward(
+    params: Params,
+    tokens: jax.Array,
+    config: TransformerConfig,
+    *,
+    positions: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Full-sequence forward (training / prefill): (B, S) → (B, S, V)."""
+    x = forward_hidden(params, tokens, config, positions=positions)
+    return jnp.einsum("bse,ev->bsv", x, lm_head_weights(params, config))
 
 
 # --------------------------------------------------------------------- decode

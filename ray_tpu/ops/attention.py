@@ -34,6 +34,10 @@ except ImportError:  # pragma: no cover
     _HAS_PLTPU = False
 
 _NEG_INF = -1e30  # finite "minus infinity": keeps exp() at exactly 0.0 without NaNs
+_LOG2E = 1.4426950408889634  # kernels fold log2(e) into sm_scale and use
+# exp2/log2 internally: one VPU transcendental per element instead of
+# exp's extra multiply (the standard TPU flash trick); the stored lse
+# stays in NATURAL log so the backward contract is unchanged
 
 
 # ------------------------------------------------------------------ reference
@@ -115,7 +119,7 @@ def _fwd_kernel(
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        s = s * sm_scale
+        s = s * (sm_scale * _LOG2E)  # base-2 log domain
 
         col = j * block_kv + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
         mask = col < kv_len
@@ -128,8 +132,8 @@ def _fwd_kernel(
         l_prev = l_scr[:, :1]
         m_cur = jnp.max(s, axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
-        p = jnp.exp(s - m_new)
-        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp2(s - m_new)
+        alpha = jnp.exp2(m_prev - m_new)
         l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
         acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
@@ -146,7 +150,10 @@ def _fwd_kernel(
         # logsumexp residual for the backward pass; fully-masked rows get -inf.
         # Stored as (..., S, 1) — a (block_q, 1) block satisfies the Mosaic
         # last-two-dims tiling rule, a bare (block_q,) block does not.
-        lse_ref[0, 0] = jnp.where(l == 0.0, _NEG_INF, m_scr[:, :1] + jnp.log(safe_l))
+        lse_ref[0, 0] = jnp.where(
+            l == 0.0, _NEG_INF,
+            (m_scr[:, :1] + jnp.log2(safe_l)) * (1.0 / _LOG2E),
+        )
 
 
 def _fwd_pallas(q, k, v, causal, sm_scale, block_q, block_kv, kv_len, interpret):
@@ -233,14 +240,14 @@ def _dkv_kernel(
 
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * sm_scale
+        ) * (sm_scale * _LOG2E)
         col = j * block_kv + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
         mask = col < kv_len
         if causal:
             row = i * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             mask = mask & (col <= row)
         s = jnp.where(mask, s, _NEG_INF)
-        p = jnp.exp(s - lse)  # (block_q, block_kv)
+        p = jnp.exp2(s - lse * _LOG2E)  # (block_q, block_kv)
 
         # dV_j += P^T dO
         dv_scr[:] += jax.lax.dot_general(
@@ -291,14 +298,14 @@ def _dq_kernel(
 
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * sm_scale
+        ) * (sm_scale * _LOG2E)
         col = j * block_kv + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
         mask = col < kv_len
         if causal:
             row = i * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             mask = mask & (col <= row)
         s = jnp.where(mask, s, _NEG_INF)
-        p = jnp.exp(s - lse)
+        p = jnp.exp2(s - lse * _LOG2E)
 
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32

@@ -163,13 +163,21 @@ class OpenAIFrontend:
             payload["top_p"] = float(req["top_p"])
         if "stop_token_ids" in req:
             payload["stop_token_ids"] = list(req["stop_token_ids"])
-        if isinstance(req.get("stop"), str):
-            # single-string stop sequence of one byte-tokenized char maps
-            # onto stop_token_ids; longer sequences are not supported by
-            # the engine's per-token stop check
-            ids = ByteTokenizer.encode(req["stop"])
-            if len(ids) == 1:
-                payload.setdefault("stop_token_ids", []).extend(ids)
+        stop = req.get("stop")
+        if isinstance(stop, str):
+            stop = [stop]
+        if isinstance(stop, list):
+            # each single-byte stop string maps onto stop_token_ids;
+            # multi-byte sequences are not supported by the engine's
+            # per-token stop check and are rejected rather than ignored
+            for item in stop:
+                ids = ByteTokenizer.encode(str(item))
+                if len(ids) != 1:
+                    raise ValueError(
+                        f"stop sequence {item!r} is not a single byte; "
+                        "multi-byte stop sequences are unsupported"
+                    )
+                payload.setdefault("stop_token_ids", []).append(ids[0])
         return payload
 
     def _completions(self, http, req: Dict[str, Any], chat: bool) -> None:
@@ -229,11 +237,19 @@ class OpenAIFrontend:
                 body["usage"] = usage
             return json.dumps(body)
 
+        import codecs
+
+        # incremental decode: a multi-byte UTF-8 character split across
+        # byte-tokens must not degrade to U+FFFD per byte — buffer until
+        # the sequence completes, exactly like the non-streamed decode
+        decoder = codecs.getincrementaldecoder("utf-8")("replace")
         try:
             for ref in stream:
                 item = core_api.get(ref, timeout=300)
                 if "token" in item:
-                    text = ByteTokenizer.decode([item["token"]])
+                    text = decoder.decode(bytes([item["token"] & 0xFF]))
+                    if not text:
+                        continue  # mid-sequence: held back
                     if chat:
                         choice = {"index": 0, "finish_reason": None,
                                   "delta": {"content": text}}
@@ -242,11 +258,20 @@ class OpenAIFrontend:
                                   "logprobs": None, "text": text}
                     send(chunk_body(choice))
                 elif item.get("done"):
-                    final = {"index": 0, "finish_reason": "stop"}
+                    tail = decoder.decode(b"", final=True)
+                    usage = item.get("usage") or {}
+                    finish = (
+                        "length"
+                        if usage.get("completion_tokens", 0)
+                        >= payload["max_tokens"] else "stop"
+                    )
+                    final = {"index": 0, "finish_reason": finish}
                     if chat:
-                        final["delta"] = {}
+                        final["delta"] = (
+                            {"content": tail} if tail else {}
+                        )
                     else:
-                        final["text"] = ""
+                        final["text"] = tail
                         final["logprobs"] = None
                     send(chunk_body(final, usage=item.get("usage")))
         except Exception as e:  # noqa: BLE001 - surfaces as an SSE error event

@@ -153,3 +153,38 @@ def test_cross_entropy_z_loss_increases_loss():
     base, _ = cross_entropy_loss(logits, targets)
     with_z, _ = cross_entropy_loss(logits, targets, z_loss_coeff=1e-2)
     assert float(with_z) > float(base)
+
+
+def test_fused_linear_cross_entropy_matches_dense():
+    """The chunked fused head+CE (PERF_NOTES.md) must agree with the
+    dense path — values AND gradients — including mask and z-loss."""
+    from ray_tpu.ops.losses import fused_linear_cross_entropy
+
+    key = jax.random.PRNGKey(11)
+    b, s, e, v, chunk = 2, 8, 16, 32, 4
+    x = _rand(key, (b, s, e))
+    head = _rand(jax.random.PRNGKey(12), (e, v))
+    targets = jax.random.randint(jax.random.PRNGKey(13), (b, s), 0, v)
+    mask = jnp.array([[1] * 8, [1, 1, 1, 1, 0, 0, 0, 0]])
+
+    def dense(x, head):
+        logits = jnp.einsum("bse,ev->bsv", x, head)
+        return cross_entropy_loss(
+            logits, targets, mask=mask, z_loss_coeff=1e-3
+        )[0]
+
+    def fused(x, head):
+        return fused_linear_cross_entropy(
+            x, head, targets, chunk=chunk, mask=mask, z_loss_coeff=1e-3
+        )[0]
+
+    np.testing.assert_allclose(
+        float(dense(x, head)), float(fused(x, head)), rtol=1e-5
+    )
+    gd = jax.grad(dense, argnums=(0, 1))(x, head)
+    gf = jax.grad(fused, argnums=(0, 1))(x, head)
+    for a, b_ in zip(gd, gf):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_), atol=1e-5)
+
+    with pytest.raises(ValueError):
+        fused_linear_cross_entropy(x, head, targets, chunk=5)
