@@ -113,6 +113,49 @@ def _cmd_status(args) -> int:
     return 0
 
 
+def _observer_init(args):
+    import ray_tpu
+
+    return ray_tpu.init(
+        num_cpus=0, detect_accelerators=not args.no_tpu,
+        address=args.address, cluster_token=args.token,
+    )
+
+
+def _cmd_logs(args) -> int:
+    """Aggregate log tails across the cluster (reference: `ray logs`
+    routed through the per-node dashboard agents)."""
+    import ray_tpu
+    from .util import state
+
+    _observer_init(args)
+    time.sleep(1.0)  # let the cluster view populate
+    for node_hex, lines in state.cluster_logs(tail=args.tail).items():
+        print(f"=== node {node_hex[:12]} ===")
+        for line in lines:
+            print(line)
+        print()
+    ray_tpu.shutdown()
+    return 0
+
+
+def _cmd_events(args) -> int:
+    import ray_tpu
+    from .util import state
+
+    _observer_init(args)
+    time.sleep(1.0)
+    for node_hex, evs in state.cluster_events(limit=args.limit).items():
+        print(f"=== node {node_hex[:12]} ===")
+        for e in evs:
+            extra = f" {e['extra']}" if e.get("extra") else ""
+            print(f"{e.get('ts', 0):.3f} {e['severity']:7s} "
+                  f"[{e['source']}] {e['message']}{extra}")
+        print()
+    ray_tpu.shutdown()
+    return 0
+
+
 def _cmd_job(args) -> int:
     from .jobs import default_job_manager
 
@@ -214,6 +257,16 @@ def build_parser() -> argparse.ArgumentParser:
         jx = jsub.add_parser(name)
         jx.add_argument("job_id")
 
+    lp = sub.add_parser("logs", help="tail logs from every cluster node")
+    lp.add_argument("--address", help="head GCS address to join as observer")
+    lp.add_argument("--tail", type=int, default=50)
+    lp.add_argument("--token", default=None)
+
+    ep = sub.add_parser("events", help="structured cluster events")
+    ep.add_argument("--address", help="head GCS address to join as observer")
+    ep.add_argument("--limit", type=int, default=50)
+    ep.add_argument("--token", default=None)
+
     tp = sub.add_parser("timeline", help="dump a chrome-trace of this session")
     tp.add_argument("output", nargs="?", default="timeline.json")
 
@@ -230,6 +283,8 @@ def main(argv=None) -> int:
         "config": _cmd_config,
         "status": _cmd_status,
         "job": _cmd_job,
+        "logs": _cmd_logs,
+        "events": _cmd_events,
         "timeline": _cmd_timeline,
         "dashboard": _cmd_dashboard,
     }[args.command]

@@ -263,6 +263,10 @@ class Autoscaler:
             if self._node_is_idle(node):
                 since = self._idle_since.setdefault(hex_id, now)
                 if now - since >= self.idle_timeout_s:
+                    from ..util.events import emit
+
+                    emit("INFO", "autoscaler",
+                         f"terminated idle node {node.node_id.hex()[:12]}")
                     self.provider.terminate_node(node)
                     node_type = node.labels.get("node_type")
                     if node_type in self._per_type_count:

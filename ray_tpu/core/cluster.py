@@ -71,7 +71,7 @@ from .exceptions import ActorDiedError
 from .gcs_service import GcsClient
 from .ids import ActorID, NodeID, ObjectID
 from .object_transfer import ObjectTransferServer, fetch_object, push_object
-from .rpc import RpcClient, RpcError
+from .rpc import PROTOCOL_VERSION, RpcClient, RpcError
 from .scheduler import (
     NodeAffinitySchedulingStrategy,
     PlacementGroupSchedulingStrategy,
@@ -83,6 +83,7 @@ from .worker_pool import WorkerCrashedError
 
 logger = logging.getLogger(__name__)
 
+PROTO_NS = "_protocol"   # GCS KV: "version" -> wire-protocol generation
 NODE_NS = "_nodes"       # GCS KV: node_id hex -> node info dict
 OBJDIR_NS = "_objdir"    # GCS KV: object id hex -> transfer address
 ACTOR_NS = "_cluster_actors"  # GCS KV: name -> {node_hex, actor_hex}
@@ -492,6 +493,8 @@ class ClusterContext:
         self.server.register("poll_task_done", self._poll_task_done)
         self.server.register("reserve_bundle", self._reserve_bundle)
         self.server.register("release_bundle", self._release_bundle)
+        self.server.register("node_logs", self._node_logs)
+        self.server.register("node_events", self._node_events)
         self.address = self.server.address
 
         self.gcs = GcsClient(gcs_address, token=self.token)
@@ -584,6 +587,19 @@ class ClusterContext:
         """Heartbeat FIRST, then the table entry: watchers discover nodes
         from the table but declare death from heartbeat staleness, so the
         heartbeat must never lag the registration."""
+        if self.is_head:
+            self.gcs.kv_put("version", PROTOCOL_VERSION, namespace=PROTO_NS)
+        else:
+            # refuse to join across wire-protocol generations: the frames
+            # are pickle, so a silent mismatch would desync mid-dispatch
+            # instead of failing cleanly (rpc.py PROTOCOL_VERSION)
+            head_proto = self.gcs.kv_get("version", namespace=PROTO_NS)
+            if head_proto is not None and head_proto != PROTOCOL_VERSION:
+                raise RuntimeError(
+                    f"cluster head speaks wire protocol {head_proto}, this "
+                    f"node speaks {PROTOCOL_VERSION}; upgrade/downgrade "
+                    f"this node's ray_tpu to match the head"
+                )
         self._heartbeat()
         info = {
             "node_id": self.node_id.hex(),
@@ -591,6 +607,7 @@ class ClusterContext:
             "resources": dict(self._local_node.resources.total),
             "labels": dict(self._local_node.labels),
             "is_head": self.is_head,
+            "protocol": PROTOCOL_VERSION,
             "pid": os.getpid(),
             "hostname": socket.gethostname(),
             "joined_at": time.time(),
@@ -646,6 +663,12 @@ class ClusterContext:
             if known is not None:
                 known.client.close()  # don't leak the quarantined socket
             self.runtime.scheduler.add_node(node)
+            from ..util.events import emit
+
+            emit("INFO", "cluster",
+                 f"node {node_hex[:12]} "
+                 f"{'rediscovered' if known is not None else 'discovered'}",
+                 address=info["address"])
             logger.info("%s cluster node %s at %s",
                         "rediscovered" if known is not None else "discovered",
                         node_hex[:12], info["address"])
@@ -663,6 +686,9 @@ class ClusterContext:
             node = self._remote_nodes.pop(node_hex, None)
         if node is None:
             return
+        from ..util.events import emit
+
+        emit("WARNING", "cluster", f"node {node_hex[:12]} died", reason=reason)
         logger.warning("cluster node %s died (%s)", node_hex[:12], reason)
         self.runtime.scheduler.remove_node(node.node_id)
         self.gcs.kv_delete(node_hex, namespace=NODE_NS)
@@ -1345,6 +1371,11 @@ class ClusterContext:
                 )
             except (RpcError, OSError):
                 pass
+        from ..util.events import emit
+
+        emit("WARNING", "actors",
+             f"actor {proxy.display_name} restarted on node "
+             f"{node.node_id.hex()[:12]}", reason=why)
         logger.warning(
             "actor %s restarted on node %s (%s)",
             proxy.display_name, node.node_id.hex()[:12], why,
@@ -1772,6 +1803,10 @@ class ClusterContext:
             )
             self._agent_running.discard(task_hex)
         self.agent_stats["parked"] += 1
+        from ..util.events import emit
+
+        emit("WARNING", "cluster",
+             f"parked undeliverable completion of task {task_hex[:12]}")
         logger.warning(
             "parked undeliverable completion of task %s (owner unreachable); "
             "the owner's poll loop can reclaim it for %.0fs",
@@ -2023,6 +2058,38 @@ class ClusterContext:
             client.close()
 
     # ------------------------------------------------------------------ misc
+
+    def fanout_nodes(self, method: str, *args, placeholder=None):
+        """Call `method(*args)` on every live remote node's agent,
+        returning {node_hex: result}; unreachable nodes map to
+        `placeholder(exc)` (the shared loop behind cluster-wide
+        logs/events aggregation — private node state stays in here)."""
+        out: Dict[str, Any] = {}
+        with self._lock:
+            nodes = list(self._remote_nodes.values())
+        for node in nodes:
+            if not node.alive:
+                continue
+            try:
+                out[node.node_id.hex()] = node.client.call(method, *args)
+            except Exception as exc:  # noqa: BLE001 - partial views are fine
+                out[node.node_id.hex()] = (
+                    placeholder(exc) if placeholder is not None else None
+                )
+        return out
+
+    def _node_logs(self, n: int = 200) -> List[str]:
+        """Serve this node's captured log tail (cross-node `ray_tpu
+        logs`; reference: per-node log routes in the dashboard agent)."""
+        from ..util import logs as _logs
+
+        return _logs.tail(int(n))
+
+    def _node_events(self, since_seq: int = 0, limit: int = 500) -> List[Dict[str, Any]]:
+        """Serve this node's structured event tail (util/events.py)."""
+        from ..util.events import events
+
+        return events().list(since_seq=int(since_seq), limit=int(limit))
 
     def _node_info(self) -> Dict[str, Any]:
         return {

@@ -98,6 +98,9 @@ class HealthCheckManager:
                 self._failures.pop(target_id, None)
             dead.append(target_id)
             self.stats["deaths"] += 1
+            from ..util.events import emit
+
+            emit("WARNING", "health", f"{target_id} declared dead")
             logger.warning("health check: %s declared dead", target_id)
             try:
                 on_dead(target_id)
@@ -176,6 +179,11 @@ class MemoryMonitor:
                 usage * 100,
             )
             return False
+        from ..util.events import emit
+
+        emit("ERROR", "health",
+             f"OOM policy killed worker {victim.pid}",
+             usage=round(usage, 3), policy=self.policy)
         logger.warning(
             "memory usage %.0f%% >= %.0f%%: killing worker %d (%s policy); "
             "its task will retry if retriable",

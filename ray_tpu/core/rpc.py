@@ -32,6 +32,20 @@ logger = logging.getLogger(__name__)
 
 _HDR = struct.Struct(">Q")
 MAX_FRAME = 1 << 31  # 2 GiB safety bound
+# Wire-protocol generation. The frames are pickle (documented choice —
+# no protobuf in this image), so cross-version compatibility cannot be
+# field-by-field like the reference's proto evolution; the VERSION
+# gates at TWO layers instead:
+#   1. token-authenticated connections embed it in the handshake magic
+#      below, BEFORE any pickle crosses — a frame/handshake change
+#      fails cleanly at connect time;
+#   2. joining nodes also compare against the head's advertised
+#      "_protocol" GCS key (cluster.py _register) — catches tokenless
+#      same-host mismatches and payload-blob-shape changes with an
+#      actionable "upgrade this node" error instead of a mid-dispatch
+#      desync.
+# Bump on ANY incompatible change to frame/blob shapes.
+PROTOCOL_VERSION = 1
 # Auth handshake prefix. The token check happens BEFORE any unpickling:
 # a pickle payload on the wire is arbitrary code execution, so a server
 # bound off-localhost must drop unauthenticated peers at the first frame.
@@ -42,7 +56,9 @@ MAX_FRAME = 1 << 31  # 2 GiB safety bound
 # deployments still assume a trusted network for the pickle payloads
 # themselves (wrap in TLS/WireGuard otherwise) — this matches the
 # reference, whose gRPC channels are plaintext unless TLS is configured.
-_AUTH_MAGIC = b"RAYTPU-AUTH2:"
+# The magic embeds PROTOCOL_VERSION so cross-generation authenticated
+# peers fail at the handshake, BEFORE any pickle crosses the wire.
+_AUTH_MAGIC = b"RAYTPU-P%d-AUTH2:" % PROTOCOL_VERSION
 
 
 class RpcError(RuntimeError):

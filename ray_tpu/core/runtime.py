@@ -187,6 +187,11 @@ class Runtime:
             cfg.oom_policy,
         )
         self.memory_monitor.start()
+        # log capture: the tail of this process's logging stream is
+        # servable over the node RPC (cross-node `ray_tpu logs`)
+        from ..util import logs as _logs
+
+        _logs.install()
         # multi-process cluster membership (core/cluster.py): the head
         # serves its GCS over RPC; workers join an existing head. Either
         # way this process gains a node server + remote dispatch.
@@ -244,6 +249,9 @@ class Runtime:
                 "gcs snapshot %s is unreadable; starting fresh", path
             )
             return
+        from ..util.events import emit
+
+        emit("INFO", "gcs", f"restored GCS snapshot from {path}")
         for info in extra.get("jobs", ()):  # job records survive restarts
             if info.status in (JobStatus.PENDING, JobStatus.RUNNING):
                 # the driver process died with the old control plane

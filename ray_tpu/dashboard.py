@@ -34,6 +34,8 @@ th{background:#eee} code{background:#eee;padding:0 .3em}
 <h2>Actors</h2><table id="actors"></table>
 <h2>Recent tasks</h2><table id="tasks"></table>
 <h2>Jobs</h2><table id="jobs"></table>
+<h2>Events</h2><table id="events"></table>
+<h2>Logs (per node, last lines)</h2><pre id="logs" style="font-size:.75em;background:#eee;padding:.6em;max-height:22em;overflow:auto"></pre>
 <script>
 function fill(id, rows) {
   const t = document.getElementById(id);
@@ -53,6 +55,12 @@ async function refresh() {
     const tasks = await (await fetch("/api/tasks")).json();
     fill("tasks", tasks.slice(-20).reverse());
     fill("jobs", await (await fetch("/api/jobs")).json());
+    const ev = await (await fetch("/api/events")).json();
+    fill("events", ev.slice(-15).reverse());
+    const logs = await (await fetch("/api/logs")).json();
+    document.getElementById("logs").textContent = Object.entries(logs)
+      .map(([n, lines]) => `=== ${n} ===\n` + lines.slice(-12).join("\n"))
+      .join("\n\n");
     document.getElementById("err").textContent = "";
   } catch (e) { document.getElementById("err").textContent = "refresh failed: " + e; }
 }
@@ -131,6 +139,13 @@ class _Handler(BaseHTTPRequestHandler):
             return state.list_objects()
         if name == "timeline":
             return json.loads(state.chrome_tracing_dump())
+        if name == "events":
+            return state.list_events()
+        if name == "cluster_events":
+            return state.cluster_events()
+        if name == "logs":
+            # the UI shows ~12 lines/node; don't ship 200 per refresh
+            return state.cluster_logs(tail=20)
         if name == "jobs":
             from .jobs import _default_manager
 

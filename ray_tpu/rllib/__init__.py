@@ -1,9 +1,12 @@
 """ray_tpu.rllib — reinforcement learning on the actor runtime.
 
 Reference parity: rllib (/root/reference/rllib/ — Algorithm :202,
-EnvRunner groups, PPO). Scoped to the load-bearing core: vectorized
-envs, actor rollout workers, and PPO as one fused XLA update.
+EnvRunner groups, algorithms/ppo + algorithms/dqn). Scoped to the
+load-bearing core: vectorized envs, actor rollout workers, PPO (the
+on-policy family) and double-DQN with replay (the off-policy family),
+each as one fused XLA update program.
 """
 
+from .dqn import DQN, DQNConfig, DQNRolloutWorker, ReplayBuffer  # noqa: F401
 from .env import CartPoleVectorEnv, VectorEnv, make_env, register_env  # noqa: F401
 from .ppo import PPO, PPOConfig, RolloutWorker, init_policy, policy_forward  # noqa: F401

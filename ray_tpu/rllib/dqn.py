@@ -1,0 +1,304 @@
+"""DQN with double-Q targets: the off-policy value-learning family.
+
+Reference parity: rllib's DQN (/root/reference/rllib/algorithms/dqn/ —
+EnvRunner actors feeding a replay buffer, a Learner applying TD updates,
+periodic target-network sync). TPU inversion: rollout workers are
+ray_tpu actors stepping numpy vector envs with a jitted epsilon-greedy
+policy; the replay buffer is a flat numpy ring on the driver; each
+train() runs K double-DQN minibatch updates fused into ONE jitted
+lax.scan program (no per-minibatch Python), and the target params sync
+by tree copy every `target_update_freq` updates.
+
+    algo = DQNConfig(env="cartpole", num_workers=2).build()
+    for _ in range(40):
+        result = algo.train()
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from .. import api
+from .env import make_env
+
+Params = Dict[str, Any]
+
+
+def init_q_network(key: jax.Array, obs_dim: int, num_actions: int,
+                   hidden: Tuple[int, ...] = (64, 64)) -> Params:
+    params: Params = {}
+    sizes = (obs_dim,) + hidden
+    for i in range(len(hidden)):
+        key, sub = jax.random.split(key)
+        params[f"w{i}"] = jax.random.normal(sub, (sizes[i], sizes[i + 1])) * (
+            1.0 / np.sqrt(sizes[i])
+        )
+        params[f"b{i}"] = jnp.zeros(sizes[i + 1])
+    key, sub = jax.random.split(key)
+    params["w_q"] = jax.random.normal(sub, (hidden[-1], num_actions)) * 0.01
+    params["b_q"] = jnp.zeros(num_actions)
+    return params
+
+
+def q_forward(params: Params, obs: jax.Array) -> jax.Array:
+    """obs (..., D) -> Q-values (..., A)."""
+    x = obs
+    i = 0
+    while f"w{i}" in params:
+        x = jnp.tanh(x @ params[f"w{i}"] + params[f"b{i}"])
+        i += 1
+    return x @ params["w_q"] + params["b_q"]
+
+
+class DQNRolloutWorker:
+    """Actor: epsilon-greedy steps of a vector env, returning flat
+    transitions for the replay buffer (reference EnvRunner in the
+    off-policy stack)."""
+
+    def __init__(self, env_name: str, num_envs: int, rollout_len: int, seed: int):
+        self.env = make_env(env_name, num_envs)
+        self.rollout_len = rollout_len
+        self.obs = self.env.reset(seed=seed)
+        self._key = jax.random.PRNGKey(seed)
+        self._episode_returns = np.zeros(num_envs, np.float32)
+        self._finished: List[float] = []
+        self._greedy = jax.jit(lambda p, o: jnp.argmax(q_forward(p, o), axis=-1))
+
+    def set_weights(self, params: Params) -> None:
+        self.params = params
+
+    def rollout(self, epsilon: float) -> Dict[str, np.ndarray]:
+        T, N, D = self.rollout_len, self.env.num_envs, self.env.observation_dim
+        obs_buf = np.zeros((T, N, D), np.float32)
+        next_buf = np.zeros((T, N, D), np.float32)
+        act_buf = np.zeros((T, N), np.int32)
+        rew_buf = np.zeros((T, N), np.float32)
+        done_buf = np.zeros((T, N), np.bool_)
+        self._finished = []
+        rng = np.random.default_rng(int(jax.random.randint(
+            self._key, (), 0, 2**31 - 1
+        )))
+        self._key = jax.random.fold_in(self._key, 1)
+        greedy = None
+        for t in range(T):
+            greedy = np.asarray(self._greedy(self.params, self.obs))
+            explore = rng.random(N) < epsilon
+            action = np.where(
+                explore, rng.integers(0, self.env.num_actions, size=N), greedy
+            ).astype(np.int32)
+            obs_buf[t] = self.obs
+            act_buf[t] = action
+            self.obs, rewards, dones = self.env.step(action)
+            # NOTE: auto-reset envs return the NEW episode's obs on done;
+            # the TD target masks next-state value by (1 - done), so the
+            # reset obs never leaks into a target
+            next_buf[t] = self.obs
+            rew_buf[t] = rewards
+            done_buf[t] = dones
+            self._episode_returns += rewards
+            for i in np.nonzero(dones)[0]:
+                self._finished.append(float(self._episode_returns[i]))
+                self._episode_returns[i] = 0.0
+        flat = T * N
+        return {
+            "obs": obs_buf.reshape(flat, D),
+            "actions": act_buf.reshape(flat),
+            "rewards": rew_buf.reshape(flat),
+            "next_obs": next_buf.reshape(flat, D),
+            "dones": done_buf.reshape(flat),
+            "episode_returns": np.asarray(self._finished, np.float32),
+        }
+
+
+class ReplayBuffer:
+    """Flat numpy ring (reference: replay_buffers/ in rllib utils)."""
+
+    def __init__(self, capacity: int, obs_dim: int):
+        self.capacity = capacity
+        self.obs = np.zeros((capacity, obs_dim), np.float32)
+        self.next_obs = np.zeros((capacity, obs_dim), np.float32)
+        self.actions = np.zeros(capacity, np.int32)
+        self.rewards = np.zeros(capacity, np.float32)
+        self.dones = np.zeros(capacity, np.bool_)
+        self.size = 0
+        self._pos = 0
+
+    def add(self, batch: Dict[str, np.ndarray]) -> None:
+        n = len(batch["actions"])
+        idx = (self._pos + np.arange(n)) % self.capacity
+        self.obs[idx] = batch["obs"]
+        self.next_obs[idx] = batch["next_obs"]
+        self.actions[idx] = batch["actions"]
+        self.rewards[idx] = batch["rewards"]
+        self.dones[idx] = batch["dones"]
+        self._pos = int((self._pos + n) % self.capacity)
+        self.size = min(self.size + n, self.capacity)
+
+    def sample(self, rng: np.random.Generator, n: int) -> Dict[str, np.ndarray]:
+        idx = rng.integers(0, self.size, size=n)
+        return {
+            "obs": self.obs[idx],
+            "next_obs": self.next_obs[idx],
+            "actions": self.actions[idx],
+            "rewards": self.rewards[idx],
+            "dones": self.dones[idx].astype(np.float32),
+        }
+
+
+@dataclasses.dataclass
+class DQNConfig:
+    env: str = "cartpole"
+    num_workers: int = 2
+    num_envs_per_worker: int = 8
+    rollout_len: int = 64
+    buffer_size: int = 100_000
+    batch_size: int = 256
+    updates_per_iter: int = 32
+    lr: float = 1e-3
+    gamma: float = 0.99
+    target_update_freq: int = 200  # in updates
+    eps_start: float = 1.0
+    eps_end: float = 0.05
+    eps_decay_iters: int = 30
+    learning_starts: int = 1000  # transitions before updates begin
+    hidden: Tuple[int, ...] = (64, 64)
+    seed: int = 0
+
+    def build(self) -> "DQN":
+        return DQN(self)
+
+
+class DQN:
+    """Algorithm.train() parity for the off-policy family."""
+
+    def __init__(self, config: DQNConfig):
+        self.config = config
+        env = make_env(config.env, 1)
+        self.obs_dim = env.observation_dim
+        self.num_actions = env.num_actions
+        key = jax.random.PRNGKey(config.seed)
+        self.params = init_q_network(key, self.obs_dim, self.num_actions,
+                                     config.hidden)
+        self.target_params = jax.tree.map(jnp.copy, self.params)
+        self.opt = optax.adam(config.lr)
+        self.opt_state = self.opt.init(self.params)
+        self.buffer = ReplayBuffer(config.buffer_size, self.obs_dim)
+        self._rng = np.random.default_rng(config.seed)
+        self.iteration = 0
+        self.num_updates = 0
+
+        worker_cls = api.remote(DQNRolloutWorker)
+        self.workers = [
+            worker_cls.options(name=f"dqn-worker-{i}", num_cpus=1).remote(
+                config.env, config.num_envs_per_worker, config.rollout_len,
+                seed=config.seed * 1000 + i,
+            )
+            for i in range(config.num_workers)
+        ]
+        self._update_k = jax.jit(self._make_update())
+
+    def _make_update(self):
+        c = self.config
+
+        def td_loss(params, target_params, batch):
+            q = q_forward(params, batch["obs"])
+            q_taken = jnp.take_along_axis(
+                q, batch["actions"][:, None], axis=-1
+            )[:, 0]
+            # double DQN: online net picks the action, target net scores it
+            next_q_online = q_forward(params, batch["next_obs"])
+            next_act = jnp.argmax(next_q_online, axis=-1)
+            next_q_target = jnp.take_along_axis(
+                q_forward(target_params, batch["next_obs"]),
+                next_act[:, None], axis=-1,
+            )[:, 0]
+            target = batch["rewards"] + c.gamma * (1.0 - batch["dones"]) * (
+                jax.lax.stop_gradient(next_q_target)
+            )
+            td = q_taken - target
+            return jnp.mean(td * td), jnp.mean(jnp.abs(td))
+
+        def update_k(params, target_params, opt_state, batches):
+            # batches: dict of (K, B, ...) arrays; one scan = K updates
+            def body(carry, batch):
+                params, opt_state = carry
+                (loss, td_abs), grads = jax.value_and_grad(
+                    td_loss, has_aux=True
+                )(params, target_params, batch)
+                updates, opt_state = self.opt.update(grads, opt_state, params)
+                params = optax.apply_updates(params, updates)
+                return (params, opt_state), (loss, td_abs)
+
+            (params, opt_state), (losses, td_abs) = jax.lax.scan(
+                body, (params, opt_state), batches
+            )
+            return params, opt_state, losses[-1], jnp.mean(td_abs)
+
+        return update_k
+
+    def _epsilon(self) -> float:
+        c = self.config
+        frac = min(1.0, self.iteration / max(1, c.eps_decay_iters))
+        return float(c.eps_start + frac * (c.eps_end - c.eps_start))
+
+    def train(self) -> Dict[str, Any]:
+        """One iteration: sync → epsilon-greedy rollouts → replay-sampled
+        fused double-DQN updates → periodic target sync."""
+        c = self.config
+        t0 = time.perf_counter()
+        eps = self._epsilon()
+        api.get([w.set_weights.remote(self.params) for w in self.workers])
+        rollouts = api.get([w.rollout.remote(eps) for w in self.workers])
+        for r in rollouts:
+            self.buffer.add(r)
+        episode_returns = np.concatenate(
+            [r["episode_returns"] for r in rollouts]
+        )
+        loss = td_abs = float("nan")
+        if self.buffer.size >= max(c.learning_starts, c.batch_size):
+            ks = [
+                self.buffer.sample(self._rng, c.batch_size)
+                for _ in range(c.updates_per_iter)
+            ]
+            batches = {
+                k: jnp.asarray(np.stack([b[k] for b in ks])) for k in ks[0]
+            }
+            self.params, self.opt_state, loss_j, td_j = self._update_k(
+                self.params, self.target_params, self.opt_state, batches
+            )
+            loss, td_abs = float(loss_j), float(td_j)
+            prev = self.num_updates
+            self.num_updates += c.updates_per_iter
+            if self.num_updates // c.target_update_freq != prev // c.target_update_freq:
+                self.target_params = jax.tree.map(jnp.copy, self.params)
+        self.iteration += 1
+        steps = c.num_workers * c.num_envs_per_worker * c.rollout_len
+        return {
+            "training_iteration": self.iteration,
+            "episode_reward_mean": (
+                float(episode_returns.mean())
+                if episode_returns.size else float("nan")
+            ),
+            "episodes_this_iter": int(episode_returns.size),
+            "timesteps_this_iter": steps,
+            "buffer_size": self.buffer.size,
+            "epsilon": eps,
+            "td_loss": loss,
+            "td_abs": td_abs,
+            "num_updates": self.num_updates,
+            "time_this_iter_s": time.perf_counter() - t0,
+        }
+
+    def stop(self) -> None:
+        for w in self.workers:
+            try:
+                api.kill(w)
+            except Exception:
+                pass

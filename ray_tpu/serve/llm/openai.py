@@ -247,7 +247,10 @@ class OpenAIFrontend:
             for ref in stream:
                 item = core_api.get(ref, timeout=300)
                 if "token" in item:
-                    text = decoder.decode(bytes([item["token"] & 0xFF]))
+                    tok = item["token"]
+                    if not 0 <= tok < 256:
+                        continue  # same contract as ByteTokenizer.decode
+                    text = decoder.decode(bytes([tok]))
                     if not text:
                         continue  # mid-sequence: held back
                     if chat:

@@ -1,0 +1,93 @@
+"""Structured cluster events: what happened, when, where — queryable.
+
+Reference parity: the events framework under src/ray/util/ (event.h —
+severity-labeled structured events exported for the dashboard and
+post-mortem debugging) and the dashboard's event module. TPU inversion:
+an in-process ring buffer with an optional JSONL sink — the runtime's
+interesting transitions (node join/death, actor restart, failover,
+OOM kills, PG lifecycle, head restore) are emitted here by the
+components themselves, the state API/dashboard read it back, and the
+CLI can dump it. One process = one log; cluster-wide views aggregate
+over the node-log RPC like logs do.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+SEVERITIES = ("DEBUG", "INFO", "WARNING", "ERROR")
+
+
+class EventLog:
+    def __init__(self, capacity: int = 10_000,
+                 sink_path: Optional[str] = None):
+        self._buf: "deque[Dict[str, Any]]" = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        self._sink_path = sink_path
+        self._seq = 0
+
+    def emit(self, severity: str, source: str, message: str,
+             **extra: Any) -> Dict[str, Any]:
+        """Record one event. source is the emitting subsystem
+        ("cluster", "actors", "health", "autoscaler", "jobs", ...)."""
+        if severity not in SEVERITIES:
+            severity = "INFO"
+        with self._lock:
+            self._seq += 1
+            event = {
+                "seq": self._seq,
+                "ts": time.time(),
+                "severity": severity,
+                "source": source,
+                "message": message,
+                **({"extra": extra} if extra else {}),
+            }
+            self._buf.append(event)
+            sink = self._sink_path
+        if sink:
+            try:
+                with open(sink, "a") as f:
+                    f.write(json.dumps(event, default=str) + "\n")
+            except OSError:
+                pass  # a full disk must not take the runtime down
+        return event
+
+    def list(self, *, since_seq: int = 0, severity: Optional[str] = None,
+             source: Optional[str] = None, limit: int = 1000) -> List[Dict[str, Any]]:
+        with self._lock:
+            out = [
+                e for e in self._buf
+                if e["seq"] > since_seq
+                and (severity is None or e["severity"] == severity)
+                and (source is None or e["source"] == source)
+            ]
+        return out[-limit:]
+
+    def set_sink(self, path: Optional[str]) -> None:
+        with self._lock:
+            self._sink_path = path
+
+    def clear(self) -> None:
+        with self._lock:
+            self._buf.clear()
+
+
+_log: Optional[EventLog] = None
+_log_lock = threading.Lock()
+
+
+def events() -> EventLog:
+    global _log
+    with _log_lock:
+        if _log is None:
+            _log = EventLog()
+        return _log
+
+
+def emit(severity: str, source: str, message: str, **extra: Any) -> None:
+    """Module-level convenience used by runtime components."""
+    events().emit(severity, source, message, **extra)

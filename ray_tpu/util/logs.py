@@ -1,0 +1,86 @@
+"""In-process log capture for cross-node log aggregation.
+
+Reference parity: the per-node log directory + dashboard log routes
+(`ray logs`, dashboard/modules/log/) — every raylet's worker logs are
+fetchable from any driver. TPU inversion: one process per node means
+one Python logging stream per node; a ring-buffer Handler captures the
+tail, the node agent serves it over its existing RPC server
+(`node_logs`), and `ray_tpu logs` / the dashboard aggregate across the
+cluster view. Nothing is written to disk unless the user configures
+logging to do so."""
+
+from __future__ import annotations
+
+import logging
+import threading
+from collections import deque
+from typing import Dict, List, Optional
+
+
+class RingBufferHandler(logging.Handler):
+    """Keeps the last N formatted log lines in memory."""
+
+    def __init__(self, capacity: int = 5000):
+        super().__init__()
+        self._buf: "deque[str]" = deque(maxlen=capacity)
+        self._lock2 = threading.Lock()
+        self.setFormatter(logging.Formatter(
+            "%(asctime)s %(levelname)s %(name)s: %(message)s"
+        ))
+
+    def emit(self, record: logging.LogRecord) -> None:
+        try:
+            line = self.format(record)
+        except Exception:  # noqa: BLE001 - logging must never raise
+            return
+        with self._lock2:
+            self._buf.append(line)
+
+    def tail(self, n: int = 200) -> List[str]:
+        with self._lock2:
+            return list(self._buf)[-n:]
+
+
+_handler: Optional[RingBufferHandler] = None
+_install_lock = threading.Lock()
+
+
+def install(capacity: int = 5000) -> RingBufferHandler:
+    """Attach the capture handler (idempotent). It hangs off the
+    "ray_tpu" logger — whose level is raised to INFO if unset, since the
+    root default of WARNING would filter the runtime's INFO records at
+    the LOGGER before any handler ran — plus the root logger for
+    WARNING+ from everything else. User console verbosity is untouched:
+    the stdlib lastResort console handler still gates at WARNING."""
+    global _handler
+    with _install_lock:
+        if _handler is None:
+            _handler = RingBufferHandler(capacity)
+            _handler.setLevel(logging.INFO)
+            # Logger levels gate at the EMITTING logger; propagation then
+            # reaches ancestor HANDLERS unconditionally — so raising the
+            # package logger to INFO + one handler on root captures
+            # ray_tpu INFO and everyone's WARNING+ exactly once.
+            pkg = logging.getLogger("ray_tpu")
+            if pkg.level == logging.NOTSET:
+                pkg.setLevel(logging.INFO)
+            logging.getLogger().addHandler(_handler)
+        return _handler
+
+
+def tail(n: int = 200) -> List[str]:
+    """Last n captured lines of THIS process."""
+    return _handler.tail(n) if _handler is not None else []
+
+
+def cluster_tail(runtime, n: int = 200) -> Dict[str, List[str]]:
+    """Log tails for every cluster node, keyed by node id hex: this
+    process's buffer plus each agent's over the node_logs RPC."""
+    ctx = getattr(runtime, "cluster", None)
+    if ctx is None:
+        return {"local": tail(n)}
+    out = ctx.fanout_nodes(
+        "node_logs", n, placeholder=lambda e: [f"<unreachable: {e!r}>"]
+    )
+    out[ctx.node_id.hex()] = tail(n)
+    return out

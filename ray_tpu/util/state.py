@@ -103,3 +103,36 @@ def chrome_tracing_dump(path: Optional[str] = None) -> str:
         with open(path, "w") as f:
             f.write(payload)
     return payload
+
+
+def list_events(limit: int = 500, severity: Optional[str] = None,
+                source: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Structured runtime events of THIS process (util/events.py)."""
+    from .events import events
+
+    return events().list(limit=limit, severity=severity, source=source)
+
+
+def cluster_events(limit: int = 500) -> Dict[str, List[Dict[str, Any]]]:
+    """Event tails for every cluster node, keyed by node id hex."""
+    rt = _runtime()
+    ctx = getattr(rt, "cluster", None)
+    if ctx is None:
+        return {"local": list_events(limit=limit)}
+    out = ctx.fanout_nodes(
+        "node_events", 0, limit,
+        placeholder=lambda e: [
+            {"severity": "ERROR", "source": "state",
+             "message": f"unreachable: {e!r}"}
+        ],
+    )
+    out[ctx.node_id.hex()] = list_events(limit=limit)
+    return out
+
+
+def cluster_logs(tail: int = 200) -> Dict[str, List[str]]:
+    """Log tails for every cluster node, keyed by node id hex
+    (reference: `ray logs` over the dashboard's per-node log routes)."""
+    from . import logs
+
+    return logs.cluster_tail(_runtime(), tail)

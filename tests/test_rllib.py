@@ -82,3 +82,49 @@ def test_custom_env_registration():
 
     with pytest.raises(ValueError, match="unknown env"):
         PPOConfig(env="nope").build()
+
+
+def test_dqn_learns_cartpole():
+    """The off-policy family: double-DQN with replay must clearly beat
+    the random baseline (reference rllib/algorithms/dqn)."""
+    from ray_tpu.rllib import DQNConfig
+
+    algo = DQNConfig(
+        env="cartpole", num_workers=2, num_envs_per_worker=8,
+        rollout_len=64, lr=1e-3, updates_per_iter=48,
+        learning_starts=512, eps_decay_iters=12, seed=0,
+    ).build()
+    try:
+        result = None
+        recent = []
+        for _ in range(30):
+            result = algo.train()
+            if result["episodes_this_iter"] > 0:
+                recent.append(result["episode_reward_mean"])
+        assert result["training_iteration"] == 30
+        assert result["buffer_size"] > 512
+        assert result["num_updates"] > 0
+        # random CartPole averages ~20; the late-training mean must
+        # clearly clear it (DQN is noisier than PPO, so average the tail)
+        tail = float(np.mean(recent[-5:]))
+        assert tail > 60.0, (recent[:5], recent[-5:])
+    finally:
+        algo.stop()
+
+
+def test_dqn_replay_buffer_ring():
+    from ray_tpu.rllib import ReplayBuffer
+
+    buf = ReplayBuffer(capacity=10, obs_dim=2)
+    batch = {
+        "obs": np.arange(8).reshape(4, 2).astype(np.float32),
+        "next_obs": np.zeros((4, 2), np.float32),
+        "actions": np.arange(4, dtype=np.int32),
+        "rewards": np.ones(4, np.float32),
+        "dones": np.zeros(4, np.bool_),
+    }
+    for _ in range(4):  # 16 adds into capacity 10: wraps
+        buf.add(batch)
+    assert buf.size == 10
+    s = buf.sample(np.random.default_rng(0), 6)
+    assert s["obs"].shape == (6, 2) and s["dones"].dtype == np.float32
