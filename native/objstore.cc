@@ -14,9 +14,12 @@
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
+#include <fcntl.h>
 #include <list>
 #include <map>
 #include <mutex>
+#include <sys/mman.h>
+#include <unistd.h>
 #include <unordered_map>
 
 namespace {
@@ -36,6 +39,8 @@ struct FreeBlock {
 
 struct Arena {
   char* base = nullptr;
+  bool mapped = false;  // base is an mmap of backing_fd (shared arena)
+  int backing_fd = -1;
   uint64_t capacity = 0;
   uint64_t used = 0;
   // offset -> free block size, ordered for coalescing
@@ -98,10 +103,45 @@ void* store_create_arena(uint64_t capacity) {
   return a;
 }
 
+// Cross-process arena: the payload pages live in a file (put it under
+// /dev/shm) mapped MAP_SHARED, so worker processes can mmap the same
+// file and read sealed objects ZERO-COPY by (offset, size) descriptor —
+// the reference's plasma client protocol (plasma/store.h:55,
+// client.cc mmap of the store's fd), minus the socket: descriptors ride
+// the existing worker pipes, and allocation stays owner-side.
+void* store_create_arena_shared(uint64_t capacity, const char* path) {
+  int fd = ::open(path, O_CREAT | O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  if (::ftruncate(fd, static_cast<off_t>(capacity)) != 0) {
+    ::close(fd);
+    ::unlink(path);  // never leave a zero/partial tmpfs file behind
+    return nullptr;
+  }
+  void* base = ::mmap(nullptr, capacity, PROT_READ | PROT_WRITE,
+                      MAP_SHARED, fd, 0);
+  if (base == MAP_FAILED) {
+    ::close(fd);
+    ::unlink(path);
+    return nullptr;
+  }
+  auto* a = new Arena();
+  a->base = static_cast<char*>(base);
+  a->mapped = true;
+  a->backing_fd = fd;
+  a->capacity = capacity;
+  a->free_blocks[0] = capacity;
+  return a;
+}
+
 void store_destroy_arena(void* handle) {
   auto* a = static_cast<Arena*>(handle);
   if (a == nullptr) return;
-  std::free(a->base);
+  if (a->mapped) {
+    ::munmap(a->base, a->capacity);
+    if (a->backing_fd >= 0) ::close(a->backing_fd);
+  } else {
+    std::free(a->base);
+  }
   delete a;
 }
 
@@ -154,7 +194,7 @@ int store_make_evictable(void* handle, uint64_t id) {
 
 // Bumped whenever an exported signature or behavior changes; the Python
 // binding refuses to drive a stale prebuilt .so (it rebuilds instead).
-uint64_t store_abi_version(void* /*unused*/) { return 2; }
+uint64_t store_abi_version(void* /*unused*/) { return 3; }
 
 // Pins the object and returns its offset (-1 if absent/unsealed). Pinned
 // objects are never eviction candidates.

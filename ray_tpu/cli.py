@@ -122,6 +122,25 @@ def _observer_init(args):
     )
 
 
+def _cmd_up(args) -> int:
+    """`ray up` equivalent over the launcher's provider abstraction
+    (reference: python/ray/autoscaler/_private/commands.py)."""
+    from .launcher import up_from_cli
+
+    info = up_from_cli(args.config, no_tpu=args.no_tpu)
+    print(f"cluster up: {len(info['nodes'])} nodes, head at {info['address']}")
+    print(f"connect with: ray_tpu.init(address={info['address']!r})")
+    return 0
+
+
+def _cmd_down(args) -> int:
+    from .launcher import down_from_cli
+
+    stopped = down_from_cli(args.config)
+    print(f"stopped {stopped} nodes")
+    return 0
+
+
 def _cmd_logs(args) -> int:
     """Aggregate log tails across the cluster (reference: `ray logs`
     routed through the per-node dashboard agents)."""
@@ -257,6 +276,11 @@ def build_parser() -> argparse.ArgumentParser:
         jx = jsub.add_parser(name)
         jx.add_argument("job_id")
 
+    up = sub.add_parser("up", help="launch a cluster from a config file")
+    up.add_argument("config", help="cluster YAML/JSON (see ray_tpu/launcher.py)")
+    dn = sub.add_parser("down", help="terminate a cluster started with `up`")
+    dn.add_argument("config")
+
     lp = sub.add_parser("logs", help="tail logs from every cluster node")
     lp.add_argument("--address", help="head GCS address to join as observer")
     lp.add_argument("--tail", type=int, default=50)
@@ -283,6 +307,8 @@ def main(argv=None) -> int:
         "config": _cmd_config,
         "status": _cmd_status,
         "job": _cmd_job,
+        "up": _cmd_up,
+        "down": _cmd_down,
         "logs": _cmd_logs,
         "events": _cmd_events,
         "timeline": _cmd_timeline,

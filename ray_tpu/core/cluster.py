@@ -1711,24 +1711,17 @@ class ClusterContext:
             # Args that shipped as refs (big/remote: arg locality) pull
             # NOW, on the executing node, over the transfer plane — the
             # borrow registered at unpickle time pins them at the owner.
-            task_args = _resolve(tuple(msg["args"]), self.runtime.object_store)
-            task_kwargs = _resolve(dict(msg["kwargs"]), self.runtime.object_store)
             renv = msg.get("runtime_env")
+            store = self.runtime.object_store
             if msg.get("executor") == "process":
-                from .worker_pool import get_worker_pool
+                from .worker_pool import execute_process_task
 
-                env_vars = dict((renv or {}).get("env_vars") or {})
-                py_modules = (renv or {}).get("py_modules") or []
-                if py_modules:
-                    existing = env_vars.get("PYTHONPATH", os.environ.get("PYTHONPATH", ""))
-                    env_vars["PYTHONPATH"] = os.pathsep.join(
-                        list(py_modules) + ([existing] if existing else [])
-                    )
-                result = get_worker_pool().execute(
-                    msg["func"], task_args, task_kwargs, env_vars=env_vars,
-                    working_dir=(renv or {}).get("working_dir"),
+                result = execute_process_task(
+                    store, msg["func"], msg["args"], msg["kwargs"], renv
                 )
             else:
+                task_args = _resolve(tuple(msg["args"]), store)
+                task_kwargs = _resolve(dict(msg["kwargs"]), store)
                 with _renv.applied(renv):
                     result = msg["func"](*task_args, **task_kwargs)
             if msg["num_returns"] == 1:

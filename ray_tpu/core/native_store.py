@@ -28,7 +28,7 @@ _lib_lock = threading.Lock()
 # Must match store_abi_version() in native/objstore.cc. A stale prebuilt
 # .so (artifacts are not in VCS) would otherwise be driven with the wrong
 # signatures — silently, via ctypes.
-_ABI_VERSION = 2
+_ABI_VERSION = 3
 
 
 def _try_build() -> bool:
@@ -71,6 +71,10 @@ def _load_lib() -> Optional[ctypes.CDLL]:
         lib = ctypes.CDLL(_LIB_PATH)
         lib.store_create_arena.restype = ctypes.c_void_p
         lib.store_create_arena.argtypes = [ctypes.c_uint64]
+        lib.store_create_arena_shared.restype = ctypes.c_void_p
+        lib.store_create_arena_shared.argtypes = [
+            ctypes.c_uint64, ctypes.c_char_p
+        ]
         lib.store_destroy_arena.argtypes = [ctypes.c_void_p]
         lib.store_create.restype = ctypes.c_int64
         lib.store_create.argtypes = [ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64]
@@ -112,14 +116,26 @@ class NativeArena:
     """One process-local arena. Not a singleton: the tiered ObjectStore owns
     one as its shared-memory tier; tests create scratch arenas freely."""
 
-    def __init__(self, capacity: int):
+    def __init__(self, capacity: int, path: "Optional[str]" = None):
+        """path=None: process-private malloc arena. path=str: the arena
+        pages live in that file (put it under /dev/shm) mapped
+        MAP_SHARED — worker processes mmap the same file and read sealed
+        payloads zero-copy via (offset, size) descriptors (the plasma
+        client protocol, plasma/store.h:55; descriptors ride the worker
+        pipes instead of a unix socket)."""
         lib = _load_lib()
         if lib is None:
             raise RuntimeError(
                 "native object store unavailable (build failed / no g++)"
             )
         self._lib = lib
-        self._arena = lib.store_create_arena(capacity)
+        self.path = path
+        if path is None:
+            self._arena = lib.store_create_arena(capacity)
+        else:
+            self._arena = lib.store_create_arena_shared(
+                capacity, path.encode()
+            )
         if not self._arena:
             raise MemoryError(f"cannot allocate {capacity}-byte arena")
         self._base = lib.store_base(self._arena)
@@ -155,6 +171,21 @@ class NativeArena:
             return None
         buf = (ctypes.c_char * size.value).from_address(self._base + offset)
         return memoryview(buf)
+
+    def descriptor(self, object_id: int):
+        """(path, offset, size) of a sealed object, PINNED until
+        release_descriptor — the cross-process handle a worker mmaps.
+        None for private arenas or absent objects."""
+        if self.path is None:
+            return None
+        size = ctypes.c_uint64()
+        offset = self._lib.store_get(self._arena, object_id, ctypes.byref(size))
+        if offset < 0:
+            return None
+        return (self.path, int(offset), int(size.value))
+
+    def release_descriptor(self, object_id: int) -> None:
+        self.unpin(object_id)
 
     def unpin(self, object_id: int) -> None:
         self._lib.store_unpin(self._arena, object_id)
@@ -214,6 +245,11 @@ class NativeArena:
     def close(self) -> None:
         if not self._closed:
             self._lib.store_destroy_arena(self._arena)
+            if self.path is not None:
+                try:
+                    os.unlink(self.path)
+                except OSError:
+                    pass
             self._closed = True
 
     def __del__(self):
@@ -221,3 +257,57 @@ class NativeArena:
             self.close()
         except Exception:
             pass
+
+
+# ------------------------------------------------------- cross-process views
+
+
+_worker_mmaps: dict = {}
+_worker_mmaps_lock = threading.Lock()
+
+
+def _materialize_view(path: str, offset: int, count: int, dtype_str: str,
+                      shape: tuple):
+    """Worker-side half of the descriptor protocol: mmap the arena file
+    once per process (read-only) and return a zero-copy numpy view of
+    the sealed payload. Objects are immutable (plasma semantics): the
+    returned array is read-only; mutate via .copy()."""
+    import mmap as _mmap
+
+    import numpy as np
+
+    with _worker_mmaps_lock:
+        mm = _worker_mmaps.get(path)
+        if mm is None:
+            fd = os.open(path, os.O_RDONLY)
+            try:
+                mm = _mmap.mmap(fd, 0, prot=_mmap.PROT_READ)
+            finally:
+                os.close(fd)
+            _worker_mmaps[path] = mm
+    arr = np.frombuffer(
+        mm, dtype=np.dtype(dtype_str), count=count, offset=offset
+    )
+    return arr.reshape(shape)
+
+
+class ShmView:
+    """Pickles as a descriptor, unpickles as a read-only zero-copy numpy
+    view over the shared arena (the plasma client handoff: bytes never
+    cross the worker pipe)."""
+
+    __slots__ = ("path", "offset", "count", "dtype_str", "shape")
+
+    def __init__(self, path: str, offset: int, count: int, dtype_str: str,
+                 shape: tuple):
+        self.path = path
+        self.offset = offset
+        self.count = count
+        self.dtype_str = dtype_str
+        self.shape = tuple(shape)
+
+    def __reduce__(self):
+        return (
+            _materialize_view,
+            (self.path, self.offset, self.count, self.dtype_str, self.shape),
+        )

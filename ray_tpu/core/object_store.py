@@ -151,6 +151,33 @@ class ObjectEntry:
         self.custodial = False
 
 
+def _reap_stale_arenas(shm_dir: str) -> None:
+    """Unlink arena files whose owning process is gone: a SIGKILLed
+    driver must not leak RAM-backed tmpfs files forever (the names embed
+    the creator's pid exactly so this sweep can tell)."""
+    try:
+        names = os.listdir(shm_dir)
+    except OSError:
+        return
+    for name in names:
+        if not name.startswith("ray_tpu_arena_"):
+            continue
+        parts = name.split("_")
+        try:
+            pid = int(parts[3])
+        except (IndexError, ValueError):
+            continue
+        try:
+            os.kill(pid, 0)  # probe: raises if the pid is gone
+        except ProcessLookupError:
+            try:
+                os.unlink(os.path.join(shm_dir, name))
+            except OSError:
+                pass
+        except PermissionError:
+            pass  # someone else's live process
+
+
 class ObjectStore:
     """Thread-safe object table with futures semantics and LRU spilling."""
 
@@ -176,10 +203,25 @@ class ObjectStore:
         self._arena = None
         if cfg.native_store:
             try:
+                import tempfile
+                import uuid as _uuid
+
                 from .native_store import NativeArena, native_available
 
                 if native_available():
-                    self._arena = NativeArena(capacity_bytes)
+                    # SHARED arena file (plasma-style): worker processes
+                    # mmap it and read sealed payloads zero-copy via
+                    # descriptors (resolve_process_args below)
+                    shm_dir = (
+                        "/dev/shm" if os.path.isdir("/dev/shm")
+                        else tempfile.gettempdir()
+                    )
+                    _reap_stale_arenas(shm_dir)
+                    path = os.path.join(
+                        shm_dir,
+                        f"ray_tpu_arena_{os.getpid()}_{_uuid.uuid4().hex[:8]}",
+                    )
+                    self._arena = NativeArena(capacity_bytes, path=path)
             except Exception:
                 self._arena = None
         self._shm_entries: Dict[int, ObjectID] = {}  # arena id -> object id
@@ -967,6 +1009,57 @@ class ObjectStore:
             self._host_bytes += entry.nbytes
         self.stats["restores"] += 1
         return value
+
+    # -------------------------------------------------- process-worker views
+
+    def resolve_process_args(self, container):
+        """Resolve task args for a PROCESS-executor worker: SHM-tier
+        numpy values become pinned zero-copy descriptors (ShmView) the
+        child mmaps instead of receiving pickled bytes over the pipe —
+        the plasma client handoff (plasma/store.h:55). Everything else
+        resolves by value like _resolve. Returns (resolved, release):
+        call release() after the worker finishes to drop the pins."""
+        from .native_store import ShmView
+        from .runtime import ObjectRef
+
+        pinned: List[int] = []
+        arena = self._arena
+
+        def one(value):
+            if not isinstance(value, ObjectRef):
+                return value
+            entry = self.entry(value.object_id)
+            if (
+                arena is not None
+                and arena.path is not None
+                and entry is not None
+                and entry.state == ObjectState.READY
+                and entry.tier == Tier.SHM
+            ):
+                _, aid, dtype_str, shape = entry.value
+                desc = arena.descriptor(aid)  # pins; None if evicted
+                if desc is not None:
+                    import numpy as np
+
+                    path, offset, size = desc
+                    pinned.append(aid)
+                    count = size // np.dtype(dtype_str).itemsize
+                    return ShmView(path, offset, count, dtype_str, shape)
+            return self.get(value.object_id)
+
+        def release() -> None:
+            for aid in pinned:
+                arena.release_descriptor(aid)
+
+        try:
+            if isinstance(container, tuple):
+                resolved = tuple(one(v) for v in container)
+            else:
+                resolved = {k: one(v) for k, v in container.items()}
+        except BaseException:
+            release()  # pins taken before the failing arg must not leak
+            raise
+        return resolved, release
 
     # ------------------------------------------------------------------ intro
 

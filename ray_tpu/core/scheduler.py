@@ -872,27 +872,19 @@ class ClusterScheduler:
             from . import chaos, runtime_env as _renv
 
             chaos.maybe_inject(spec.name)
-            args = _resolve(spec.args, self._store)
-            kwargs = _resolve(spec.kwargs, self._store)
             if spec.executor == "process":
-                # Run in a pooled worker process (GIL-free). env_vars are
-                # set in the child's environment — true isolation, no
-                # process-global lock; py_modules extend the child's path
-                # via PYTHONPATH.
-                from .worker_pool import get_worker_pool
+                # Pooled worker process (GIL-free); SHM-tier args ship
+                # as zero-copy arena descriptors (plasma handoff). One
+                # shared implementation with the cluster agent path.
+                from .worker_pool import execute_process_task
 
-                env_vars = dict((spec.runtime_env or {}).get("env_vars") or {})
-                py_modules = (spec.runtime_env or {}).get("py_modules") or []
-                if py_modules:
-                    existing = env_vars.get("PYTHONPATH", os.environ.get("PYTHONPATH", ""))
-                    env_vars["PYTHONPATH"] = os.pathsep.join(
-                        list(py_modules) + ([existing] if existing else [])
-                    )
-                result = get_worker_pool().execute(
-                    spec.func, args, kwargs, env_vars=env_vars,
-                    working_dir=(spec.runtime_env or {}).get("working_dir"),
+                result = execute_process_task(
+                    self._store, spec.func, spec.args, spec.kwargs,
+                    spec.runtime_env,
                 )
             else:
+                args = _resolve(spec.args, self._store)
+                kwargs = _resolve(spec.kwargs, self._store)
                 with _renv.applied(spec.runtime_env):
                     result = spec.func(*args, **kwargs)
             self._seal_returns(spec, result)

@@ -404,3 +404,36 @@ def shutdown_worker_pool() -> None:
         if _pool is not None:
             _pool.shutdown()
             _pool = None
+
+
+def execute_process_task(store, func, args, kwargs, runtime_env):
+    """One implementation of the process-executor dispatch for BOTH the
+    local scheduler and the cluster agent: resolve args (SHM-tier values
+    become pinned zero-copy arena descriptors — the plasma handoff),
+    assemble the child environment from the runtime_env, execute on the
+    pooled worker, and release the pins on every path."""
+    import os as _os
+
+    renv = runtime_env or {}
+    release_a = release_k = None
+    try:
+        resolved_args, release_a = store.resolve_process_args(tuple(args))
+        resolved_kwargs, release_k = store.resolve_process_args(dict(kwargs))
+        env_vars = dict(renv.get("env_vars") or {})
+        py_modules = renv.get("py_modules") or []
+        if py_modules:
+            existing = env_vars.get(
+                "PYTHONPATH", _os.environ.get("PYTHONPATH", "")
+            )
+            env_vars["PYTHONPATH"] = _os.pathsep.join(
+                list(py_modules) + ([existing] if existing else [])
+            )
+        return get_worker_pool().execute(
+            func, resolved_args, resolved_kwargs, env_vars=env_vars,
+            working_dir=renv.get("working_dir"),
+        )
+    finally:
+        if release_a is not None:
+            release_a()
+        if release_k is not None:
+            release_k()

@@ -1,0 +1,130 @@
+"""Cluster launcher: `ray_tpu up/down <config>` over the provider
+abstraction (reference: `ray up`, autoscaler/_private/commands.py).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+
+def _write_config(tmp_path, n_workers=2):
+    config = {
+        "head": {"port": 0, "num_cpus": 1},
+        "workers": [
+            {"host": "localhost", "num_cpus": 2,
+             "resources": {"pet": 1}}
+            for _ in range(n_workers)
+        ],
+        "provider": "local",
+    }
+    path = tmp_path / "cluster.yaml"
+    import yaml
+
+    path.write_text(yaml.safe_dump(config))
+    return str(path), config
+
+
+def test_up_launches_and_down_terminates(tmp_path):
+    from ray_tpu.launcher import ClusterLauncher, load_config
+
+    # port 0 is invalid for a rendezvous address: pick a free one
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    path, config = _write_config(tmp_path)
+    config["head"]["port"] = port
+    launcher = ClusterLauncher(config, no_tpu=True)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    try:
+        info = launcher.up(wait_s=90)
+        assert info["address"].endswith(f":{port}")
+        assert len(info["nodes"]) == 3
+        # a driver can use the launched cluster
+        import ray_tpu
+
+        ray_tpu.init(address=info["address"], num_cpus=0,
+                     detect_accelerators=False)
+        deadline = time.monotonic() + 60
+        while ray_tpu.cluster_resources().get("pet", 0) < 2:
+            assert time.monotonic() < deadline
+            time.sleep(0.2)
+
+        @ray_tpu.remote(num_cpus=0, resources={"pet": 1})
+        def where():
+            return os.getpid()
+
+        pid = ray_tpu.get(where.remote(), timeout=60)
+        assert pid in [n["pid"] for n in info["nodes"]]
+        ray_tpu.shutdown()
+    finally:
+        launcher.down()
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if all(p.poll() is not None for p in launcher.provider.procs):
+            break
+        time.sleep(0.2)
+    assert all(p.poll() is not None for p in launcher.provider.procs)
+
+
+def test_ssh_provider_command_construction():
+    from ray_tpu.launcher import SSHLaunchProvider, _start_cmd
+
+    provider = SSHLaunchProvider({
+        "ssh_user": "me", "workers": [{"host": "10.0.0.2"}],
+    })
+    cmd = _start_cmd(
+        address="10.0.0.1:6379", port=None, num_cpus=8,
+        resources={"TPU": 4}, token="sekrit", no_tpu=False,
+    )
+    full = provider.ssh_command("10.0.0.2", cmd)
+    assert full[0] == "ssh"
+    assert "me@10.0.0.2" in full
+    remote = full[-1]
+    assert "--address 10.0.0.1:6379" in remote
+    assert "--num-cpus 8" in remote
+    assert "--token sekrit" in remote
+    assert remote.startswith("nohup ")
+    assert "'{\"TPU\": 4}'" in remote  # resources JSON is shell-quoted
+
+
+def test_unknown_provider_rejected():
+    from ray_tpu.launcher import ClusterLauncher
+
+    with pytest.raises(ValueError, match="unknown provider"):
+        ClusterLauncher({"provider": "gcp"})
+
+
+def test_cli_up_down_roundtrip(tmp_path):
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    config = {
+        "head": {"port": port, "num_cpus": 1},
+        "workers": [{"host": "localhost", "num_cpus": 1}],
+        "provider": "local",
+    }
+    path = tmp_path / "c.json"
+    path.write_text(json.dumps(config))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    up = subprocess.run(
+        [sys.executable, "-m", "ray_tpu", "--no-tpu", "up", str(path)],
+        capture_output=True, text=True, timeout=180, env=env,
+    )
+    try:
+        assert up.returncode == 0, up.stdout + up.stderr
+        assert "cluster up: 2 nodes" in up.stdout
+    finally:
+        down = subprocess.run(
+            [sys.executable, "-m", "ray_tpu", "--no-tpu", "down", str(path)],
+            capture_output=True, text=True, timeout=60, env=env,
+        )
+    assert down.returncode == 0, down.stdout + down.stderr
+    assert "stopped 2 nodes" in down.stdout
