@@ -256,6 +256,9 @@ def build_parser() -> argparse.ArgumentParser:
                     help='node labels as JSON, e.g. \'{"zone": "us-a"}\'')
     st.add_argument("--token", default=None,
                     help="cluster auth token (required off-localhost)")
+    st.add_argument("--launch-tag", default=None,
+                    help="opaque tag embedded in the cmdline so the "
+                         "launcher's `down` can target this cluster only")
     st.add_argument("--snapshot-path", default=None,
                     help="GCS snapshot file: the head persists its tables "
                          "here (same as RAY_TPU_GCS_SNAPSHOT_PATH)")

@@ -493,6 +493,7 @@ class ClusterContext:
         self.server.register("poll_task_done", self._poll_task_done)
         self.server.register("reserve_bundle", self._reserve_bundle)
         self.server.register("release_bundle", self._release_bundle)
+        self.server.register("stream_item", self._stream_item)
         self.server.register("node_logs", self._node_logs)
         self.server.register("node_events", self._node_events)
         self.address = self.server.address
@@ -831,6 +832,8 @@ class ClusterContext:
                 "bundle": bundle_key,
                 "runtime_env": spec.runtime_env,
                 "executor": spec.executor,
+                "streaming": spec.streaming,
+                "stream_max_backlog": spec.stream_max_backlog,
                 "reply_addr": self.address,
             })
             reply = node.client.call("execute_task", blob)
@@ -909,8 +912,71 @@ class ClusterContext:
                     nbytes=status[2] if len(status) > 2 else 0,
                 )
             # "pushed": the push RPC already sealed the value
+        if spec.streaming:
+            stream = spec.live_stream()
+            if stream is not None:
+                stream._finish()  # end-of-stream for the consumer
         self.runtime.scheduler.finish_remote(spec, node, pool)
         return "ok"
+
+    def _stream_item(self, task_hex: str, idx: int, oid_hex: str,
+                     status) -> str:
+        """One yield of a remotely-executing streaming generator
+        (reference: ObjectRefStream item reporting, core_worker.h:273).
+        Small values were pushed (sealed) on the same ordered connection
+        just before this call; big ones seal as remote placeholders.
+        The REPLY is the backpressure: it blocks while the consumer's
+        backlog is full, and "stale" tells the producer to stop."""
+        with self._lock:
+            rec = self._pending.get(task_hex)
+        if rec is None:
+            return "stale"  # failed over / finished: stop producing
+        spec = rec.spec
+        oid = ObjectID(oid_hex)
+        store = self.runtime.object_store
+        store.create(oid, owner_task=spec)  # lineage: reconstructable
+        if status[0] == "remote":
+            store.seal_remote(
+                oid, status[1], nbytes=status[2] if len(status) > 2 else 0
+            )
+        if oid not in spec.return_ids:
+            spec.return_ids.append(oid)
+        stream = spec.live_stream()
+        if stream is None:
+            # the consumer dropped the generator: stop the producer and
+            # close the task out CLEANLY — this is abandonment, not an
+            # agent failure, and must not trigger resubmission
+            self._finish_stream_task(task_hex)
+            return "stale"
+        if idx >= stream._appended:
+            stream._append_oid(oid)
+        if spec.stream_max_backlog:
+            try:
+                # SHORT wait; a still-full backlog answers "backlogged"
+                # and the producer re-sends the (idempotent) item — a
+                # merely-slow consumer paces the stream indefinitely,
+                # matching local semantics, without pinning this server
+                # thread or tripping the producer's socket timeout
+                stream._wait_backlog(spec.stream_max_backlog, timeout=30)
+            except RuntimeError:
+                self._finish_stream_task(task_hex)
+                return "stale"  # consumer abandoned mid-wait
+            except TimeoutError:
+                return "backlogged"
+        return "ok"
+
+    def _finish_stream_task(self, task_hex: str) -> None:
+        """Close out a streaming task whose consumer went away: pop the
+        pending record (so the poll loop never declares a false agent
+        death) and finish the stream + scheduler bookkeeping cleanly."""
+        with self._lock:
+            rec = self._pending.pop(task_hex, None)
+        if rec is None:
+            return
+        stream = rec.spec.live_stream()
+        if stream is not None:
+            stream._finish()
+        self.runtime.scheduler.finish_remote(rec.spec, rec.node, rec.pool)
 
     # --------------------------------------------- owner-side result recovery
 
@@ -1707,6 +1773,9 @@ class ClusterContext:
         from . import runtime_env as _renv
 
         task_hex = msg["task_hex"]
+        if msg.get("streaming"):
+            self._run_agent_streaming(msg)
+            return
         try:
             # Args that shipped as refs (big/remote: arg locality) pull
             # NOW, on the executing node, over the transfer plane — the
@@ -1764,6 +1833,89 @@ class ClusterContext:
         self._deliver_with_retry(
             task_hex, msg["reply_addr"], deliver,
             park=lambda: self._park_values(msg, values),
+        )
+
+    def _run_agent_streaming(self, msg: Dict[str, Any]) -> None:
+        """Execute a streaming generator HERE, delivering each yield to
+        the owner as it is produced: small values push + stream_item,
+        big values seal custodially and ship a placeholder. The
+        stream_item reply carries the owner's backpressure, so it rides
+        a DEDICATED connection — blocking it must not head-of-line
+        block other tasks' completions on the shared reply client."""
+        from . import runtime_env as _renv
+        from .config import cfg
+        from .ids import TaskID
+        from .object_store import _estimate_nbytes
+
+        task_hex = msg["task_hex"]
+        task_id = TaskID(task_hex)
+        store = self.runtime.object_store
+        client = RpcClient(
+            msg["reply_addr"], timeout=600.0, retries=0, token=self.token
+        )
+        try:
+            try:
+                task_args = _resolve(tuple(msg["args"]), store)
+                task_kwargs = _resolve(dict(msg["kwargs"]), store)
+                with _renv.applied(msg.get("runtime_env")):
+                    result = msg["func"](*task_args, **task_kwargs)
+                    if not hasattr(result, "__iter__"):
+                        raise TypeError(
+                            f"streaming task {msg['name']} must return an "
+                            f"iterable/generator, got {type(result).__name__}"
+                        )
+                    for idx, item in enumerate(result):
+                        oid = ObjectID.for_task_return(task_id, idx)
+                        if _estimate_nbytes(item) <= cfg.remote_inline_max_bytes:
+                            push_object(
+                                msg["reply_addr"], oid.hex(), item,
+                                client=client,
+                            )
+                            status = ("pushed", None)
+                        else:
+                            entry = store.create(oid)
+                            entry.custodial = True
+                            store.seal(oid, item)
+                            try:
+                                self.gcs.kv_put(
+                                    oid.hex(), self.address,
+                                    namespace=OBJDIR_NS,
+                                )
+                            except (RpcError, OSError):
+                                pass
+                            status = (
+                                "remote", self.address, _estimate_nbytes(item)
+                            )
+                        while True:
+                            reply = client.call(
+                                "stream_item", task_hex, idx, oid.hex(),
+                                status,
+                            )
+                            if reply != "backlogged":
+                                break
+                            # owner's consumer is slow, not gone: re-send
+                            # (idempotent by idx) and wait again
+                        if reply == "stale":
+                            # owner failed over or the consumer abandoned
+                            # the stream: stop producing
+                            with self._lock:
+                                self._agent_running.discard(task_hex)
+                            return
+            except BaseException as exc:  # noqa: BLE001 - ferried to owner
+                tb = (
+                    getattr(exc, "remote_traceback", None)
+                    or traceback.format_exc()
+                )
+                self._reply_error(msg, exc, tb)
+                return
+        finally:
+            client.close()
+        self._deliver_with_retry(
+            task_hex, msg["reply_addr"],
+            lambda: self._reply_client(msg["reply_addr"]).call(
+                "task_done", task_hex, [], None
+            ),
+            park=lambda: self._park(task_hex, [], None, []),
         )
 
     def _park_values(self, msg: Dict[str, Any], values: List[Any]) -> None:

@@ -1029,15 +1029,19 @@ class ObjectStore:
             if not isinstance(value, ObjectRef):
                 return value
             entry = self.entry(value.object_id)
-            if (
-                arena is not None
-                and arena.path is not None
-                and entry is not None
-                and entry.state == ObjectState.READY
-                and entry.tier == Tier.SHM
-            ):
-                _, aid, dtype_str, shape = entry.value
-                desc = arena.descriptor(aid)  # pins; None if evicted
+            if arena is not None and arena.path is not None and entry is not None:
+                # under entry.lock like every reader: a concurrent arena
+                # eviction flips value/state, and an unlocked unpack of
+                # entry.value would race it
+                with entry.lock:
+                    if (
+                        entry.state == ObjectState.READY
+                        and entry.tier == Tier.SHM
+                    ):
+                        _, aid, dtype_str, shape = entry.value
+                        desc = arena.descriptor(aid)  # pins; None if evicted
+                    else:
+                        desc = None
                 if desc is not None:
                     import numpy as np
 

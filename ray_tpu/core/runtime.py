@@ -384,12 +384,15 @@ class Runtime:
             stream_max_backlog=stream_max_backlog,
         )
         if streaming:
-            spec.stream = ObjectRefGenerator(task_id, self)
+            import weakref
+
+            gen = ObjectRefGenerator(task_id, self)
+            spec.stream = weakref.ref(gen)
         for oid in return_ids:
             self.object_store.create(oid, owner_task=spec)
         self.scheduler.submit(spec)
         if streaming:
-            return spec.stream
+            return gen
         refs = [ObjectRef(oid, self) for oid in return_ids]
         return refs[0] if num_returns == 1 else refs
 

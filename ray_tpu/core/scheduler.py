@@ -117,7 +117,10 @@ class TaskSpec:
     # seal into dynamic return ids and flow through `stream`
     # (reference: ObjectRefStream, core_worker.h:273)
     streaming: bool = False
-    stream: Any = None  # ObjectRefGenerator (producer half)
+    # weakref.ref to the consumer's ObjectRefGenerator: the spec must NOT
+    # keep it alive, or consumer abandonment could never be detected
+    # (the backpressured producer would block forever)
+    stream: Any = None
     # producer flow control: block when the consumer lags this many items
     # behind (None = unbounded, the reference's default)
     stream_max_backlog: Optional[int] = None
@@ -134,6 +137,11 @@ class TaskSpec:
     start_ts: float = 0.0
     end_ts: float = 0.0
     node_hex: str = ""
+
+    def live_stream(self):
+        """The consumer's ObjectRefGenerator, or None once the consumer
+        dropped it (stream is a weakref — abandonment detection)."""
+        return self.stream() if self.stream is not None else None
 
 
 # --------------------------------------------------------------------------- node
@@ -636,12 +644,16 @@ class ClusterScheduler:
                 self._pending.extendleft(reversed(deferred))
 
     def _remotable(self, spec: TaskSpec) -> bool:
-        """Streaming generators need a live in-process stream and actor
-        methods execute in their owner's mailbox — neither can ship to a
-        node agent. Everything else can."""
+        """Actor methods execute in their owner's mailbox and cannot
+        ship to a node agent. Everything else can — including streaming
+        generators, whose yields flow back item-by-item over the
+        stream_item plane (core/cluster.py; reference: ObjectRefStream
+        across workers, core_worker.h:273). Streaming with a process
+        executor stays local (generators cannot cross the worker pipe
+        there either)."""
         return (
-            not spec.streaming
-            and spec.actor is None
+            spec.actor is None
+            and not (spec.streaming and spec.executor == "process")
             and self.remote_dispatcher is not None
         )
 
@@ -998,7 +1010,7 @@ class ClusterScheduler:
                 f"streaming task {spec.name} must return an iterable/generator, "
                 f"got {type(result).__name__}"
             )
-        stream = spec.stream
+        stream = spec.live_stream()
         already = stream._appended if stream is not None else 0
         for idx, item in enumerate(result):
             if stream is not None and spec.stream_max_backlog:
@@ -1023,8 +1035,9 @@ class ClusterScheduler:
                 entry = self._store.entry(oid)
                 if entry is not None and not entry.event.is_set():
                     self._store.seal_error(oid, error)
-            if spec.stream is not None:
-                spec.stream._finish(error)
+            stream = spec.live_stream()
+            if stream is not None:
+                stream._finish(error)
             return
         for oid in spec.return_ids:
             self._store.seal_error(oid, error)

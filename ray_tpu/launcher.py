@@ -53,11 +53,16 @@ def load_config(path: str) -> Dict[str, Any]:
 
 def _start_cmd(*, address: Optional[str], port: Optional[int],
                num_cpus: Optional[int], resources: Optional[Dict[str, float]],
-               token: Optional[str], no_tpu: bool) -> List[str]:
+               token: Optional[str], no_tpu: bool,
+               tag: Optional[str] = None) -> List[str]:
     cmd = [sys.executable, "-m", "ray_tpu"]
     if no_tpu:
         cmd.append("--no-tpu")
     cmd.append("start")
+    if tag:
+        # identification only: lets `down` target THIS cluster's agents
+        # by cmdline pattern without touching co-tenant clusters
+        cmd += ["--launch-tag", tag]
     if address:
         cmd += ["--address", address]
     else:
@@ -132,9 +137,13 @@ class SSHLaunchProvider:
         return {"host": host, "ssh_pid": proc.pid}
 
     def terminate_all(self) -> None:
-        # best effort, HEAD INCLUDED: kill the agents by process pattern
-        # (there is no remote daemon to ask; SIGTERM lets `start`'s loop
-        # shut down gracefully)
+        # best effort, HEAD INCLUDED: kill THIS cluster's agents by the
+        # launch tag in their cmdline — co-tenant clusters on the same
+        # host (other tags) are untouched
+        tag = self.config.get("_launch_tag", "")
+        pattern = (
+            f"ray_tpu.*--launch-tag {tag}" if tag else "ray_tpu.*start"
+        )
         hosts = [self.config.get("head", {}).get("host", "localhost")] + [
             w.get("host", "localhost")
             for w in self.config.get("workers", [])
@@ -144,7 +153,7 @@ class SSHLaunchProvider:
             try:
                 subprocess.run(
                     ["ssh", *self.ssh_opts, target,
-                     "pkill -f 'ray_tpu.*start' || true"],
+                     f"pkill -f {shlex.quote(pattern)} || true"],
                     capture_output=True, timeout=30,
                 )
             except Exception:
@@ -170,13 +179,17 @@ class ClusterLauncher:
         self.address: Optional[str] = None
 
     def up(self, wait_s: float = 60.0) -> Dict[str, Any]:
+        import uuid as _uuid
+
         head = self.config.get("head", {})
         token = self.config.get("token")
+        tag = self.config.setdefault("_launch_tag", _uuid.uuid4().hex[:12])
         port = int(head.get("port", 6379))
         head_host = head.get("host", "localhost")
         head_cmd = _start_cmd(
             address=None, port=port, num_cpus=head.get("num_cpus"),
             resources=head.get("resources"), token=token, no_tpu=self.no_tpu,
+            tag=tag,
         )
         head_info = self.provider.launch(head_cmd, head_host)
         connect_host = "127.0.0.1" if head_host == "localhost" else head_host
@@ -191,7 +204,7 @@ class ClusterLauncher:
                     address=self.address, port=None,
                     num_cpus=w.get("num_cpus"),
                     resources=w.get("resources"), token=token,
-                    no_tpu=self.no_tpu,
+                    no_tpu=self.no_tpu, tag=tag,
                 )
                 launched.append(
                     self.provider.launch(cmd, w.get("host", "localhost"))
@@ -254,6 +267,7 @@ def up_from_cli(config_path: str, *, no_tpu: bool = False) -> Dict[str, Any]:
         "address": info["address"],
         "provider": config.get("provider", "local"),
         "pids": [n.get("pid") for n in info["nodes"] if n.get("pid")],
+        "launch_tag": config.get("_launch_tag"),
         "config_path": os.path.abspath(config_path),
     }
     with open(_state_path(config_path), "w") as f:
@@ -275,6 +289,18 @@ def down_from_cli(config_path: str) -> int:
     stopped = 0
     if state["provider"] == "local":
         for pid in state.get("pids", []):
+            # pids recycle across reboots: verify the target still IS a
+            # ray_tpu node before signaling it
+            try:
+                with open(f"/proc/{pid}/cmdline", "rb") as f:
+                    cmdline = f.read().decode(errors="replace")
+            except OSError:
+                continue
+            if "ray_tpu" not in cmdline:
+                continue
+            tag = state.get("launch_tag")
+            if tag and tag not in cmdline:
+                continue
             try:
                 os.kill(pid, signal.SIGTERM)
                 stopped += 1
@@ -282,6 +308,7 @@ def down_from_cli(config_path: str) -> int:
                 pass
     else:
         config = load_config(state["config_path"])
+        config["_launch_tag"] = state.get("launch_tag", "")
         SSHLaunchProvider(config).terminate_all()
         stopped = len(config.get("workers", [])) + 1
     os.unlink(path)

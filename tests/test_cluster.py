@@ -197,16 +197,60 @@ def test_graceful_remove_deregisters(cluster):
     assert ray_tpu.get(f.remote(), timeout=60) == 7
 
 
-def test_streaming_stays_local(cluster):
-    """Streaming generators cannot ship to agents; they run in-process."""
+def test_streaming_generator_on_remote_agent(cluster):
+    """num_returns="streaming" tasks dispatch to agents: each yield
+    flows back over the stream_item plane as it is produced (reference:
+    ObjectRefStream across workers, core_worker.h:273)."""
+    import os
+
+    from ray_tpu.core.scheduler import NodeAffinitySchedulingStrategy
+
+    remote_nodes = [n for n in cluster.runtime.scheduler.nodes() if n.is_remote]
+
+    @ray_tpu.remote
+    def gen(n):
+        import os as _os
+
+        for i in range(n):
+            yield (i, _os.getpid())
+
+    stream = gen.options(
+        num_returns="streaming",
+        scheduling_strategy=NodeAffinitySchedulingStrategy(
+            remote_nodes[0].node_id
+        ),
+    ).remote(5)
+    items = [ray_tpu.get(r, timeout=60) for r in stream]
+    assert [i for i, _ in items] == [0, 1, 2, 3, 4]
+    pids = {p for _, p in items}
+    assert pids and os.getpid() not in pids, "generator ran in-process"
+
+
+def test_streaming_remote_big_items_and_backpressure(cluster):
+    """Big yields stay on the agent as placeholders pulled on get();
+    stream_max_backlog paces a fast remote producer."""
+    import time as _time
+
+    from ray_tpu.core.scheduler import NodeAffinitySchedulingStrategy
+
+    remote_nodes = [n for n in cluster.runtime.scheduler.nodes() if n.is_remote]
 
     @ray_tpu.remote
     def gen():
-        for i in range(5):
-            yield i
+        for i in range(6):
+            yield np.full(200_000, i, dtype=np.float64)  # 1.6 MB each
 
-    stream = gen.options(num_returns="streaming").remote()
-    assert [ray_tpu.get(r) for r in stream] == [0, 1, 2, 3, 4]
+    stream = gen.options(
+        num_returns="streaming", stream_max_backlog=2,
+        scheduling_strategy=NodeAffinitySchedulingStrategy(
+            remote_nodes[0].node_id
+        ),
+    ).remote()
+    seen = []
+    for ref in stream:
+        _time.sleep(0.05)  # slow consumer: the producer must be paced
+        seen.append(float(ray_tpu.get(ref, timeout=60)[0]))
+    assert seen == [0.0, 1.0, 2.0, 3.0, 4.0, 5.0]
 
 
 def test_rpc_auth_token_required():
