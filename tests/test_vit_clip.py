@@ -96,7 +96,11 @@ def test_clip_contrastive_training_aligns_pairs():
     tokens = jax.random.randint(jax.random.PRNGKey(2), (4, 12), 0, 256)
     lengths = jnp.full((4,), 12)
 
-    opt = optax.adam(1e-2)
+    # 5e-3 over more steps: 1e-2 overshoots this toy problem into a
+    # text-embedding collapse on some optimization trajectories (seen
+    # when XLA fusion-order drift nudged the path) — at 5e-3 the pairs
+    # align to loss ~0 across seeds
+    opt = optax.adam(5e-3)
     opt_state = opt.init(params)
 
     @jax.jit
@@ -108,8 +112,8 @@ def test_clip_contrastive_training_aligns_pairs():
         return optax.apply_updates(params, updates), opt_state, loss
 
     losses = []
-    # plateaus at ln(B) until logit_scale warms up (~step 75), then collapses
-    for _ in range(150):
+    # plateaus at ln(B) until logit_scale warms up, then collapses to ~0
+    for _ in range(400):
         params, opt_state, loss = step(params, opt_state)
         losses.append(float(loss))
     assert losses[-1] < losses[0] * 0.35, (losses[0], losses[-1])
