@@ -101,6 +101,9 @@ def _pod_env_resources() -> Optional[ResourceDict]:
     visible = os.environ.get("TPU_VISIBLE_CHIPS")
     if not acc_type and not visible:
         return None
+    hostnames = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    n_hosts = max(1, len([h for h in hostnames.split(",") if h.strip()]))
+    clamped = False
     if visible is not None:
         chips = float(len([c for c in visible.split(",") if c.strip()]))
     else:
@@ -115,20 +118,41 @@ def _pod_env_resources() -> Optional[ResourceDict]:
                 total = int(acc_type.rsplit("-", 1)[1])
                 cores_per_chip = 2 if gen in ("v2", "v3", "v4", "v5p") else 1
                 slice_chips = max(1, total // cores_per_chip)
-                hostnames = os.environ.get("TPU_WORKER_HOSTNAMES", "")
-                n_hosts = max(1, len([h for h in hostnames.split(",") if h.strip()]))
                 chips = float(max(1, slice_chips // n_hosts))
             except ValueError:
                 pass
+        # TPU_TOPOLOGY ("1x1", "2x4", "2x2x4") counts the chips actually
+        # attached SLICE-WIDE and wins when its per-host share is
+        # SMALLER: environments that advertise a slice type but attach a
+        # sub-slice (tunneled dev chips, GKE subslicing) must not
+        # over-report — 4 num_tpus=1 tasks would contend for 1 real chip
+        # (observed: v5litepod-4 type with 1x1 topology = one chip).
+        topology = os.environ.get("TPU_TOPOLOGY", "")
+        if topology:
+            try:
+                import math
+
+                topo_chips = math.prod(
+                    int(d) for d in topology.lower().split("x")
+                )
+                per_host = max(1, topo_chips // n_hosts)
+                if topo_chips >= 1 and per_host < chips:
+                    chips = float(per_host)
+                    clamped = True
+            except ValueError:
+                pass
     out: ResourceDict = {"TPU": chips}
-    if acc_type:
+    if acc_type and not clamped:
+        # One head resource per slice: a gang reserves the whole pod by
+        # demanding {"TPU-<type>-head": 1}. A CLAMPED node is a
+        # sub-slice, not the advertised slice — synthesizing the slice
+        # head there would schedule a full-slice gang onto fewer real
+        # chips than it demands.
         try:
             worker_id = int(os.environ.get("TPU_WORKER_ID", "0") or 0)
         except ValueError:
             worker_id = 0  # malformed env must not brick node startup
         if worker_id == 0:
-            # one head resource per slice: a gang reserves the whole pod
-            # by demanding {"TPU-<type>-head": 1}
             out[f"TPU-{acc_type}-head"] = 1.0
     return out
 

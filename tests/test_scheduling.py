@@ -242,6 +242,7 @@ def test_tpu_pod_env_resources(monkeypatch):
     monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v4-16")
     monkeypatch.setenv("TPU_VISIBLE_CHIPS", "0,1,2,3")
     monkeypatch.setenv("TPU_WORKER_ID", "0")
+    monkeypatch.delenv("TPU_TOPOLOGY", raising=False)
     res = detect_tpu_resources()
     assert res["TPU"] == 4.0
     assert res["TPU-v4-16-head"] == 1.0
@@ -266,6 +267,36 @@ def test_tpu_pod_env_resources(monkeypatch):
     res = detect_tpu_resources()
     assert res["TPU"] == 4.0
     assert res["TPU-v5litepod-8-head"] == 1.0
+
+    # a SMALLER attached topology clamps the type-derived count: a
+    # v5litepod-4 slice type with a 1x1 topology is ONE real chip
+    # (tunneled dev chips / GKE subslicing) — over-reporting would let
+    # 4 num_tpus=1 tasks contend for it. A clamped node is a SUB-slice:
+    # it must NOT advertise the full-slice head resource, or a gang
+    # demanding the slice lands on fewer chips than it asked for.
+    monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v5litepod-4")
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "localhost")
+    monkeypatch.setenv("TPU_TOPOLOGY", "1x1")
+    res = detect_tpu_resources()
+    assert res["TPU"] == 1.0
+    assert "TPU-v5litepod-4-head" not in res
+    # ...but topology never INFLATES past the type-derived count, and a
+    # full-slice topology keeps the head resource
+    monkeypatch.setenv("TPU_TOPOLOGY", "4x4")
+    res = detect_tpu_resources()
+    assert res["TPU"] == 4.0
+    assert res["TPU-v5litepod-4-head"] == 1.0
+
+    # multi-host sub-slice: topology counts chips SLICE-WIDE, so the
+    # clamp divides by the host count — v4-32 type (8 chips/host over 2
+    # hosts) with an attached 2x2x2 = 8-chip topology is 4 real
+    # chips/host, not 8
+    monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v4-32")
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "h0,h1")
+    monkeypatch.setenv("TPU_TOPOLOGY", "2x2x2")
+    res = detect_tpu_resources()
+    assert res["TPU"] == 4.0
+    assert "TPU-v4-32-head" not in res
 
 
 def test_task_threads_are_reused():
