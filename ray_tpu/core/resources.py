@@ -106,7 +106,7 @@ def _pod_env_resources() -> Optional[ResourceDict]:
     clamped = False
     if visible is not None:
         chips = float(len([c for c in visible.split(",") if c.strip()]))
-    else:
+    else:  # type-derived (clamped below alongside the visible path)
         # Only the type is known. The numeric suffix counts TENSORCORES
         # for v2/v3/v4/v5p (2 per chip) but CHIPS for v5litepod/v5e/v6e —
         # the same generation table the reference TPUAcceleratorManager
@@ -121,26 +121,29 @@ def _pod_env_resources() -> Optional[ResourceDict]:
                 chips = float(max(1, slice_chips // n_hosts))
             except ValueError:
                 pass
-        # TPU_TOPOLOGY ("1x1", "2x4", "2x2x4") counts the chips actually
-        # attached SLICE-WIDE and wins when its per-host share is
-        # SMALLER: environments that advertise a slice type but attach a
-        # sub-slice (tunneled dev chips, GKE subslicing) must not
-        # over-report — 4 num_tpus=1 tasks would contend for 1 real chip
-        # (observed: v5litepod-4 type with 1x1 topology = one chip).
-        topology = os.environ.get("TPU_TOPOLOGY", "")
-        if topology:
-            try:
-                import math
+    # TPU_TOPOLOGY ("1x1", "2x4", "2x2x4") counts the chips actually
+    # attached SLICE-WIDE; its per-host share wins when SMALLER than
+    # either the type-derived count OR the visible-chips list:
+    # environments that advertise a slice but attach a sub-slice
+    # (tunneled dev chips, GKE subslicing) must not over-report — 4
+    # num_tpus=1 tasks would contend for 1 real chip (observed:
+    # v5litepod-4 type with 1x1 topology = one chip). `clamped` also
+    # suppresses the slice-head resource below: a sub-slice is not the
+    # advertised slice.
+    topology = os.environ.get("TPU_TOPOLOGY", "")
+    if topology:
+        try:
+            import math
 
-                topo_chips = math.prod(
-                    int(d) for d in topology.lower().split("x")
-                )
-                per_host = max(1, topo_chips // n_hosts)
-                if topo_chips >= 1 and per_host < chips:
-                    chips = float(per_host)
-                    clamped = True
-            except ValueError:
-                pass
+            topo_chips = math.prod(
+                int(d) for d in topology.lower().split("x")
+            )
+            per_host = max(1, topo_chips // n_hosts)
+            if topo_chips >= 1 and per_host < chips:
+                chips = float(per_host)
+                clamped = True
+        except ValueError:
+            pass
     out: ResourceDict = {"TPU": chips}
     if acc_type and not clamped:
         # One head resource per slice: a gang reserves the whole pod by

@@ -39,3 +39,4 @@ from .sharding import (  # noqa: F401
     tree_specs,
     validate_divisibility,
 )
+from .dcn import CrossSliceReplicator, fetch_replica  # noqa: F401

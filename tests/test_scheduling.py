@@ -298,6 +298,17 @@ def test_tpu_pod_env_resources(monkeypatch):
     assert res["TPU"] == 4.0
     assert "TPU-v4-32-head" not in res
 
+    # the clamp applies to the VISIBLE-chips path too: a container shown
+    # 4 chips on a node whose attached topology is 1x1 has one real chip
+    # and is a sub-slice (no head resource)
+    monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v5litepod-4")
+    monkeypatch.setenv("TPU_VISIBLE_CHIPS", "0,1,2,3")
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "localhost")
+    monkeypatch.setenv("TPU_TOPOLOGY", "1x1")
+    res = detect_tpu_resources()
+    assert res["TPU"] == 1.0
+    assert "TPU-v5litepod-4-head" not in res
+
 
 def test_task_threads_are_reused():
     """Thread-executor tasks run on pooled, reused threads — a burst of
