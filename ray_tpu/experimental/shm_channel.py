@@ -41,6 +41,7 @@ argument.
 
 from __future__ import annotations
 
+import fcntl
 import mmap
 import os
 import pickle
@@ -68,6 +69,46 @@ _OFF_DATA_LEN = 32
 
 def _shm_dir() -> str:
     return "/dev/shm" if os.path.isdir("/dev/shm") else tempfile.gettempdir()
+
+
+_reaped_once = False
+
+
+def _reap_stale_channels(shm_dir: str) -> None:
+    """Unlink channel files no live ENDPOINT holds open: every open
+    channel keeps a shared flock on its file, so an exclusive
+    non-blocking flock succeeding proves abandonment (creator-pid would
+    be the wrong proxy — dag pipelines outlive the driver that created
+    their channels, and PID namespaces lie across containers). Runs
+    once per process: a SIGKILLed user must not leak tmpfs RAM forever,
+    but per-creation directory scans would be pure overhead."""
+    global _reaped_once
+    if _reaped_once:
+        return
+    _reaped_once = True
+    try:
+        names = os.listdir(shm_dir)
+    except OSError:
+        return
+    for name in names:
+        if not name.startswith("ray_tpu_chan_"):
+            continue
+        path = os.path.join(shm_dir, name)
+        try:
+            fd = os.open(path, os.O_RDWR)
+        except OSError:
+            continue
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError:
+            os.close(fd)
+            continue  # some endpoint somewhere holds it: live
+        try:
+            os.unlink(path)  # abandoned: no endpoint held the lock
+        except OSError:
+            pass
+        finally:
+            os.close(fd)  # releases the exclusive lock
 
 
 class ShmChannel:
@@ -101,14 +142,29 @@ class ShmChannel:
         self.num_readers = int(num_readers)
         self._data_off = _HDR.size + _ACK.size * self.num_readers
         if _create:
-            fd, self.path = tempfile.mkstemp(
-                prefix="ray_tpu_chan_", dir=_shm_dir()
-            ) if path is None else (os.open(path, os.O_CREAT | os.O_RDWR), path)
+            if path is None:
+                shm_dir = _shm_dir()
+                _reap_stale_channels(shm_dir)
+                fd, self.path = tempfile.mkstemp(
+                    prefix=f"ray_tpu_chan_{os.getpid()}_", dir=shm_dir
+                )
+            else:
+                fd, self.path = os.open(path, os.O_CREAT | os.O_RDWR), path
             try:
+                # lease FIRST: between mkstemp and LOCK_SH the file would
+                # otherwise be visible-but-unleased, and a concurrent
+                # process's sweep could reap a channel being born
+                fcntl.flock(fd, fcntl.LOCK_SH)
                 os.ftruncate(fd, self._data_off + self.capacity)
                 self._mm = mmap.mmap(fd, self._data_off + self.capacity)
-            finally:
+            except BaseException:
                 os.close(fd)
+                if path is None:
+                    try:
+                        os.unlink(self.path)  # half-born mkstemp file
+                    except OSError:
+                        pass
+                raise
             _HDR.pack_into(
                 self._mm, 0, _MAGIC, self.num_readers, 0, 0, 0, self.capacity
             )
@@ -116,12 +172,19 @@ class ShmChannel:
             self.path = path
             fd = os.open(path, os.O_RDWR)
             try:
+                fcntl.flock(fd, fcntl.LOCK_SH)  # lease before anything else
                 self._mm = mmap.mmap(fd, self._data_off + self.capacity)
-            finally:
+            except BaseException:
                 os.close(fd)
+                raise
             magic, nr, _, _, _, cap = _HDR.unpack_from(self._mm, 0)
             if magic != _MAGIC or nr != self.num_readers or cap != self.capacity:
+                os.close(fd)
                 raise ValueError(f"channel file {path!r} does not match layout")
+        # the fd stays OPEN holding the shared flock: it is this
+        # endpoint's liveness lease — the stale-channel reaper only
+        # unlinks files on which an exclusive flock succeeds
+        self._fd = fd
         self._owner = _create
 
     # ------------------------------------------------------------- plumbing
@@ -205,11 +268,28 @@ class ShmChannel:
         # against a concurrent write() stamping version/data_len
         _U64.pack_into(self._mm, _OFF_CLOSED, 1)
 
+    def release(self) -> None:
+        """Drop this endpoint's liveness lease (close its fd). Called by
+        unlink()/GC; safe to call twice."""
+        fd, self._fd = self._fd, -1
+        if fd >= 0:
+            try:
+                os.close(fd)
+            except OSError:
+                pass
+
     def unlink(self) -> None:
         """Remove the backing file (creator only, after all ends closed)."""
+        self.release()
         try:
             os.unlink(self.path)
         except OSError:
+            pass
+
+    def __del__(self):
+        try:
+            self.release()
+        except Exception:
             pass
 
     def __reduce__(self):

@@ -106,3 +106,36 @@ def test_cross_process_actor_pipeline(runtime):
         outbound.close()
         inbound.unlink()
         outbound.unlink()
+
+
+def test_stale_channel_files_reaped(tmp_path, monkeypatch):
+    """Channel files with no live ENDPOINT (nobody holds the shared
+    flock lease) are swept at the next channel creation; files any open
+    endpoint still leases survive — even if their creator died (dag
+    pipelines outlive the driver that made their channels)."""
+    import os
+
+    from ray_tpu.experimental import shm_channel as sc
+
+    monkeypatch.setattr(sc, "_shm_dir", lambda: str(tmp_path))
+    monkeypatch.setattr(sc, "_reaped_once", False)
+    abandoned = tmp_path / "ray_tpu_chan_999999999_x"  # no lease holder
+    abandoned.write_bytes(b"\x00" * 64)
+    # a LIVE channel: its endpoint object holds the flock lease
+    live = sc.ShmChannel(capacity=1024, num_readers=1)
+    monkeypatch.setattr(sc, "_reaped_once", False)  # sweep again
+
+    chan = sc.ShmChannel(capacity=1024, num_readers=1)
+    try:
+        assert not abandoned.exists(), "abandoned file survived the sweep"
+        assert os.path.exists(live.path), "leased channel was reaped"
+        # the lease, not the creator pid, is the liveness signal:
+        # re-sweeping with both endpoints open leaves both alone
+        monkeypatch.setattr(sc, "_reaped_once", False)
+        sc._reap_stale_channels(str(tmp_path))
+        assert os.path.exists(live.path) and os.path.exists(chan.path)
+    finally:
+        for ch in (chan, live):
+            ch.close()
+            ch.unlink()
+        assert not os.path.exists(live.path)
