@@ -1,0 +1,39 @@
+"""jax cross-version shims.
+
+The framework targets the modern surface (`jax.shard_map`, its
+`check_vma` kwarg); older jax (< 0.5, e.g. the 0.4.x this image pins)
+keeps shard_map under `jax.experimental.shard_map` and spells the
+replication check `check_rep`. One adapter keeps every call site on the
+modern spelling, so upgrading jax later is a no-op here.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+try:  # modern jax: top-level export
+    from jax import shard_map as _shard_map  # type: ignore[attr-defined]
+except ImportError:  # jax < 0.5: experimental namespace
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+_PARAMS = frozenset(inspect.signature(_shard_map).parameters)
+
+
+def shard_map(f=None, **kwargs):
+    """`jax.shard_map` with kwarg translation for older jax. Usable both
+    directly and as a decorator factory (``shard_map(mesh=..., ...)``)."""
+    if "check_vma" in kwargs and "check_vma" not in _PARAMS:
+        kwargs["check_rep"] = kwargs.pop("check_vma")
+    if f is None:
+        return lambda fn: _shard_map(fn, **kwargs)
+    return _shard_map(f, **kwargs)
+
+
+def set_mesh(mesh):
+    """`jax.set_mesh` (modern: the global-mesh context manager). Older
+    jax spells the same thing as entering the Mesh itself."""
+    import jax
+
+    if hasattr(jax, "set_mesh"):
+        return jax.set_mesh(mesh)
+    return mesh  # Mesh is a context manager on jax < 0.5

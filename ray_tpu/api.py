@@ -291,9 +291,15 @@ def list_actors() -> List[Dict[str, Any]]:
 
 
 def placement_group(
-    bundles: Sequence[ResourceDict], strategy: str = "PACK", name: str = ""
+    bundles: Sequence[ResourceDict], strategy: str = "PACK", name: str = "",
+    max_reschedules: Optional[int] = None,
 ) -> PlacementGroup:
-    return _runtime().create_placement_group(bundles, strategy, name)
+    """Reserve a gang of bundles. `max_reschedules` bounds how many
+    re-reservation attempts the group gets after a bundle host dies
+    before it is marked FAILED (None = cfg.pg_reschedule_budget)."""
+    return _runtime().create_placement_group(
+        bundles, strategy, name, max_reschedules=max_reschedules
+    )
 
 
 def remove_placement_group(pg: PlacementGroup) -> None:

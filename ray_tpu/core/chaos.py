@@ -9,6 +9,13 @@ max_retries), so recovery paths are exercised exactly like real faults.
 
 Also configurable via env: RAY_TPU_CHAOS="failure_prob=0.3,delay_s=0.01,
 max_injections=5,name_filter=flaky".
+
+`kill_node=1` escalates an injection from a task error to HARD process
+death (os._exit): the whole node agent disappears mid-task, exactly like
+a host loss. Set it through the env on a worker agent and dispatch a
+task matching `name_filter` there — the node-death recovery paths
+(heartbeat staleness, task failover, actor restart, placement-group
+rescheduling) then run against a real process kill instead of a mock.
 """
 
 from __future__ import annotations
@@ -33,6 +40,7 @@ class ChaosConfig:
     max_injections: int = -1  # -1 = unlimited
     name_filter: Optional[str] = None  # substring match on task name
     seed: int = 0
+    kill_node: bool = False  # matching task kills THIS process (node death)
 
 
 class _ChaosState:
@@ -52,10 +60,12 @@ def set_chaos(
     max_injections: int = -1,
     name_filter: Optional[str] = None,
     seed: int = 0,
+    kill_node: bool = False,
 ) -> None:
     with _state.lock:
         _state.config = ChaosConfig(
-            failure_prob, delay_s, max_injections, name_filter, seed
+            failure_prob, delay_s, max_injections, name_filter, seed,
+            kill_node,
         )
         _state.injected = 0
         _state.rng = np.random.default_rng(seed)
@@ -83,6 +93,8 @@ def load_from_env() -> None:
             kwargs[k] = float(v)
         elif k in ("max_injections", "seed"):
             kwargs[k] = int(v)
+        elif k == "kill_node":
+            kwargs[k] = v.strip().lower() in ("1", "true", "yes", "on")
         elif k == "name_filter":
             kwargs[k] = v
     set_chaos(**kwargs)
@@ -101,14 +113,19 @@ def maybe_inject(task_name: str) -> None:
     # count against max_injections too, so they are bounded.
     delay = 0.0
     fail_ordinal = 0
+    kill = False
     with _state.lock:
         if 0 <= config.max_injections <= _state.injected:
             return
-        if config.delay_s > 0:
+        if config.kill_node:
+            _state.injected += 1
+            kill = True
+        if not kill and config.delay_s > 0:
             delay = config.delay_s
             _state.injected += 1
         if (
-            config.failure_prob > 0
+            not kill
+            and config.failure_prob > 0
             # A failure is its own injection event even when a delay fired in
             # the same call: re-check the budget (the delay may have consumed
             # the last unit) and count it separately so max_injections bounds
@@ -118,6 +135,10 @@ def maybe_inject(task_name: str) -> None:
         ):
             _state.injected += 1
             fail_ordinal = _state.injected
+    if kill:
+        # Abrupt node death: no cleanup, no deregistration — the rest of
+        # the cluster must discover it through heartbeat staleness.
+        os._exit(137)
     if delay > 0:
         time.sleep(delay)
     if fail_ordinal:

@@ -48,11 +48,19 @@ them on a surviving feasible node (RESTARTING → ALIVE, in-flight calls
 fail, queued calls resume, named directory repoints) — the reference's
 actor FSM (gcs_actor_manager.h:328) with owner-driven placement.
 
+Placement groups survive node death too: a bundle host's death moves
+the group RESERVED → RESCHEDULING (scheduler.handle_node_death) and the
+owner re-runs the 2PC reservation against surviving nodes — tasks
+queued against the group wait for the re-reservation instead of failing
+fast, budgeted bundle actors restart into the re-reserved bundles, and
+an exhausted reschedule budget fails the group with its death history
+(the reference's GcsPlacementGroupManager rescheduling FSM,
+gcs_placement_group_mgr.h:232, with owner-driven recovery).
+
 Known gaps (tracked for later rounds): streaming generators are
-local-only; PG bundles are not rescheduled after their host dies (tasks
-targeting them fail fast instead); the borrow registration is async, so
-an owner that GCs within the in-flight window surfaces ObjectLostError
-at the borrower's get().
+local-only; the borrow registration is async, so an owner that GCs
+within the in-flight window surfaces ObjectLostError at the borrower's
+get().
 """
 
 from __future__ import annotations
@@ -68,7 +76,7 @@ from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
 from .exceptions import ActorDiedError
-from .gcs_service import GcsClient
+from .gcs_service import PG_NS, GcsClient
 from .ids import ActorID, NodeID, ObjectID
 from .object_transfer import ObjectTransferServer, fetch_object, push_object
 from .rpc import PROTOCOL_VERSION, RpcClient, RpcError
@@ -349,10 +357,7 @@ class RemoteActorProxy:
 
     def _restart_budget(self) -> bool:
         c = self.creation
-        return (
-            c is not None and c["bundle"] is None
-            and self.restarts_used < c["max_restarts"]
-        )
+        return c is not None and self.restarts_used < c["max_restarts"]
 
     def _recover_or_die(self, call: "_RemoteActorCall", exc) -> None:
         """The hosting side can no longer serve this actor (node declared
@@ -557,6 +562,7 @@ class ClusterContext:
         runtime.scheduler.remote_dispatcher = self._dispatch
         runtime.scheduler.remote_bundle_reserver = self._reserve_remote_bundles
         runtime.scheduler.remote_bundle_releaser = self._release_remote_bundles
+        runtime.scheduler.pg_state_sink = self._record_pg_state
 
         self._register()
         self._watch_thread = threading.Thread(
@@ -712,10 +718,15 @@ class ClusterContext:
                 ),
                 system_failure=True,
             )
+        # Placement groups with bundles reserved there: RESERVED →
+        # RESCHEDULING, re-run the 2PC against survivors. Kicked BEFORE
+        # the actor restarts below so bundle-actor restart threads find
+        # the group already rescheduling and park on wait_reserved.
+        self.runtime.scheduler.handle_node_death(node_hex, reason)
         # Remote actors hosted there: restart elsewhere when budgeted
         # (reference actor FSM: ALIVE→RESTARTING→ALIVE,
-        # gcs_actor_manager.h:328), else die. PG-bundle actors die with
-        # their bundle — the reservation was on the dead node.
+        # gcs_actor_manager.h:328), else die. PG-bundle actors restart
+        # into their bundle once the group re-reserves it.
         with self._lock:
             proxies = [
                 p for p in self.remote_actors.values()
@@ -1186,6 +1197,39 @@ class ClusterContext:
             self._release_bundle(*key)
         return len(doomed)
 
+    def _record_pg_state(self, pg) -> None:
+        """Scheduler FSM sink: mirror this owner's placement-group state
+        into the cluster-wide GCS PG table (reference: the PG table the
+        GcsPlacementGroupManager persists). Best-effort — the FSM is
+        owner-local truth; the table is observability."""
+        try:
+            if pg.state == "REMOVED":
+                self.gcs.kv_delete(pg.id.hex(), namespace=PG_NS)
+                return
+            self.gcs.kv_put(pg.id.hex(), {
+                "pg_id": pg.id.hex(),
+                "name": pg.name,
+                "strategy": pg.strategy.value,
+                "state": pg.state,
+                "owner": self.node_id.hex(),
+                "bundles": [
+                    {
+                        "index": b.index,
+                        "resources": dict(b.resources),
+                        "node": (
+                            b.node.node_id.hex() if b.node is not None else None
+                        ),
+                    }
+                    for b in pg.bundles
+                ],
+                "reschedules_used": pg.reschedules_used,
+                "death_history": list(pg.death_history),
+                "failure_reason": pg.failure_reason,
+                "updated_at": time.time(),
+            }, namespace=PG_NS)
+        except (RpcError, OSError):
+            pass
+
     # -------------------------------------------------------- remote actors
 
     def can_place_actor_remotely(self, strategy, resources):
@@ -1391,33 +1435,45 @@ class ClusterContext:
         if c is None:
             return  # killed (creation cleared) before this thread ran
         resources = dict(c["resources"])
-        deadline = time.monotonic() + 30.0
+        bundle_key = tuple(c["bundle"]) if c.get("bundle") else None
         node = None
         pool = None
-        while time.monotonic() < deadline:
-            with proxy._lock:
-                if proxy.state != "RESTARTING":
-                    return  # killed while we searched
-            with self._lock:
-                candidates = [
-                    n for n in self._remote_nodes.values()
-                    if n.alive and n.resources.can_ever_fit(resources)
-                ]
-            candidates.sort(key=lambda n: n.utilization())
-            for cand in candidates:
-                if cand.resources.try_acquire(resources):
-                    node, pool = cand, cand.resources
+        if bundle_key is not None:
+            # A bundle actor follows its bundle: wait for the placement
+            # group to re-reserve it (RESCHEDULING → RESERVED), then
+            # restart on whichever node now hosts the bundle.
+            node, pool, err = self._await_rescheduled_bundle(
+                proxy, bundle_key, resources
+            )
+            if node is None:
+                proxy.die(f"{why}; {err}")
+                return
+        else:
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                with proxy._lock:
+                    if proxy.state != "RESTARTING":
+                        return  # killed while we searched
+                with self._lock:
+                    candidates = [
+                        n for n in self._remote_nodes.values()
+                        if n.alive and n.resources.can_ever_fit(resources)
+                    ]
+                candidates.sort(key=lambda n: n.utilization())
+                for cand in candidates:
+                    if cand.resources.try_acquire(resources):
+                        node, pool = cand, cand.resources
+                        break
+                if node is not None:
                     break
-            if node is not None:
-                break
-            time.sleep(0.2)
-        if node is None:
-            proxy.die(f"{why}; no surviving node can host a restart")
-            return
+                time.sleep(0.2)
+            if node is None:
+                proxy.die(f"{why}; no surviving node can host a restart")
+                return
         try:
             blob = self._actor_blob(
                 proxy.actor_id.hex(), c,
-                resources=resources, bundle=None,
+                resources=resources, bundle=bundle_key,
                 max_restarts=c["max_restarts"] - proxy.restarts_used,
             )
             reply = node.client.call("create_actor", blob)
@@ -1453,6 +1509,43 @@ class ClusterContext:
                 node.client.call("kill_actor", proxy.actor_id.hex())
             except (RpcError, OSError):
                 pass
+
+    def _await_rescheduled_bundle(self, proxy: RemoteActorProxy,
+                                  bundle_key: Tuple[str, int],
+                                  resources: Dict[str, float]):
+        """Resolve a restarting bundle actor's new host: wait for its
+        placement group to re-reserve the bundle, then lease the actor's
+        resources from the re-reserved pool. Returns (node, pool, None)
+        or (None, None, reason)."""
+        from .config import cfg
+
+        pg_hex, idx = bundle_key
+        pg = self.runtime.scheduler.get_placement_group(pg_hex)
+        if pg is None:
+            return None, None, "its placement group is gone"
+        if not pg.wait_reserved(timeout=cfg.pg_reschedule_wait_s):
+            return None, None, (
+                f"placement group {pg_hex[:12]} did not re-reserve "
+                f"({pg.state}: {pg.failure_reason or 'timed out'})"
+            )
+        try:
+            bundle = pg.bundles[idx]
+        except IndexError:
+            return None, None, f"bundle {idx} does not exist"
+        node, pool = bundle.node, bundle.reserved
+        if node is None or not node.is_remote or not node.alive or pool is None:
+            return None, None, f"bundle {idx} host is not a live agent"
+        deadline = time.monotonic() + 30.0
+        while not pool.try_acquire(resources):
+            with proxy._lock:
+                if proxy.state != "RESTARTING":
+                    return None, None, "killed while waiting for the bundle"
+            if time.monotonic() > deadline:
+                return None, None, (
+                    f"bundle {idx} pool never freed capacity for the restart"
+                )
+            time.sleep(0.02)
+        return node, pool, None
 
     def submit_remote_actor_call(self, proxy: RemoteActorProxy, method: str,
                                  args, kwargs, return_ids) -> None:
@@ -1773,6 +1866,18 @@ class ClusterContext:
         from . import runtime_env as _renv
 
         task_hex = msg["task_hex"]
+        try:
+            # Same chaos boundary as local execution (scheduler._run_task):
+            # injected failures/delays/node-kills hit remotely dispatched
+            # tasks too, so cluster recovery paths are exercisable by the
+            # one harness (kill_node here takes the whole agent down).
+            from . import chaos
+
+            chaos.maybe_inject(msg["name"])
+        except BaseException as exc:  # noqa: BLE001 - ferried to the owner
+            tb = traceback.format_exc()
+            self._reply_error(msg, exc, tb)
+            return
         if msg.get("streaming"):
             self._run_agent_streaming(msg)
             return

@@ -19,6 +19,13 @@ from typing import Any, Dict, List, Optional, Tuple
 from .gcs import GlobalControlStore
 from .rpc import RpcClient, RpcServer
 
+# Cluster-wide placement-group table (reference: the PG table the
+# GcsPlacementGroupManager persists, gcs_placement_group_mgr.h:232).
+# Each owner records its PGs' FSM state here — pg_hex -> {state,
+# bundles, death_history, ...} — so `ray_tpu status`/tests can observe
+# RESERVED -> RESCHEDULING -> RESERVED|FAILED transitions cluster-wide.
+PG_NS = "_pgs"
+
 
 class _ResourceSync:
     """Periodic resource-usage broadcast, aggregated at the head
@@ -139,6 +146,21 @@ class GcsClient:
     def cluster_view(self) -> Dict[str, Any]:
         """Aggregated live-node resource view."""
         return self._rpc.call("cluster_view")
+
+    # ----------------------------------------------------- placement groups
+
+    def pg_state(self, pg_hex: str) -> Optional[Dict[str, Any]]:
+        """One placement group's recorded FSM state, or None."""
+        return self.kv_get(pg_hex, namespace=PG_NS)
+
+    def pg_states(self) -> Dict[str, Dict[str, Any]]:
+        """The whole cluster PG table: pg_hex -> state record."""
+        out: Dict[str, Dict[str, Any]] = {}
+        for key in self.kv_keys(namespace=PG_NS):
+            rec = self.kv_get(key, namespace=PG_NS)
+            if rec:
+                out[key] = rec
+        return out
 
     # ----------------------------------------------------- function export
 

@@ -109,6 +109,24 @@ class HealthCheckManager:
         return dead
 
 
+def probe_agent(node) -> bool:
+    """Synchronous liveness probe of a cluster node's agent (reference:
+    one GcsHealthCheckManager ping). Used by the placement-group
+    rescheduler to reject a candidate whose death heartbeat staleness
+    has not caught yet — re-reserving a bundle on an about-to-be-declared
+    node would burn a reschedule attempt for nothing. Local (in-process)
+    nodes are trivially alive."""
+    if not getattr(node, "is_remote", False):
+        return bool(getattr(node, "alive", True))
+    client = getattr(node, "client", None)
+    if client is None or not node.alive:
+        return False
+    try:
+        return bool(client.call("node_info"))
+    except Exception:  # noqa: BLE001 - any transport failure counts as down
+        return False
+
+
 def read_memory_usage_fraction() -> float:
     """Fraction of host memory in use, from /proc/meminfo (no psutil
     needed; matches the reference's MemoryMonitor source)."""

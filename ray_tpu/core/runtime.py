@@ -684,8 +684,11 @@ class Runtime:
 
     # ------------------------------------------------------------- placement
 
-    def create_placement_group(self, bundles, strategy="PACK", name="") -> PlacementGroup:
-        return self.scheduler.create_placement_group(bundles, strategy, name)
+    def create_placement_group(self, bundles, strategy="PACK", name="",
+                               max_reschedules=None) -> PlacementGroup:
+        return self.scheduler.create_placement_group(
+            bundles, strategy, name, max_reschedules=max_reschedules
+        )
 
     def remove_placement_group(self, pg: PlacementGroup) -> None:
         self.scheduler.remove_placement_group(pg)
@@ -810,6 +813,12 @@ def init_runtime(**kwargs) -> Runtime:
     global _global_runtime
     with _global_lock:
         if _global_runtime is None:
+            # Env-driven chaos (RAY_TPU_CHAOS) activates at process
+            # start, so spawned node agents can be armed with e.g.
+            # kill_node injections before any task reaches them.
+            from . import chaos
+
+            chaos.load_from_env()
             _global_runtime = Runtime(**kwargs)
         return _global_runtime
 

@@ -228,19 +228,58 @@ class PlacementGroup:
     (gcs_placement_group_scheduler.h:288). In-process we reserve atomically
     under the scheduler lock; the observable semantics (all-or-nothing,
     strategy-constrained spread) match.
+
+    Lifecycle FSM (reference: GcsPlacementGroupManager states,
+    gcs_placement_group_mgr.h:232): PENDING → RESERVED. A bundle host's
+    death moves the group RESERVED → RESCHEDULING: the owner re-runs the
+    2PC reservation for the dead bundles against surviving (or newly
+    joined) nodes, bounded by a per-group reschedule budget with
+    exponential backoff. Success returns to RESERVED (tasks queued
+    against the group resume, budgeted bundle actors restart into the
+    re-reserved bundles); an exhausted budget lands in FAILED, and every
+    task targeting the group fails with the recorded death history.
     """
 
     def __init__(self, pg_id: PlacementGroupID, bundles: List[Bundle],
-                 strategy: PlacementStrategy, name: str = ""):
+                 strategy: PlacementStrategy, name: str = "",
+                 max_reschedules: Optional[int] = None):
         self.id = pg_id
         self.bundles = bundles
         self.strategy = strategy
         self.name = name
         self.created = threading.Event()
         self.removed = False
+        # --- rescheduling FSM ---
+        self.state = "PENDING"  # RESERVED | RESCHEDULING | FAILED | REMOVED
+        # None = use cfg.pg_reschedule_budget at decision time
+        self.max_reschedules = max_reschedules
+        self.reschedules_used = 0
+        self.death_history: List[Dict[str, Any]] = []
+        self.failure_reason = ""
+        self._reserved_event = threading.Event()
+        self._rescheduler_running = False
 
     def ready(self, timeout: Optional[float] = None) -> bool:
         return self.created.wait(timeout)
+
+    def wait_reserved(self, timeout: Optional[float] = None) -> bool:
+        """Block until the group holds a live reservation (True) or is
+        terminally FAILED/REMOVED (False). Dependents — bundle-actor
+        restarts, gang re-mesh — park here while a reschedule runs."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            if self.state == "RESERVED":
+                return True
+            if self.state in ("FAILED", "REMOVED") or self.removed:
+                return False
+            remaining = (
+                None if deadline is None else deadline - time.monotonic()
+            )
+            if remaining is not None and remaining <= 0:
+                return self.state == "RESERVED"
+            self._reserved_event.wait(
+                timeout=0.5 if remaining is None else min(remaining, 0.5)
+            )
 
     @property
     def bundle_specs(self) -> List[ResourceDict]:
@@ -343,6 +382,9 @@ class ClusterScheduler:
         # own partial progress); releaser(pg_hex, bundles) best-effort.
         self.remote_bundle_reserver: Optional[Callable] = None
         self.remote_bundle_releaser: Optional[Callable] = None
+        # Cluster hook: callable(pg) recording the group's FSM state in
+        # the GCS PG table (observability; None for local-only runtimes).
+        self.pg_state_sink: Optional[Callable] = None
         # task execution threads: dedicated per running task (blocking
         # get() can never deadlock) but REUSED across tasks
         self._task_threads = _ReusableThreadPool()
@@ -484,7 +526,8 @@ class ClusterScheduler:
     # ---------------------------------------------------------- placement grps
 
     def create_placement_group(
-        self, bundles: Sequence[ResourceDict], strategy: str = "PACK", name: str = ""
+        self, bundles: Sequence[ResourceDict], strategy: str = "PACK",
+        name: str = "", max_reschedules: Optional[int] = None,
     ) -> PlacementGroup:
         """Reserve a gang of bundles, cluster-wide.
 
@@ -506,6 +549,7 @@ class ClusterScheduler:
                 [Bundle(i, dict(r)) for i, r in enumerate(bundles)],
                 strat,
                 name,
+                max_reschedules=max_reschedules,
             )
             acquired: List[Tuple[Node, ResourceDict]] = []
             with self._lock:
@@ -544,6 +588,7 @@ class ClusterScheduler:
                     continue
             with self._lock:
                 self._placement_groups[pg.id] = pg
+            self._pg_transition(pg, "RESERVED", "initial reservation")
             pg.created.set()
             return pg
         raise PlacementGroupUnschedulableError(last_err)
@@ -607,11 +652,243 @@ class ClusterScheduler:
             self._placement_groups.pop(pg.id, None)
             pg.removed = True
             for bundle in pg.bundles:
-                if bundle.node is not None:
+                if bundle.node is not None and bundle.node.alive:
                     bundle.node.resources.release(bundle.resources)
-        remote = [b for b in pg.bundles if b.node is not None and b.node.is_remote]
+        remote = [
+            b for b in pg.bundles
+            if b.node is not None and b.node.is_remote and b.node.alive
+        ]
         if remote and self.remote_bundle_releaser is not None:
             self.remote_bundle_releaser(pg.id.hex(), remote)
+        self._pg_transition(pg, "REMOVED")
+
+    # ------------------------------------------------ placement-group FSM
+
+    def get_placement_group(self, pg_hex: str) -> Optional[PlacementGroup]:
+        with self._lock:
+            return self._placement_groups.get(PlacementGroupID(pg_hex))
+
+    def _pg_transition(self, pg: PlacementGroup, state: str,
+                       reason: str = "", **extra: Any) -> None:
+        """One choke point for every PG state change: FSM bookkeeping,
+        structured event, metric, GCS PG-table record, dispatch wake
+        (deferred tasks targeting the group must re-examine it)."""
+        pg.state = state
+        if state == "RESCHEDULING":
+            pg._reserved_event.clear()
+        else:
+            pg._reserved_event.set()
+        from ..util.events import emit
+        from ..util.metrics import get_or_create_counter
+
+        severity = "WARNING" if state in ("RESCHEDULING", "FAILED") else "INFO"
+        emit(severity, "placement_groups",
+             f"placement group {pg.id.hex()[:12]} -> {state}"
+             + (f" ({reason})" if reason else ""),
+             pg=pg.id.hex(), state=state, **extra)
+        get_or_create_counter(
+            "raytpu_pg_state_transitions_total",
+            "Placement-group FSM transitions by target state.",
+            ("state",),
+        ).inc(tags={"state": state})
+        if self.pg_state_sink is not None:
+            try:
+                self.pg_state_sink(pg)
+            except Exception:  # noqa: BLE001 - observability must not wedge the FSM
+                logger.exception("placement-group state sink failed")
+        self._wake.set()
+
+    def handle_node_death(self, node_hex: str, reason: str) -> None:
+        """Heartbeat-confirmed node death: every placement group with a
+        bundle reserved on that node transitions to RESCHEDULING and the
+        2PC re-runs against surviving nodes (reference: the GCS PG
+        manager's rescheduling on raylet death)."""
+        with self._lock:
+            pgs = list(self._placement_groups.values())
+        for pg in pgs:
+            dead = [
+                b.index for b in pg.bundles
+                if b.node is not None and b.node.node_id.hex() == node_hex
+            ]
+            if dead:
+                self._kick_reschedule(
+                    pg, f"node {node_hex[:12]} died: {reason}", dead
+                )
+
+    def _kick_reschedule(self, pg: PlacementGroup, reason: str,
+                         bundle_indexes: List[int]) -> None:
+        """Record the death and ensure exactly one rescheduler thread is
+        driving the group's recovery."""
+        pg.death_history.append({
+            "ts": time.time(),
+            "bundles": list(bundle_indexes),
+            "reason": reason,
+        })
+        with self._lock:
+            if pg.removed or pg.state in ("FAILED", "REMOVED"):
+                return
+            if pg._rescheduler_running:
+                return  # the running thread re-derives dead bundles per attempt
+            pg._rescheduler_running = True
+        self._pg_transition(
+            pg, "RESCHEDULING", reason, bundles=list(bundle_indexes)
+        )
+        threading.Thread(
+            target=self._reschedule_pg, args=(pg,), daemon=True,
+            name=f"ray_tpu-pg-reschedule-{pg.id.hex()[:8]}",
+        ).start()
+
+    def _reschedule_pg(self, pg: PlacementGroup) -> None:
+        """Rescheduler thread: budgeted, backed-off re-reservation loop.
+        Mirrors the actor restart budget — attempts are cumulative over
+        the group's lifetime, so a flapping group cannot thrash forever."""
+        from .config import cfg
+
+        budget = (
+            pg.max_reschedules
+            if pg.max_reschedules is not None
+            else cfg.pg_reschedule_budget
+        )
+        backoff = max(cfg.pg_reschedule_backoff_s, 0.05)
+        attempt = 0
+        try:
+            while True:
+                if pg.removed:
+                    return
+                if pg.reschedules_used >= budget:
+                    self._fail_pg(pg, budget)
+                    return
+                pg.reschedules_used += 1
+                attempt += 1
+                err = self._try_reschedule_once(pg)
+                if err is None:
+                    self._pg_transition(
+                        pg, "RESERVED",
+                        f"re-reserved after {attempt} attempt(s)",
+                        reschedules_used=pg.reschedules_used,
+                    )
+                    return
+                from ..util.events import emit
+
+                emit("WARNING", "placement_groups",
+                     f"placement group {pg.id.hex()[:12]} reschedule "
+                     f"attempt {attempt} failed: {err}",
+                     pg=pg.id.hex())
+                logger.warning("PG %s reschedule attempt %d failed: %s",
+                               pg.id.hex()[:12], attempt, err)
+                if pg.reschedules_used >= budget:
+                    self._fail_pg(pg, budget)
+                    return
+                time.sleep(min(backoff * (2 ** (attempt - 1)), 8.0))
+        finally:
+            with self._lock:
+                pg._rescheduler_running = False
+            # a death that landed between our final transition and the
+            # flag clear found the thread "running" and was skipped:
+            # re-kick so it is never lost
+            if not pg.removed and pg.state == "RESERVED":
+                late = [
+                    b.index for b in pg.bundles
+                    if b.node is not None and not b.node.alive
+                ]
+                if late:
+                    self._kick_reschedule(
+                        pg, "bundle host died during rescheduling", late
+                    )
+
+    def _try_reschedule_once(self, pg: PlacementGroup) -> Optional[str]:
+        """One re-reservation round for every dead bundle: plan + phase-1
+        acquire under the lock, liveness-probe + 2PC phase 2 outside it,
+        commit the new hosts only when everything granted. Returns None
+        on success, an error string to retry on."""
+        from .health import probe_agent
+
+        acquired: List[Tuple[Node, ResourceDict]] = []
+        replacements: List[Tuple[Bundle, Node]] = []
+        with self._lock:
+            dead = [
+                b for b in pg.bundles
+                if b.node is None or not b.node.alive
+            ]
+            if not dead:
+                return None  # healed concurrently
+            alive = [n for n in self._nodes.values() if n.alive]
+            held = {
+                b.node.node_id for b in pg.bundles
+                if b.node is not None and b.node.alive
+            }
+            pack_node: Optional[Node] = (
+                next(
+                    (b.node for b in pg.bundles
+                     if b.node is not None and b.node.alive), None
+                )
+                if pg.strategy == PlacementStrategy.STRICT_PACK else None
+            )
+
+            def rollback() -> None:
+                for node, res in acquired:
+                    node.resources.release(res)
+
+            for bundle in dead:
+                if pg.strategy == PlacementStrategy.STRICT_SPREAD:
+                    candidates = [n for n in alive if n.node_id not in held]
+                elif pg.strategy == PlacementStrategy.STRICT_PACK:
+                    candidates = [pack_node] if pack_node is not None else alive
+                else:
+                    candidates = list(alive)
+                chosen = None
+                for node in sorted(candidates, key=lambda n: n.utilization()):
+                    if node.resources.try_acquire(bundle.resources):
+                        chosen = node
+                        break
+                if chosen is None:
+                    rollback()
+                    return (
+                        f"no surviving node can host bundle {bundle.index} "
+                        f"({bundle.resources}) under {pg.strategy.value}"
+                    )
+                acquired.append((chosen, dict(bundle.resources)))
+                replacements.append((bundle, chosen))
+                held.add(chosen.node_id)
+                if pack_node is None:
+                    pack_node = chosen
+        # outside the lock: probe remote candidates (their death may not
+        # have aged out of heartbeats yet), then 2PC phase 2
+        remote = [(b, n) for b, n in replacements if n.is_remote]
+        for _, node in remote:
+            if not probe_agent(node):
+                with self._lock:
+                    rollback()
+                return (
+                    f"candidate node {node.node_id.hex()[:12]} is "
+                    f"unresponsive"
+                )
+        if remote and self.remote_bundle_reserver is not None:
+            shims = [
+                Bundle(b.index, dict(b.resources), node=node)
+                for b, node in remote
+            ]
+            err = self.remote_bundle_reserver(pg.id.hex(), shims)
+            if err is not None:
+                with self._lock:
+                    rollback()
+                return err
+        with self._lock:
+            for bundle, node in replacements:
+                bundle.node = node
+                bundle.reserved = ResourceSet(bundle.resources)
+        return None
+
+    def _fail_pg(self, pg: PlacementGroup, budget: int) -> None:
+        history = "; ".join(
+            f"bundles {h['bundles']} lost ({h['reason']})"
+            for h in pg.death_history
+        )
+        pg.failure_reason = (
+            f"rescheduling budget exhausted ({budget} attempt(s)); "
+            f"death history: {history or 'none'}"
+        )
+        self._pg_transition(pg, "FAILED", pg.failure_reason)
 
     # ----------------------------------------------------------- dispatch loop
 
@@ -678,20 +955,31 @@ class ClusterScheduler:
                     target, pool = bundle.node, bundle.reserved
                     break
             if target is None:
-                if not live and any(
-                    b.node is not None and not b.node.alive for b in bundles
-                ):
-                    # every eligible bundle's host is dead — a rejoined
-                    # node gets a NEW identity, so this never heals
-                    # (bundle rescheduling on node death is a tracked gap)
+                if pg.state == "FAILED":
+                    # rescheduling budget exhausted: surface the death
+                    # history instead of hanging the task forever
                     self._fail_returns(
                         spec,
                         OutOfResourcesError(
-                            f"Task {spec.name}: every placement-group bundle "
-                            f"it targets lost its host node"
+                            f"Task {spec.name}: placement group "
+                            f"{pg.id.hex()[:12]} failed: {pg.failure_reason}"
                         ),
                     )
                     return True
+                dead = [
+                    b for b in bundles
+                    if b.node is not None and not b.node.alive
+                ]
+                if not live and dead and pg.state == "RESERVED":
+                    # host death observed at dispatch before any death
+                    # notification reached the FSM (e.g. a direct
+                    # remove_node): self-heal by kicking the rescheduler
+                    self._kick_reschedule(
+                        pg, "bundle host observed dead at dispatch",
+                        [b.index for b in dead],
+                    )
+                # RESCHEDULING (or kick in flight): stay queued — the
+                # re-reservation repoints the bundles and we dispatch then
                 return False
         elif isinstance(strategy, NodeAffinitySchedulingStrategy):
             with self._lock:

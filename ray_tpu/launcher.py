@@ -119,13 +119,17 @@ class SSHLaunchProvider:
         self.config = config
         self.user = config.get("ssh_user")
         self.ssh_opts = config.get("ssh_opts", ["-o", "StrictHostKeyChecking=no"])
+        # injectable transport: tests drive the full up→join→down
+        # lifecycle through a loopback/recording fake instead of a real
+        # ssh binary; pods use the default
+        self.ssh_bin = config.get("ssh_bin", "ssh")
         self.procs: List[subprocess.Popen] = []
 
     def ssh_command(self, host: str, cmd: List[str]) -> List[str]:
         target = f"{self.user}@{host}" if self.user else host
         remote = " ".join(shlex.quote(part) for part in cmd)
         # nohup: the agent must outlive the ssh session
-        return ["ssh", *self.ssh_opts, target,
+        return [self.ssh_bin, *self.ssh_opts, target,
                 f"nohup {remote} >/tmp/ray_tpu_agent.log 2>&1 & echo $!"]
 
     def launch(self, cmd: List[str], host: str) -> Dict[str, Any]:
@@ -152,7 +156,7 @@ class SSHLaunchProvider:
             target = f"{self.user}@{host}" if self.user else host
             try:
                 subprocess.run(
-                    ["ssh", *self.ssh_opts, target,
+                    [self.ssh_bin, *self.ssh_opts, target,
                      f"pkill -f {shlex.quote(pattern)} || true"],
                     capture_output=True, timeout=30,
                 )

@@ -29,6 +29,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+from .._jax_compat import shard_map
+
 P = PartitionSpec
 
 _NEG_INF = -1e30
@@ -249,7 +251,7 @@ def ring_attention(
         )
     else:
         raise ValueError(f"unknown ring impl {impl!r}")
-    fn = jax.shard_map(
+    fn = shard_map(
         body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False,
     )

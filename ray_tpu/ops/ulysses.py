@@ -26,6 +26,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+from .._jax_compat import shard_map
+
 from .attention import flash_attention
 
 P = PartitionSpec
@@ -91,7 +93,7 @@ def ulysses_attention(
         _ulysses_local, axis_name=axis, causal=causal, sm_scale=sm_scale,
         implementation=implementation,
     )
-    fn = jax.shard_map(
+    fn = shard_map(
         body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False,
     )

@@ -29,6 +29,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+from .._jax_compat import shard_map
+
 P = PartitionSpec
 
 # In-graph aliases (use under shard_map; axis_name is the mesh axis).
@@ -108,7 +110,7 @@ class CollectiveGroup:
 
         def build():
             @partial(
-                jax.shard_map, mesh=self.mesh, in_specs=spec, out_specs=spec,
+                shard_map, mesh=self.mesh, in_specs=spec, out_specs=spec,
                 check_vma=False,
             )
             def _reduce(v):
@@ -124,7 +126,7 @@ class CollectiveGroup:
 
         def build():
             @partial(
-                jax.shard_map, mesh=self.mesh, in_specs=spec,
+                shard_map, mesh=self.mesh, in_specs=spec,
                 out_specs=out_spec, check_vma=False,
             )
             def _bcast(v):
@@ -147,7 +149,7 @@ class CollectiveGroup:
 
         def build():
             @partial(
-                jax.shard_map, mesh=self.mesh, in_specs=spec,
+                shard_map, mesh=self.mesh, in_specs=spec,
                 out_specs=out_spec, check_vma=False,
             )
             def _gather(v):
@@ -176,7 +178,7 @@ class CollectiveGroup:
 
         def build():
             @partial(
-                jax.shard_map, mesh=self.mesh, in_specs=spec,
+                shard_map, mesh=self.mesh, in_specs=spec,
                 out_specs=out_spec, check_vma=False,
             )
             def _rs(v):
@@ -192,7 +194,7 @@ class CollectiveGroup:
 
         def build():
             @partial(
-                jax.shard_map, mesh=self.mesh, in_specs=P(), out_specs=P(),
+                shard_map, mesh=self.mesh, in_specs=P(), out_specs=P(),
                 check_vma=False,
             )
             def _bar(v):

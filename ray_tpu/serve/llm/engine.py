@@ -67,6 +67,37 @@ class _Request:
     top_k: int = 0          # 0 = disabled
     top_p: float = 1.0      # 1.0 = disabled
     stop_token_ids: tuple = ()
+    # multi-token stop sequences: generation ends when the tail of the
+    # emitted tokens equals any of these (vLLM's `stop` strings, matched
+    # over tokens — the byte tokenizer makes strings == token sequences)
+    stop_sequences: tuple = ()
+    stop_tail: list = dataclasses.field(default_factory=list)
+
+
+def _normalize_stop_sequences(stop_sequences) -> tuple:
+    seqs = tuple(
+        tuple(int(t) for t in seq) for seq in (stop_sequences or ()) if seq
+    )
+    if any(len(s) == 0 for s in seqs):
+        raise ValueError("stop sequences must be non-empty token lists")
+    return seqs
+
+
+def _hit_stop_sequence(request: "_Request", token: int) -> bool:
+    """Per-token stop check over the decoded tail: append the emitted
+    token to the request's rolling tail and report whether any stop
+    sequence is now its suffix. Shared by the dense and paged engines."""
+    seqs = request.stop_sequences
+    if not seqs:
+        return False
+    tail = request.stop_tail
+    tail.append(int(token))
+    longest = max(len(s) for s in seqs)
+    if len(tail) > longest:
+        del tail[: len(tail) - longest]
+    return any(
+        len(tail) >= len(s) and tuple(tail[-len(s):]) == s for s in seqs
+    )
 
 
 class ResponseStream:
@@ -171,6 +202,7 @@ class LLMEngine:
         temperature: float = 0.0,
         *,
         stop_token_ids: Optional[List[int]] = None,
+        stop_sequences: Optional[List[List[int]]] = None,
         top_k: int = 0,
         top_p: float = 1.0,
     ) -> ResponseStream:
@@ -191,6 +223,7 @@ class LLMEngine:
             temperature=temperature,
             out=queue.Queue(),
             stop_token_ids=tuple(stop_token_ids or ()),
+            stop_sequences=_normalize_stop_sequences(stop_sequences),
         )
         self._queue.put(request)
         _reject_if_dead(self, request)
@@ -254,6 +287,7 @@ class LLMEngine:
             slot.remaining <= 0
             or first == self.config.eos_id
             or first in request.stop_token_ids
+            or _hit_stop_sequence(request, first)
         ):
             self._finish(slot)
 
@@ -291,6 +325,7 @@ class LLMEngine:
             if (
                 token == self.config.eos_id
                 or token in slot.request.stop_token_ids
+                or _hit_stop_sequence(slot.request, token)
                 or slot.remaining <= 0
                 or slot.position >= self.max_seq - 1
             ):

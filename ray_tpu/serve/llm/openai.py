@@ -167,18 +167,40 @@ class OpenAIFrontend:
         if isinstance(stop, str):
             stop = [stop]
         if isinstance(stop, list):
-            # each single-byte stop string maps onto stop_token_ids;
-            # multi-byte sequences are not supported by the engine's
-            # per-token stop check and are rejected rather than ignored
+            # single-byte stop strings map onto stop_token_ids; longer
+            # ones ship as token sequences the engine matches over the
+            # decoded tail (engine.py _hit_stop_sequence)
             for item in stop:
                 ids = ByteTokenizer.encode(str(item))
-                if len(ids) != 1:
-                    raise ValueError(
-                        f"stop sequence {item!r} is not a single byte; "
-                        "multi-byte stop sequences are unsupported"
-                    )
-                payload.setdefault("stop_token_ids", []).append(ids[0])
+                if not ids:
+                    continue
+                if len(ids) == 1:
+                    payload.setdefault("stop_token_ids", []).append(ids[0])
+                else:
+                    payload.setdefault("stop_sequences", []).append(ids)
         return payload
+
+    @staticmethod
+    def _stop_strings(req: Dict[str, Any]) -> List[str]:
+        stop = req.get("stop")
+        if isinstance(stop, str):
+            stop = [stop]
+        if not isinstance(stop, list):
+            return []
+        return [str(s) for s in stop if str(s)]
+
+    @staticmethod
+    def _truncate_at_stop(text: str, stops: List[str]):
+        """OpenAI semantics: the stop sequence itself is never returned.
+        Returns (text up to the earliest stop occurrence, hit?)."""
+        cut = None
+        for s in stops:
+            i = text.find(s)
+            if i >= 0 and (cut is None or i < cut):
+                cut = i
+        if cut is None:
+            return text, False
+        return text[:cut], True
 
     def _completions(self, http, req: Dict[str, Any], chat: bool) -> None:
         from ... import api as core_api
@@ -190,14 +212,18 @@ class OpenAIFrontend:
         created = int(time.time())
         obj = "chat.completion" if chat else "text_completion"
 
+        stops = self._stop_strings(req)
         if req.get("stream"):
-            self._stream_sse(http, handle, payload, rid, created, model_id, chat)
+            self._stream_sse(http, handle, payload, rid, created, model_id,
+                             chat, stops)
             return
         result = core_api.get(handle.generate.remote(payload), timeout=300)
         text = ByteTokenizer.decode(result["tokens"])
+        text, stopped = self._truncate_at_stop(text, stops)
         finish = (
             "length"
-            if result["usage"]["completion_tokens"] >= payload["max_tokens"]
+            if not stopped
+            and result["usage"]["completion_tokens"] >= payload["max_tokens"]
             else "stop"
         )
         choice: Dict[str, Any] = {"index": 0, "finish_reason": finish,
@@ -212,9 +238,14 @@ class OpenAIFrontend:
         })
 
     def _stream_sse(self, http, handle, payload, rid, created, model_id,
-                    chat) -> None:
+                    chat, stops: Optional[List[str]] = None) -> None:
         """Server-sent events, OpenAI stream shape: one chunk per token,
-        a final usage-bearing chunk, then `data: [DONE]`."""
+        a final usage-bearing chunk, then `data: [DONE]`.
+
+        Stop strings are enforced here too: decoded text that could be
+        the prefix of a stop string is held back until it either
+        completes the stop (dropped, stream finishes with
+        finish_reason="stop") or diverges (flushed)."""
         from ... import api as core_api
 
         obj = "chat.completion.chunk" if chat else "text_completion"
@@ -243,30 +274,62 @@ class OpenAIFrontend:
         # byte-tokens must not degrade to U+FFFD per byte — buffer until
         # the sequence completes, exactly like the non-streamed decode
         decoder = codecs.getincrementaldecoder("utf-8")("replace")
+        stops = stops or []
+        buf = ""  # decoded text held back as a possible stop prefix
+        stopped = False
+
+        def holdback(text: str) -> int:
+            """Longest suffix of `text` that is a proper prefix of some
+            stop string (must be withheld until it resolves)."""
+            hold = 0
+            for s in stops:
+                for k in range(min(len(s) - 1, len(text)), hold, -1):
+                    if text.endswith(s[:k]):
+                        hold = k
+                        break
+            return hold
+
+        def text_choice(text: str) -> Dict[str, Any]:
+            if chat:
+                return {"index": 0, "finish_reason": None,
+                        "delta": {"content": text}}
+            return {"index": 0, "finish_reason": None,
+                    "logprobs": None, "text": text}
+
         try:
             for ref in stream:
                 item = core_api.get(ref, timeout=300)
                 if "token" in item:
                     tok = item["token"]
-                    if not 0 <= tok < 256:
+                    if stopped or not 0 <= tok < 256:
                         continue  # same contract as ByteTokenizer.decode
-                    text = decoder.decode(bytes([tok]))
-                    if not text:
+                    piece = decoder.decode(bytes([tok]))
+                    if not piece:
                         continue  # mid-sequence: held back
-                    if chat:
-                        choice = {"index": 0, "finish_reason": None,
-                                  "delta": {"content": text}}
+                    buf += piece
+                    buf, hit = self._truncate_at_stop(buf, stops)
+                    if hit:
+                        stopped = True
+                        hold = 0
                     else:
-                        choice = {"index": 0, "finish_reason": None,
-                                  "logprobs": None, "text": text}
-                    send(chunk_body(choice))
+                        hold = holdback(buf)
+                    emit_now = buf[: len(buf) - hold] if hold else buf
+                    buf = buf[len(buf) - hold:] if hold else ""
+                    if emit_now:
+                        send(chunk_body(text_choice(emit_now)))
                 elif item.get("done"):
-                    tail = decoder.decode(b"", final=True)
+                    tail = "" if stopped else decoder.decode(b"", final=True)
+                    # held-back text before a stop still ships; the stop
+                    # string itself never does
+                    tail, hit = self._truncate_at_stop(buf + tail, stops)
+                    stopped = stopped or hit
                     usage = item.get("usage") or {}
                     finish = (
-                        "length"
-                        if usage.get("completion_tokens", 0)
-                        >= payload["max_tokens"] else "stop"
+                        "stop" if stopped else (
+                            "length"
+                            if usage.get("completion_tokens", 0)
+                            >= payload["max_tokens"] else "stop"
+                        )
                     )
                     final = {"index": 0, "finish_reason": finish}
                     if chat:

@@ -42,7 +42,14 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...models.transformer import TransformerConfig
-from .engine import ResponseStream, _Request, _fail_all_requests, _reject_if_dead
+from .engine import (
+    ResponseStream,
+    _Request,
+    _fail_all_requests,
+    _hit_stop_sequence,
+    _normalize_stop_sequences,
+    _reject_if_dead,
+)
 from .paged import (
     PagedConfig,
     PageAllocator,
@@ -415,6 +422,7 @@ class PagedLLMEngine:
         top_k: int = 0,
         top_p: float = 1.0,
         stop_token_ids: Optional[List[int]] = None,
+        stop_sequences: Optional[List[List[int]]] = None,
     ) -> ResponseStream:
         limit = self.paged.max_slot_tokens
         if len(prompt_tokens) + max_tokens > limit:
@@ -435,6 +443,7 @@ class PagedLLMEngine:
             top_k=int(top_k),
             top_p=float(top_p),
             stop_token_ids=tuple(stop_token_ids or ()),
+            stop_sequences=_normalize_stop_sequences(stop_sequences),
         )
         self._queue.put(request)
         _reject_if_dead(self, request)
@@ -776,6 +785,7 @@ class PagedLLMEngine:
         if (
             token == self.config.eos_id
             or token in request.stop_token_ids
+            or _hit_stop_sequence(request, token)
             or slot.emit_remaining <= 0
         ):
             slot.finished_emit = True

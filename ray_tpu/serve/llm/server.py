@@ -63,7 +63,8 @@ class LLMServer:
         prompt = payload["prompt_tokens"]
         kwargs = {}
         for name, cast in (("top_k", int), ("top_p", float),
-                           ("stop_token_ids", list)):
+                           ("stop_token_ids", list),
+                           ("stop_sequences", list)):
             if name in payload:
                 kwargs[name] = cast(payload[name])
         stream = self.engine.submit(

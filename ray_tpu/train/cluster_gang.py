@@ -46,7 +46,8 @@ class _GangHostActor:
         self._result: Any = None
 
     def start(self, train_fn: Callable, config, coordinator: str,
-              num_processes: int, process_id: int, run_name: str) -> bool:
+              num_processes: int, process_id: int, run_name: str,
+              init_distributed: bool = True) -> bool:
         import threading
 
         def go() -> None:
@@ -72,7 +73,7 @@ class _GangHostActor:
                     )
 
             try:
-                if num_processes > 1:
+                if num_processes > 1 and init_distributed:
                     jax.distributed.initialize(
                         coordinator_address=coordinator,
                         num_processes=num_processes,
@@ -89,7 +90,7 @@ class _GangHostActor:
                     )
                 finally:
                     _set_session(None)
-                    if num_processes > 1:
+                    if num_processes > 1 and init_distributed:
                         try:
                             jax.distributed.shutdown()
                         except Exception:
@@ -126,7 +127,15 @@ class ClusterWorkerGroup:
     """MultihostWorkerGroup sibling whose members are cluster-hosted
     actors inside a placement group (one bundle per node by default).
     Same start/run_async/poll/finish/shutdown surface, so
-    TrainController drives it via group_factory."""
+    TrainController drives it via group_factory.
+
+    Elastic re-mesh: pass an existing `pg` (e.g. shared across
+    TrainController restart attempts) and start() waits for the group to
+    be RESERVED — after a bundle host death that means waiting out the
+    PG's RESCHEDULING pass — then re-elects a coordinator from the
+    CURRENT bundle-0 host and assembles a fresh gang on whatever nodes
+    now hold the bundles. An externally supplied pg is never removed by
+    shutdown(), so it survives gang teardown between attempts."""
 
     def __init__(
         self,
@@ -135,24 +144,44 @@ class ClusterWorkerGroup:
         run_name: str = "train_run",
         env_per_worker: Optional[List[Dict[str, str]]] = None,
         placement_strategy: str = "STRICT_SPREAD",
+        pg: Any = None,
+        init_distributed: bool = True,
+        pg_wait_s: float = 60.0,
     ):
         self.num_workers = num_workers
         self.resources_per_worker = dict(resources_per_worker or {"CPU": 1.0})
         self.run_name = run_name
         self.env_per_worker = env_per_worker
         self.placement_strategy = placement_strategy
-        self.pg = None
+        self.pg = pg
+        self._owns_pg = pg is None
+        self.init_distributed = init_distributed
+        self.pg_wait_s = pg_wait_s
         self.workers: List[Any] = []
         self._coordinator: Optional[str] = None
 
     def start(self) -> None:
-        bundles = [dict(self.resources_per_worker)
-                   for _ in range(self.num_workers)]
-        self.pg = api.placement_group(
-            bundles, strategy=self.placement_strategy,
-            name=f"{self.run_name}-gang",
-        )
-        self.pg.ready(timeout=60)
+        if self.pg is None:
+            bundles = [dict(self.resources_per_worker)
+                       for _ in range(self.num_workers)]
+            self.pg = api.placement_group(
+                bundles, strategy=self.placement_strategy,
+                name=f"{self.run_name}-gang",
+            )
+            self._owns_pg = True
+            self.pg.ready(timeout=60)
+        elif len(self.pg.bundles) < self.num_workers:
+            raise ValueError(
+                f"placement group has {len(self.pg.bundles)} bundles; "
+                f"gang needs {self.num_workers}"
+            )
+        # A shared PG may be mid-reschedule after a node death: park
+        # until the 2PC re-reserved every bundle (or the group failed).
+        if not self.pg.wait_reserved(timeout=self.pg_wait_s):
+            raise RuntimeError(
+                f"placement group for {self.run_name} is not reservable "
+                f"({self.pg.state}): {self.pg.failure_reason or 'timed out'}"
+            )
         # The coordinator lives in rank 0's process, on bundle 0's host.
         # Remote members must be able to REACH it: a remote bundle-0
         # advertises its agent's host; a local bundle-0 advertises the
@@ -168,6 +197,14 @@ class ClusterWorkerGroup:
             ctx = getattr(rt, "cluster", None)
             host = ctx.address.split(":")[0] if ctx is not None else "127.0.0.1"
         self._coordinator = f"{host}:{_free_port()}"
+        from ..util.events import emit
+
+        emit("INFO", "train",
+             f"gang {self.run_name}: coordinator elected at "
+             f"{self._coordinator}",
+             bundle0=(
+                 node0.node_id.hex() if node0 is not None else None
+             ))
         Host = api.remote(_GangHostActor)
         per = dict(self.resources_per_worker)
         num_cpus = per.pop("CPU", 0.0)
@@ -191,7 +228,7 @@ class ClusterWorkerGroup:
         acks = [
             w.start.remote(
                 train_fn, config, self._coordinator, self.num_workers,
-                rank, self.run_name,
+                rank, self.run_name, self.init_distributed,
             )
             for rank, w in enumerate(self.workers)
         ]
@@ -215,10 +252,10 @@ class ClusterWorkerGroup:
                 api.kill(w)
             except Exception:
                 pass
-        if self.pg is not None:
+        if self.pg is not None and self._owns_pg:
             try:
                 api.remove_placement_group(self.pg)
             except Exception:
                 pass
+            self.pg = None
         self.workers = []
-        self.pg = None

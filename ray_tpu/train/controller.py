@@ -60,12 +60,18 @@ class TrainController:
         train_config: Optional[Dict[str, Any]] = None,
         poll_interval: float = 0.05,
         group_factory: Optional[Callable[[], Any]] = None,
+        restart_backoff_s: float = 1.0,
     ):
         self.train_fn = train_fn
         self.scaling = scaling
         self.run_config = run_config
         self.train_config = train_config
         self.poll_interval = poll_interval
+        # pause between restart attempts: a gang that died with its node
+        # usually needs the cluster to DECLARE the death (heartbeat
+        # staleness) and reschedule the placement group before a restart
+        # can succeed — hot-looping would just burn the failure budget
+        self.restart_backoff_s = restart_backoff_s
         # default: in-process actor gang; pass a factory building a
         # MultihostWorkerGroup for one-process-per-host SPMD (multihost.py)
         self.group_factory = group_factory
@@ -110,15 +116,24 @@ class TrainController:
                     run_name=self.run_config.name,
                     trial_dir=self.run_config.storage_path,
                 )
+            from ..util.events import emit
+
             try:
                 group.start()
                 self.status = RunStatus.RUNNING
+                emit("INFO", "train",
+                     f"run {self.run_config.name}: gang of {num_workers} "
+                     f"running (attempt {self.num_restarts + 1})")
                 outcome = self._poll_until_done(group)
                 if outcome is None:  # clean finish
                     self.status = RunStatus.FINISHED
+                    emit("INFO", "train",
+                         f"run {self.run_config.name} finished "
+                         f"({self.num_restarts} restart(s))")
                     return self._result(None)
                 error = outcome
-            except (ActorDiedError, TaskError, RayTpuError) as e:
+            except (ActorDiedError, TaskError, RayTpuError, RuntimeError,
+                    TimeoutError) as e:
                 error = repr(e)
             finally:
                 group.shutdown()
@@ -126,12 +141,21 @@ class TrainController:
             if policy.should_restart():
                 self.status = RunStatus.RESTARTING
                 self.num_restarts += 1
+                emit("WARNING", "train",
+                     f"run {self.run_config.name} restarting from "
+                     f"checkpoint step {self.latest_checkpoint_step} "
+                     f"(restart {self.num_restarts}): {error}")
                 # the train_fn is responsible for resuming from
                 # latest_checkpoint_step (passed through train_config)
                 if self.train_config is not None:
                     self.train_config["resume_from_step"] = self.latest_checkpoint_step
+                if self.restart_backoff_s > 0:
+                    time.sleep(self.restart_backoff_s)
                 continue
             self.status = RunStatus.ERRORED
+            emit("ERROR", "train",
+                 f"run {self.run_config.name} errored after "
+                 f"{self.num_restarts} restart(s): {error}")
             return self._result(error)
 
     def _poll_until_done(self, group: WorkerGroup) -> Optional[str]:

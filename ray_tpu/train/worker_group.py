@@ -69,6 +69,7 @@ class WorkerGroup:
         resources_per_worker: Dict[str, float],
         run_name: str = "train_run",
         trial_dir: Optional[str] = None,
+        pg: Optional[PlacementGroup] = None,
     ):
         self.num_workers = num_workers
         self.resources_per_worker = resources_per_worker
@@ -81,15 +82,27 @@ class WorkerGroup:
 
             trial_dir = tempfile.mkdtemp(prefix=f"ray_tpu_train_{run_name}_")
         self.trial_dir = trial_dir
-        self.pg: Optional[PlacementGroup] = None
+        # An externally shared pg (e.g. reused across TrainController
+        # restart attempts) is waited on, not created, and never removed.
+        self.pg: Optional[PlacementGroup] = pg
+        self._owns_pg = pg is None
         self.workers: List[Any] = []
 
     def start(self) -> None:
-        bundles = [dict(self.resources_per_worker) for _ in range(self.num_workers)]
-        self.pg = api.placement_group(bundles, strategy="PACK")
-        if not self.pg.ready(timeout=30):
-            raise TimeoutError(
-                f"placement group for {self.run_name} not placed within 30s"
+        if self.pg is None:
+            bundles = [
+                dict(self.resources_per_worker) for _ in range(self.num_workers)
+            ]
+            self.pg = api.placement_group(bundles, strategy="PACK")
+            self._owns_pg = True
+            if not self.pg.ready(timeout=30):
+                raise TimeoutError(
+                    f"placement group for {self.run_name} not placed within 30s"
+                )
+        if not self.pg.wait_reserved(timeout=60):
+            raise RuntimeError(
+                f"placement group for {self.run_name} is not reservable "
+                f"({self.pg.state}): {self.pg.failure_reason or 'timed out'}"
             )
         actor_cls = api.remote(TrainWorker)
         from ..core.scheduler import PlacementGroupSchedulingStrategy
@@ -127,10 +140,10 @@ class WorkerGroup:
                 api.kill(w)
             except Exception:
                 pass
-        if self.pg is not None:
+        if self.pg is not None and self._owns_pg:
             try:
                 api.remove_placement_group(self.pg)
             except Exception:
                 pass
+            self.pg = None
         self.workers = []
-        self.pg = None

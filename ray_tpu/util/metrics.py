@@ -170,6 +170,17 @@ def registry() -> MetricsRegistry:
     return _registry()
 
 
+def get_or_create_counter(name: str, description: str = "",
+                          tag_keys: Sequence[str] = ()) -> Counter:
+    """Idempotent Counter accessor for emitters that may re-run (runtime
+    re-init, module reload): returns the registered series instead of
+    shadowing it with a fresh zeroed one."""
+    existing = _registry().get(name)
+    if isinstance(existing, Counter):
+        return existing
+    return Counter(name, description, tag_keys)
+
+
 def register_runtime_gauges() -> None:
     """Callback gauges over live runtime internals (scrape-time sampling)."""
     from ..core import runtime as rt

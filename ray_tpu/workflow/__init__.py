@@ -8,6 +8,14 @@ tasks, persisting each result under storage/<workflow_id>/<step_id>.pkl.
 Step ids are content-addressed (function name + argument structure), so
 re-running the same driver code after a crash skips every step whose
 result is already on disk — exactly-once-ish without a database.
+
+Per-step robustness: `@workflow.step(max_retries=3)` re-runs a step that
+raised (any exception) up to N times before the failure propagates, and
+`@workflow.step(timeout_s=30)` bounds how long run() waits for the
+step's result — a hung step surfaces WorkflowStepTimeout instead of
+wedging the whole workflow. Both also available per-call through
+`fn.options(...)`. Retry/timeout settings are not part of the step id,
+so tuning them never invalidates persisted results.
 """
 
 from __future__ import annotations
@@ -21,12 +29,20 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 from .. import api
 
 
+class WorkflowStepTimeout(TimeoutError):
+    """A step exceeded its timeout_s budget; its result never arrived."""
+
+
 @dataclasses.dataclass(frozen=True)
 class StepNode:
     fn: Callable
     args: Tuple[Any, ...]
     kwargs: Tuple[Tuple[str, Any], ...]
     name: str
+    # robustness knobs — deliberately NOT hashed into step_id, so tuning
+    # them on a resumed run still reuses persisted results
+    max_retries: int = 0
+    timeout_s: Optional[float] = None
 
     @property
     def step_id(self) -> str:
@@ -50,22 +66,38 @@ def _digest(value: Any) -> bytes:
 
 
 class _StepFunction:
-    def __init__(self, fn: Callable, name: Optional[str] = None):
+    def __init__(self, fn: Callable, name: Optional[str] = None,
+                 max_retries: int = 0, timeout_s: Optional[float] = None):
         self._fn = fn
         self._name = name or fn.__name__
+        self._max_retries = max_retries
+        self._timeout_s = timeout_s
+
+    def options(self, *, max_retries: Optional[int] = None,
+                timeout_s: Optional[float] = None) -> "_StepFunction":
+        """Per-call override of the step's retry/timeout settings."""
+        return _StepFunction(
+            self._fn, self._name,
+            self._max_retries if max_retries is None else max_retries,
+            self._timeout_s if timeout_s is None else timeout_s,
+        )
 
     def step(self, *args, **kwargs) -> StepNode:
-        return StepNode(self._fn, args, tuple(sorted(kwargs.items())), self._name)
+        return StepNode(
+            self._fn, args, tuple(sorted(kwargs.items())), self._name,
+            max_retries=self._max_retries, timeout_s=self._timeout_s,
+        )
 
     def __call__(self, *args, **kwargs):
         return self._fn(*args, **kwargs)
 
 
-def step(fn: Optional[Callable] = None, *, name: Optional[str] = None):
+def step(fn: Optional[Callable] = None, *, name: Optional[str] = None,
+         max_retries: int = 0, timeout_s: Optional[float] = None):
     """@workflow.step decorator; build nodes with fn.step(...)."""
     if fn is None:
-        return lambda f: _StepFunction(f, name)
-    return _StepFunction(fn, name)
+        return lambda f: _StepFunction(f, name, max_retries, timeout_s)
+    return _StepFunction(fn, name, max_retries, timeout_s)
 
 
 # ------------------------------------------------------------------ execution
@@ -128,7 +160,25 @@ def run(
             k: (submit(v) if isinstance(v, StepNode) else v) for k, v in n.kwargs
         }
         # args that are refs are resolved by the runtime before fn runs
-        ref = run_step.remote(n.fn, sid, store.dir, *resolved_args, **resolved_kwargs)
+        task = run_step
+        if n.max_retries:
+            task = run_step.options(
+                max_retries=n.max_retries, retry_exceptions=True
+            )
+        ref = task.remote(n.fn, sid, store.dir, *resolved_args, **resolved_kwargs)
+        if n.timeout_s is not None:
+            # bound the wait HERE: downstream steps must never bind to a
+            # ref that may hang forever
+            from ..core.exceptions import GetTimeoutError
+
+            try:
+                value = api.get(ref, timeout=n.timeout_s)
+            except GetTimeoutError:
+                raise WorkflowStepTimeout(
+                    f"step {sid} did not finish within {n.timeout_s}s"
+                ) from None
+            memo[sid] = value
+            return value
         memo[sid] = ref
         return ref
 

@@ -57,6 +57,33 @@ def test_chaos_env_parsing(monkeypatch):
     assert cfg.delay_s == 0.01
     assert cfg.max_injections == 3
     assert cfg.name_filter == "x"
+    assert cfg.kill_node is False
+
+
+def test_kill_node_env_parsing(monkeypatch):
+    monkeypatch.setenv(
+        "RAY_TPU_CHAOS", "kill_node=1,name_filter=boom,max_injections=1"
+    )
+    chaos.load_from_env()
+    cfg = chaos._state.config
+    assert cfg.kill_node is True
+    assert cfg.name_filter == "boom"
+    assert cfg.max_injections == 1
+
+
+def test_kill_node_hard_exits_matching_task(monkeypatch):
+    """kill_node escalates an injection to process death (os._exit):
+    filtered by task name, bounded by max_injections."""
+    exits = []
+    monkeypatch.setattr(chaos.os, "_exit", lambda code: exits.append(code))
+    chaos.set_chaos(kill_node=True, name_filter="die", max_injections=1)
+    chaos.maybe_inject("innocent")
+    assert exits == []
+    chaos.maybe_inject("die-here")
+    assert exits == [137]
+    assert chaos.num_injected() == 1
+    chaos.maybe_inject("die-here")  # budget exhausted: no second kill
+    assert exits == [137]
 
 
 def test_chaos_under_training_controller_restart():

@@ -320,3 +320,373 @@ def test_cluster_hosted_train_gang_matches_single_process(gang_cluster):
         assert member_losses == pytest.approx(baseline, rel=1e-5)
     # reports streamed back over the actor plane from both ranks
     assert {r[2] for r in reports} == {0, 1}
+
+
+# ----------------------------------------------------------------- failover
+# Node-death recovery: a bundle host dying moves its PG through
+# RESERVED -> RESCHEDULING -> RESERVED (re-reserved on a surviving
+# node), budgeted bundle actors restart into the re-reserved bundle,
+# and a cluster-hosted train gang re-meshes and resumes from its latest
+# checkpoint. Node kills go through the chaos harness (kill_node mode),
+# so the same injection machinery covers task faults AND host loss.
+
+_CHAOS_KILL_ENV = {
+    "RAY_TPU_CHAOS": "kill_node=1,name_filter=chaos-kill,max_injections=1"
+}
+
+
+@pytest.fixture
+def failover_cluster():
+    """Head (1 CPU) + 3 agents with gang:1 each, armed with a chaos
+    kill_node trigger: any task named 'chaos-kill' executed on an agent
+    hard-kills that agent (os._exit), simulating host loss. A 2-bundle
+    STRICT_SPREAD PG leaves exactly one spare gang-capable agent."""
+    c = Cluster(
+        head_node_args={
+            "num_cpus": 1,
+            "_system_config": {
+                "node_stale_s": 2.0,
+                "node_heartbeat_s": 0.2,
+                "pg_reschedule_backoff_s": 0.2,
+            },
+        }
+    )
+    for _ in range(3):
+        c.add_node(
+            num_cpus=3, resources={"gang": 1},
+            system_config={"node_heartbeat_s": 0.2, "node_stale_s": 2.0},
+            env=dict(_CHAOS_KILL_ENV),
+        )
+    c.wait_for_nodes(4)
+    yield c
+    c.shutdown()
+    from ray_tpu.core.config import cfg
+
+    cfg.reset()
+
+
+def _chaos_kill_node(node_id):
+    """Kill a node through the chaos harness: dispatch a task named to
+    match the agents' kill_node filter, pinned to the victim."""
+    from ray_tpu.core.scheduler import NodeAffinitySchedulingStrategy
+
+    @ray_tpu.remote(num_cpus=0, name="chaos-kill")
+    def boom():  # pragma: no cover - the agent dies before returning
+        return "unreachable"
+
+    boom.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(node_id)
+    ).remote()  # fire and forget: the result never arrives
+
+
+def _agent_pids(cluster):
+    return {
+        rec["node_id"]: rec["pid"]
+        for rec in cluster.runtime.cluster.nodes()
+        if not rec["is_head"]
+    }
+
+
+def _pg_event_states(pg):
+    from ray_tpu.util.events import events
+
+    return [
+        e["extra"]["state"]
+        for e in events().list(source="placement_groups")
+        if e.get("extra", {}).get("pg") == pg.id.hex()
+        and e["extra"].get("state")
+    ]
+
+
+def test_pg_reschedules_bundle_after_node_death(failover_cluster):
+    """Kill bundle 1's host: the PG transitions RESERVED ->
+    RESCHEDULING -> RESERVED with the bundle re-reserved (2PC) on the
+    spare agent; tasks dispatched into the bundle land there."""
+    pg = ray_tpu.placement_group(
+        [{"gang": 1}, {"gang": 1}], strategy="STRICT_SPREAD"
+    )
+    assert pg.ready(timeout=10)
+    assert pg.state == "RESERVED"
+    agent_pids = _agent_pids(failover_cluster)
+    victim_hex = pg.bundles[1].node.node_id.hex()
+    spare_hexes = set(agent_pids) - {
+        b.node.node_id.hex() for b in pg.bundles
+    }
+    assert len(spare_hexes) == 1
+
+    _chaos_kill_node(pg.bundles[1].node.node_id)
+
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        node = pg.bundles[1].node
+        if (
+            pg.state == "RESERVED"
+            and node is not None
+            and node.node_id.hex() != victim_hex
+        ):
+            break
+        time.sleep(0.1)
+    assert pg.state == "RESERVED", (pg.state, pg.failure_reason)
+    assert pg.bundles[1].node.node_id.hex() in spare_hexes
+    assert pg.reschedules_used >= 1
+    assert pg.death_history
+    assert victim_hex[:12] in pg.death_history[0]["reason"]
+
+    # the spare agent's own ledger holds the re-reserved bundle (2PC
+    # phase 2 landed there), so both surviving gang agents show 0 free
+    held = _agent_available("gang")
+    assert list(held.values()) == [0.0, 0.0], f"agent ledgers: {held}"
+
+    # work scheduled into the re-reserved bundle executes on the spare
+    @ray_tpu.remote(num_cpus=0, resources={"gang": 1})
+    def whoami():
+        return os.getpid()
+
+    pid = ray_tpu.get(
+        whoami.options(
+            scheduling_strategy=PlacementGroupSchedulingStrategy(
+                pg, placement_group_bundle_index=1
+            )
+        ).remote(),
+        timeout=60,
+    )
+    assert pid == agent_pids[pg.bundles[1].node.node_id.hex()]
+
+    # the event stream recorded the full transition sequence...
+    states = _pg_event_states(pg)
+    assert states[0] == "RESERVED"
+    assert "RESCHEDULING" in states
+    assert states[-1] == "RESERVED"
+    # ...and the GCS PG table mirrors the final state cluster-wide
+    rec = failover_cluster.runtime.cluster.gcs.pg_state(pg.id.hex())
+    assert rec["state"] == "RESERVED"
+    assert rec["reschedules_used"] >= 1
+    assert rec["death_history"]
+    ray_tpu.remove_placement_group(pg)
+
+
+def test_bundle_actor_restarts_into_rescheduled_bundle(failover_cluster):
+    """A max_restarts-budgeted actor living in a bundle follows its
+    bundle: node death -> PG re-reserves on the spare -> the actor FSM
+    (ALIVE -> RESTARTING -> ALIVE) lands it on the bundle's new host."""
+    pg = ray_tpu.placement_group(
+        [{"gang": 1, "CPU": 1}, {"gang": 1, "CPU": 1}],
+        strategy="STRICT_SPREAD",
+    )
+    assert pg.ready(timeout=10)
+    agent_pids = _agent_pids(failover_cluster)
+
+    @ray_tpu.remote(num_cpus=1, resources={"gang": 1}, max_restarts=1)
+    class Member:
+        def where(self):
+            return os.getpid()
+
+    member = Member.options(
+        scheduling_strategy=PlacementGroupSchedulingStrategy(
+            pg, placement_group_bundle_index=1
+        )
+    ).remote()
+    old_pid = ray_tpu.get(member.where.remote(), timeout=60)
+    victim_hex = pg.bundles[1].node.node_id.hex()
+    assert old_pid == agent_pids[victim_hex]
+
+    _chaos_kill_node(pg.bundles[1].node.node_id)
+
+    new_pid = None
+    deadline = time.monotonic() + 90
+    while time.monotonic() < deadline:
+        try:
+            new_pid = ray_tpu.get(member.where.remote(), timeout=30)
+            if new_pid != old_pid:
+                break
+        except Exception:
+            time.sleep(0.3)  # death window: calls fail until RESTARTING
+    assert new_pid is not None and new_pid != old_pid
+    new_hex = pg.bundles[1].node.node_id.hex()
+    assert new_hex != victim_hex
+    assert new_pid == agent_pids[new_hex]
+    ray_tpu.kill(member)
+    ray_tpu.remove_placement_group(pg)
+
+
+@pytest.fixture
+def single_agent_cluster():
+    c = Cluster(
+        head_node_args={
+            "num_cpus": 1,
+            "_system_config": {
+                "node_stale_s": 2.0,
+                "node_heartbeat_s": 0.2,
+            },
+        }
+    )
+    c.add_node(num_cpus=2, resources={"gang": 1},
+               system_config={"node_heartbeat_s": 0.2},
+               env=dict(_CHAOS_KILL_ENV))
+    c.wait_for_nodes(2)
+    yield c
+    c.shutdown()
+    from ray_tpu.core.config import cfg
+
+    cfg.reset()
+
+
+def test_pg_budget_exhausted_fails_with_death_history(single_agent_cluster):
+    """max_reschedules=0: the first bundle-host death exhausts the
+    budget; the PG lands in FAILED and tasks targeting it fail with a
+    clear error carrying the death history."""
+    from ray_tpu.core.exceptions import OutOfResourcesError
+
+    pg = ray_tpu.placement_group([{"gang": 1}], max_reschedules=0)
+    assert pg.ready(timeout=10)
+    victim_hex = pg.bundles[0].node.node_id.hex()
+
+    _chaos_kill_node(pg.bundles[0].node.node_id)
+
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline and pg.state != "FAILED":
+        time.sleep(0.1)
+    assert pg.state == "FAILED"
+    assert "death history" in pg.failure_reason
+    assert victim_hex[:12] in pg.failure_reason
+    assert not pg.wait_reserved(timeout=1)
+
+    @ray_tpu.remote(num_cpus=0, resources={"gang": 1})
+    def doomed():
+        return 1
+
+    with pytest.raises(OutOfResourcesError, match="death history"):
+        ray_tpu.get(
+            doomed.options(
+                scheduling_strategy=PlacementGroupSchedulingStrategy(pg)
+            ).remote(),
+            timeout=30,
+        )
+    states = _pg_event_states(pg)
+    assert states[-1] == "FAILED"
+    ray_tpu.remove_placement_group(pg)
+
+
+def _make_step_train_fn():
+    """Checkpoint-aware toy train loop (built in function scope so
+    cloudpickle ships it by value to agent-hosted actors): reports a
+    decreasing loss per step and resumes from resume_from_step — the
+    controller feeds it the latest checkpoint step across restarts."""
+
+    def fn(config):
+        import time as _time
+
+        from ray_tpu.train import report
+
+        total = config["total_steps"]
+        resume = config.get("resume_from_step")
+        start = (resume + 1) if resume is not None else 0
+        for step in range(start, total):
+            _time.sleep(config["step_s"])
+            try:
+                report(
+                    {"loss": 1.0 / (step + 1.0), "step": step},
+                    checkpoint_step=step,
+                )
+            except RuntimeError:
+                pass
+        return start
+
+    return fn
+
+
+def test_cluster_gang_remesh_on_node_death(failover_cluster):
+    """THE failover capstone: kill the agent hosting bundle 1 mid-train.
+    The PG re-reserves on the spare node, the controller re-meshes the
+    gang there with a freshly elected coordinator, training resumes from
+    the latest checkpoint (steps never replay), and the loss curve
+    continues to the end."""
+    import threading
+
+    from ray_tpu.train import (
+        ClusterWorkerGroup,
+        FailureConfig,
+        RunConfig,
+        RunStatus,
+        ScalingConfig,
+        TrainController,
+    )
+
+    pg = ray_tpu.placement_group(
+        [{"CPU": 1, "gang": 1}, {"CPU": 1, "gang": 1}],
+        strategy="STRICT_SPREAD",
+    )
+    assert pg.ready(timeout=10)
+    victim_hex = pg.bundles[1].node.node_id.hex()
+
+    groups = []
+
+    def factory():
+        group = ClusterWorkerGroup(
+            num_workers=2,
+            resources_per_worker={"CPU": 1, "gang": 1},
+            run_name="failover-gang",
+            env_per_worker=[dict(_HOST_ENV) for _ in range(2)],
+            pg=pg,
+            init_distributed=False,  # recovery paths under test, not SPMD
+            pg_wait_s=60,
+        )
+        groups.append(group)
+        return group
+
+    total_steps = 40
+    controller = TrainController(
+        _make_step_train_fn(),
+        ScalingConfig(
+            num_workers=2, resources_per_worker={"CPU": 1, "gang": 1}
+        ),
+        RunConfig(name="failover-gang", failure=FailureConfig(max_failures=10)),
+        train_config={"total_steps": total_steps, "step_s": 0.25},
+        group_factory=factory,
+        restart_backoff_s=0.5,
+    )
+    box = {}
+    runner = threading.Thread(
+        target=lambda: box.update(result=controller.run()), daemon=True
+    )
+    runner.start()
+
+    # let training produce a few checkpointed steps, then kill bundle
+    # 1's host mid-train
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline and len(controller.metrics_history) < 3:
+        time.sleep(0.1)
+    assert controller.metrics_history, "gang never reported"
+    _chaos_kill_node(pg.bundles[1].node.node_id)
+
+    runner.join(timeout=240)
+    assert not runner.is_alive(), "controller never finished after failover"
+    result = box["result"]
+    assert result.status == RunStatus.FINISHED, result.error
+    assert result.error is None
+    assert result.num_restarts >= 1
+
+    # resumed from the latest checkpoint: steps strictly increase (no
+    # replay, no gap) and reach the end; the loss curve continues
+    steps = [m["step"] for m in result.metrics_history]
+    assert steps[0] == 0
+    assert steps[-1] == total_steps - 1
+    assert steps == sorted(set(steps)), "steps replayed or reordered"
+    losses = [m["loss"] for m in result.metrics_history]
+    assert losses == sorted(losses, reverse=True), "loss curve broke"
+    assert result.checkpoint_step == total_steps - 1
+
+    # the PG re-reserved off the dead node...
+    assert pg.state == "RESERVED"
+    survivors = {b.node.node_id.hex() for b in pg.bundles}
+    assert victim_hex not in survivors
+    assert pg.reschedules_used >= 1
+    # ...the re-meshed gang elected a NEW coordinator...
+    assert len(groups) >= 2
+    assert groups[-1]._coordinator != groups[0]._coordinator
+    # ...and the event stream recorded the full transition sequence
+    states = _pg_event_states(pg)
+    assert states[0] == "RESERVED"
+    assert "RESCHEDULING" in states
+    assert states[-1] == "RESERVED"
+    ray_tpu.remove_placement_group(pg)

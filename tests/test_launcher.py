@@ -128,3 +128,89 @@ def test_cli_up_down_roundtrip(tmp_path):
         )
     assert down.returncode == 0, down.stdout + down.stderr
     assert "stopped 2 nodes" in down.stdout
+
+
+def test_ssh_provider_lifecycle_fake_transport(tmp_path):
+    """Drive the ssh provider through a REAL up→join→down lifecycle over
+    a loopback transport: a fake `ssh` binary records every invocation
+    and executes the remote command locally, so agents actually start,
+    register with the head's GCS, and die on `down` — the provider is
+    exercised end to end, not just its argv assembly."""
+    import socket
+
+    from ray_tpu.launcher import ClusterLauncher
+
+    record = tmp_path / "ssh_record.jsonl"
+    fake = tmp_path / "fake_ssh.py"
+    fake.write_text(
+        "#!/usr/bin/env python3\n"
+        "import json, subprocess, sys\n"
+        f"with open({str(record)!r}, 'a') as f:\n"
+        "    f.write(json.dumps(sys.argv[1:]) + '\\n')\n"
+        "proc = subprocess.run(['/bin/sh', '-c', sys.argv[-1]],\n"
+        "                      capture_output=True, text=True)\n"
+        "sys.stdout.write(proc.stdout)\n"
+        "sys.stderr.write(proc.stderr)\n"
+        "sys.exit(proc.returncode)\n"
+    )
+    fake.chmod(0o755)
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    config = {
+        "provider": "ssh",
+        "ssh_bin": str(fake),
+        "head": {"host": "localhost", "port": port, "num_cpus": 1},
+        "workers": [{"host": "localhost", "num_cpus": 1,
+                     "resources": {"fake": 1}}],
+    }
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    launcher = ClusterLauncher(config, no_tpu=True)
+    try:
+        info = launcher.up(wait_s=90)
+        assert info["address"].endswith(f":{port}")
+        assert len(info["nodes"]) == 2
+
+        # both nodes joined the head's GCS through the fake transport
+        from ray_tpu.core.gcs_service import GcsClient
+
+        client = GcsClient(info["address"])
+        try:
+            view = client.cluster_view()
+            assert len(view["nodes"]) == 2
+            assert view["total"].get("fake", 0) == 1
+        finally:
+            client.close()
+
+        launches = [json.loads(l) for l in record.read_text().splitlines()]
+        assert len(launches) == 2
+        assert all(a[-1].startswith("nohup ") for a in launches)
+        assert "--head" in launches[0][-1]
+        assert "--address" in launches[1][-1]
+    finally:
+        launcher.down()
+
+    # down pkill'ed by launch tag on every configured host
+    invocations = [json.loads(l) for l in record.read_text().splitlines()]
+    downs = [a for a in invocations if "pkill" in a[-1]]
+    assert len(downs) == 2
+    tag = config["_launch_tag"]
+    assert all(tag in a[-1] for a in downs)
+
+    # ...and the cluster is actually gone: the head stops answering
+    from ray_tpu.core.gcs_service import GcsClient
+
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        try:
+            c = GcsClient(info["address"], timeout=2.0)
+            try:
+                c.ping()
+            finally:
+                c.close()
+        except Exception:
+            break
+        time.sleep(0.3)
+    else:
+        raise AssertionError("head still answering after down()")

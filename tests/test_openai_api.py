@@ -131,3 +131,49 @@ def test_error_schema(endpoint):
         body = json.loads(e.read())
         assert e.code == 404
         assert body["error"]["type"] == "invalid_request_error"
+
+
+def test_multibyte_stop_sequence_truncates(endpoint):
+    """Multi-byte stop strings are honored (engine-side tail match +
+    OpenAI-style truncation), not rejected as they were before."""
+    req = {
+        "model": "gpt2-tiny", "prompt": "hello tpu", "max_tokens": 24,
+        "temperature": 0.0,
+    }
+    with _post(endpoint + "/v1/completions", req) as r:
+        base = json.loads(r.read())
+    text = base["choices"][0]["text"]
+    assert len(text) >= 4  # greedy decode of 24 byte-tokens
+    stop = text[1:3]  # a 2-char (multi-byte) substring of the output
+    with _post(endpoint + "/v1/completions", {**req, "stop": stop}) as r:
+        body = json.loads(r.read())
+    choice = body["choices"][0]
+    # greedy decode is deterministic: the stopped run is the same text
+    # truncated BEFORE the first stop occurrence, finish_reason "stop"
+    assert choice["text"] == text[: text.find(stop)]
+    assert stop not in choice["text"]
+    assert choice["finish_reason"] == "stop"
+
+
+def test_multibyte_stop_sequence_streaming(endpoint):
+    req = {
+        "model": "gpt2-tiny", "prompt": "hello tpu", "max_tokens": 24,
+        "temperature": 0.0,
+    }
+    with _post(endpoint + "/v1/completions", req) as r:
+        base = json.loads(r.read())
+    text = base["choices"][0]["text"]
+    stop = text[1:3]
+    with _post(endpoint + "/v1/completions",
+               {**req, "stop": stop, "stream": True}) as r:
+        raw = r.read().decode()
+    frames = [
+        line[len("data: "):]
+        for line in raw.split("\n") if line.startswith("data: ")
+    ]
+    assert frames[-1] == "[DONE]"
+    chunks = [json.loads(f) for f in frames[:-1]]
+    streamed = "".join(c["choices"][0].get("text", "") for c in chunks)
+    # the held-back scanner never leaks the stop string onto the wire
+    assert streamed == text[: text.find(stop)]
+    assert chunks[-1]["choices"][0]["finish_reason"] == "stop"

@@ -1,6 +1,7 @@
 """Mesh / sharding-rule / collective tests on the virtual 8-device CPU mesh."""
 
 import jax
+from ray_tpu._jax_compat import shard_map as compat_shard_map
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -131,7 +132,7 @@ def test_in_graph_collectives_under_shard_map(mesh8):
     from functools import partial
 
     @jax.jit
-    @partial(jax.shard_map, mesh=mesh8, in_specs=P("dp"), out_specs=P("dp"))
+    @partial(compat_shard_map, mesh=mesh8, in_specs=P("dp"), out_specs=P("dp"))
     def normalize(x):
         total = col.psum(jnp.sum(x), "dp")
         return x / total

@@ -92,3 +92,69 @@ def test_different_args_are_different_steps(tmp_path):
     assert workflow.run(inc.step(10), storage=str(tmp_path), workflow_id="a") == 11
     completed = workflow.list_completed(str(tmp_path), "a")
     assert len(completed) == 2
+
+
+def test_flaky_step_retries_then_succeeds(tmp_path):
+    """Per-step max_retries: a step that raises is re-run as a task
+    retry until it succeeds; the persisted result is the good one."""
+    attempts = tmp_path / "attempts"
+
+    @workflow.step(max_retries=3)
+    def flaky():
+        n = int(attempts.read_text()) if attempts.exists() else 0
+        attempts.write_text(str(n + 1))
+        if n < 2:
+            raise RuntimeError(f"boom #{n}")
+        return "survived"
+
+    out = workflow.run(
+        flaky.step(), storage=str(tmp_path), workflow_id="retry"
+    )
+    assert out == "survived"
+    assert int(attempts.read_text()) == 3  # 2 failures + 1 success
+    assert any(
+        s.startswith("flaky")
+        for s in workflow.list_completed(str(tmp_path), "retry")
+    )
+
+
+def test_retry_budget_exhausted_propagates(tmp_path):
+    @workflow.step(max_retries=1)
+    def always_fails():
+        raise RuntimeError("permanently broken")
+
+    with pytest.raises(Exception, match="permanently broken"):
+        workflow.run(
+            always_fails.step(), storage=str(tmp_path), workflow_id="budget"
+        )
+    assert workflow.list_completed(str(tmp_path), "budget") == []
+
+
+def test_hung_step_times_out(tmp_path):
+    import time
+
+    @workflow.step(timeout_s=0.5)
+    def hung():
+        time.sleep(60)
+        return 1
+
+    t0 = time.monotonic()
+    with pytest.raises(workflow.WorkflowStepTimeout, match="hung"):
+        workflow.run(hung.step(), storage=str(tmp_path), workflow_id="hang")
+    assert time.monotonic() - t0 < 30  # nowhere near the 60s sleep
+
+
+def test_step_options_override(tmp_path):
+    calls = tmp_path / "calls"
+
+    @workflow.step
+    def sometimes():
+        n = int(calls.read_text()) if calls.exists() else 0
+        calls.write_text(str(n + 1))
+        if n < 1:
+            raise RuntimeError("first call fails")
+        return "ok"
+
+    node = sometimes.options(max_retries=2).step()
+    assert node.max_retries == 2
+    assert workflow.run(node, storage=str(tmp_path), workflow_id="opt") == "ok"
