@@ -212,7 +212,13 @@ def _cmd_timeline(args) -> int:
         print("no live runtime in this process; timeline covers the "
               "current session only", file=sys.stderr)
         ray_tpu.init(detect_accelerators=False)
-    state.chrome_tracing_dump(args.output)
+    if args.trace:
+        # span-based distributed trace (util/tracing): nested
+        # submit→queue→dispatch→execute→result causality, stitched
+        # across nodes; supersedes the flat completed-task dump
+        state.trace_dump(args.output, trace_id=args.trace_id)
+    else:
+        state.chrome_tracing_dump(args.output)
     print(f"wrote {args.output} (open in chrome://tracing or Perfetto)")
     return 0
 
@@ -296,6 +302,12 @@ def build_parser() -> argparse.ArgumentParser:
 
     tp = sub.add_parser("timeline", help="dump a chrome-trace of this session")
     tp.add_argument("output", nargs="?", default="timeline.json")
+    tp.add_argument("--trace", action="store_true",
+                    help="export runtime spans (distributed trace, nested "
+                         "causality) instead of the legacy task timeline")
+    tp.add_argument("--trace-id", default=None,
+                    help="with --trace: export only this trace (stitched "
+                         "cluster-wide)")
 
     dp = sub.add_parser("dashboard", help="serve the cluster dashboard")
     dp.add_argument("--port", type=int, default=8265)

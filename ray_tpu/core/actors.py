@@ -20,6 +20,7 @@ import enum
 import logging
 import queue
 import threading
+import time
 import traceback
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
@@ -57,6 +58,9 @@ class ActorMethodCall:
     # through `stream` (reference: ObjectRefStream, core_worker.h:273)
     streaming: bool = False
     stream: Any = None
+    # caller's actor.call span context: the mailbox hop crosses threads,
+    # so the execution span re-parents from this, not a contextvar
+    trace_ctx: Any = None
 
     def fail(self, store, error: BaseException) -> None:
         """Seal `error` into every unresolved return slot and close the
@@ -303,6 +307,19 @@ class ActorRuntime:
                 logger.warning(
                     "restarting actor %s (%d/%d)", self.name, self.num_restarts, self.max_restarts
                 )
+                # single-span trace: restarts are rare and have no caller
+                # to parent into, but they must show on the timeline
+                from ..util import tracing
+
+                tracing.tracer().record_span(
+                    "actor.restart", time.time(), time.time(),
+                    lane=f"actor:{self.name}",
+                    attrs={"actor": self.name,
+                           "actor_id": self.actor_id.hex(),
+                           "restart": self.num_restarts,
+                           "max_restarts": self.max_restarts},
+                    status="ERROR",
+                )
                 continue
             if restart:
                 self._die("exceeded max_restarts")
@@ -336,11 +353,21 @@ class ActorRuntime:
                 executor.shutdown(wait=True)
 
     def _execute(self, call: ActorMethodCall) -> None:
+        from ..util import tracing
+
         with self._lock:
             self._inflight.append(call)
+        exec_span = tracing.tracer().start_span(
+            "actor.execute", parent=call.trace_ctx,
+            lane=f"actor:{self.name}",
+            attrs={"actor": self.name, "method": call.method_name,
+                   "task_id": call.task_id.hex()},
+        )
         try:
-            self._execute_inner(call)
+            with tracing.use_context(exec_span.context):
+                self._execute_inner(call)
         finally:
+            exec_span.end()
             with self._lock:
                 try:
                     self._inflight.remove(call)

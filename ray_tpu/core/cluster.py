@@ -101,7 +101,7 @@ class _RemoteActorCall:
     """One in-flight method call on a remote actor."""
 
     __slots__ = ("task_hex", "method", "args", "kwargs", "return_ids",
-                 "sent_at", "strikes")
+                 "sent_at", "strikes", "trace_ctx")
 
     def __init__(self, task_hex, method, args, kwargs, return_ids):
         self.task_hex = task_hex
@@ -111,6 +111,7 @@ class _RemoteActorCall:
         self.return_ids = return_ids
         self.sent_at = 0.0     # set when the sender ships it
         self.strikes = 0       # consecutive "unknown" poll replies
+        self.trace_ctx = None  # caller's actor.call span (wire context)
 
 
 class _PendingTask:
@@ -270,6 +271,7 @@ class RemoteActorProxy:
                     "kwargs": kwargs,
                     "return_oids": [oid.hex() for oid in call.return_ids],
                     "reply_addr": self.ctx.address,
+                    "trace_ctx": call.trace_ctx,
                 })
                 reply = node.client.call("call_actor", blob)
                 if reply != "accepted":
@@ -501,6 +503,7 @@ class ClusterContext:
         self.server.register("stream_item", self._stream_item)
         self.server.register("node_logs", self._node_logs)
         self.server.register("node_events", self._node_events)
+        self.server.register("node_spans", self._node_spans)
         self.address = self.server.address
 
         self.gcs = GcsClient(gcs_address, token=self.token)
@@ -809,9 +812,27 @@ class ClusterContext:
         Never raises: every failure path flows through finish_remote."""
         import cloudpickle
 
+        from ..util import tracing
+
         task_hex = spec.task_id.hex()
         with self._lock:
             self._pending[task_hex] = _PendingTask(spec, node, pool)
+        # queue span closes here (the dispatch decision IS the end of
+        # queueing for a remotely placed task); the dispatch span covers
+        # arg shipping + the execute_task RPC and is what the agent's
+        # execution span parents into across the wire.
+        now = time.time()
+        lane = f"node:{node.node_id.hex()[:8]}"
+        span_attrs = {"task": spec.name, "task_id": task_hex,
+                      "attempt": spec.attempt}
+        tracing.tracer().record_span(
+            "task.queue", spec.submit_wall_ts, now,
+            parent=spec.trace_ctx, lane=lane, attrs=span_attrs,
+        )
+        dispatch_span = tracing.tracer().start_span(
+            "task.dispatch", parent=spec.trace_ctx, lane=lane,
+            attrs=span_attrs, start_ts=now,
+        )
         try:
             # Small ObjectRef args resolve HERE (the owner); big/remote
             # ones ship as refs and the agent pulls (arg locality).
@@ -846,8 +867,11 @@ class ClusterContext:
                 "streaming": spec.streaming,
                 "stream_max_backlog": spec.stream_max_backlog,
                 "reply_addr": self.address,
+                "trace_ctx": dispatch_span.context,
             })
-            reply = node.client.call("execute_task", blob)
+            with tracing.use_context(dispatch_span.context):
+                reply = node.client.call("execute_task", blob)
+            dispatch_span.end(accepted=(reply == "accepted"))
             if reply == "busy":
                 # The agent's OWN ledger is full and its admission queue
                 # overflowed (another driver saturating it). Not a node
@@ -866,6 +890,7 @@ class ClusterContext:
                 if rec is not None:
                     rec.sent_at = rec.polled_at = time.monotonic()
         except (RpcError, OSError) as exc:
+            dispatch_span.end(status="ERROR", error=repr(exc))
             with self._lock:
                 rec = self._pending.pop(task_hex, None)
             if rec is None:
@@ -887,6 +912,7 @@ class ClusterContext:
                 system_failure=True,
             )
         except BaseException as exc:  # serialization errors etc: user-level
+            dispatch_span.end(status="ERROR", error=repr(exc))
             with self._lock:
                 rec = self._pending.pop(task_hex, None)
             if rec is None:
@@ -1548,10 +1574,12 @@ class ClusterContext:
         return node, pool, None
 
     def submit_remote_actor_call(self, proxy: RemoteActorProxy, method: str,
-                                 args, kwargs, return_ids) -> None:
+                                 args, kwargs, return_ids,
+                                 trace_ctx=None) -> None:
         import uuid
 
         call = _RemoteActorCall(uuid.uuid4().hex, method, args, kwargs, return_ids)
+        call.trace_ctx = trace_ctx
         proxy.submit(call)
 
     def kill_remote_actor(self, proxy: RemoteActorProxy) -> None:
@@ -1641,10 +1669,16 @@ class ClusterContext:
         with self._lock:
             self._agent_running.add(msg["task_hex"])
         try:
-            refs = self.runtime.submit_actor_task(
-                handle._actor_id, msg["method"], tuple(msg["args"]),
-                dict(msg["kwargs"]), num_returns=n if n > 1 else 1,
-            )
+            # adopt the owner's actor.call span context for the local
+            # submission: the hosted execution parents into the owner's
+            # trace across the process boundary
+            from ..util import tracing
+
+            with tracing.use_context(msg.get("trace_ctx")):
+                refs = self.runtime.submit_actor_task(
+                    handle._actor_id, msg["method"], tuple(msg["args"]),
+                    dict(msg["kwargs"]), num_returns=n if n > 1 else 1,
+                )
         except BaseException as exc:  # noqa: BLE001 - ferried to the owner
             tb = getattr(exc, "remote_traceback", None) or traceback.format_exc()
             self._task_pool().submit(
@@ -1864,8 +1898,18 @@ class ClusterContext:
     def _run_agent_task_inner(self, msg: Dict[str, Any]) -> None:
         from .config import cfg
         from . import runtime_env as _renv
+        from ..util import tracing
 
         task_hex = msg["task_hex"]
+        # THE cross-process trace link: this execution span parents into
+        # the driver's dispatch/submit span via the blob's trace context,
+        # so one trace_id covers submit → queue → dispatch → execute →
+        # result even though the processes share nothing else.
+        exec_span = tracing.tracer().start_span(
+            "task.execute", parent=msg.get("trace_ctx"),
+            lane=f"node:{self.node_id.hex()[:8]}",
+            attrs={"task": msg["name"], "task_id": task_hex, "remote": True},
+        )
         try:
             # Same chaos boundary as local execution (scheduler._run_task):
             # injected failures/delays/node-kills hit remotely dispatched
@@ -1873,13 +1917,17 @@ class ClusterContext:
             # one harness (kill_node here takes the whole agent down).
             from . import chaos
 
-            chaos.maybe_inject(msg["name"])
+            with tracing.use_context(exec_span.context):
+                chaos.maybe_inject(msg["name"])
         except BaseException as exc:  # noqa: BLE001 - ferried to the owner
             tb = traceback.format_exc()
+            exec_span.end(status="ERROR", error=repr(exc))
             self._reply_error(msg, exc, tb)
             return
         if msg.get("streaming"):
-            self._run_agent_streaming(msg)
+            with tracing.use_context(exec_span.context):
+                self._run_agent_streaming(msg)
+            exec_span.end()
             return
         try:
             # Args that shipped as refs (big/remote: arg locality) pull
@@ -1887,17 +1935,18 @@ class ClusterContext:
             # borrow registered at unpickle time pins them at the owner.
             renv = msg.get("runtime_env")
             store = self.runtime.object_store
-            if msg.get("executor") == "process":
-                from .worker_pool import execute_process_task
+            with tracing.use_context(exec_span.context):
+                if msg.get("executor") == "process":
+                    from .worker_pool import execute_process_task
 
-                result = execute_process_task(
-                    store, msg["func"], msg["args"], msg["kwargs"], renv
-                )
-            else:
-                task_args = _resolve(tuple(msg["args"]), store)
-                task_kwargs = _resolve(dict(msg["kwargs"]), store)
-                with _renv.applied(renv):
-                    result = msg["func"](*task_args, **task_kwargs)
+                    result = execute_process_task(
+                        store, msg["func"], msg["args"], msg["kwargs"], renv
+                    )
+                else:
+                    task_args = _resolve(tuple(msg["args"]), store)
+                    task_kwargs = _resolve(dict(msg["kwargs"]), store)
+                    with _renv.applied(renv):
+                        result = msg["func"](*task_args, **task_kwargs)
             if msg["num_returns"] == 1:
                 values = [result]
             else:
@@ -1909,31 +1958,38 @@ class ClusterContext:
                     )
         except BaseException as exc:  # noqa: BLE001 - ferried to the owner
             tb = getattr(exc, "remote_traceback", None) or traceback.format_exc()
+            exec_span.end(status="ERROR", error=repr(exc))
             self._reply_error(msg, exc, tb)
             return
+        exec_span.end()
 
         def deliver() -> None:
             reply = self._reply_client(msg["reply_addr"])
             statuses: List[Tuple[str, Any]] = []
             from .object_store import _estimate_nbytes
 
-            for oid_hex, value in zip(msg["return_oids"], values):
-                if _estimate_nbytes(value) <= cfg.remote_inline_max_bytes:
-                    push_object(msg["reply_addr"], oid_hex, value, client=reply)
-                    statuses.append(("pushed", None))
-                else:
-                    # big result: stays here; the owner pulls on get()
-                    oid = ObjectID(oid_hex)
-                    store = self.runtime.object_store
-                    entry = store.create(oid)
-                    entry.custodial = True  # held for the owner; only its
-                    # free_object (or node death) releases the value
-                    store.seal(oid, value)
-                    self.gcs.kv_put(oid_hex, self.address, namespace=OBJDIR_NS)
-                    statuses.append(
-                        ("remote", self.address, _estimate_nbytes(value))
-                    )
-            reply.call("task_done", task_hex, statuses, None)
+            # result span: push-vs-park time back to the owner, the tail
+            # of the remote task's trace
+            with tracing.span("task.result", parent=exec_span.context,
+                              lane=f"node:{self.node_id.hex()[:8]}",
+                              task=msg["name"], task_id=task_hex):
+                for oid_hex, value in zip(msg["return_oids"], values):
+                    if _estimate_nbytes(value) <= cfg.remote_inline_max_bytes:
+                        push_object(msg["reply_addr"], oid_hex, value, client=reply)
+                        statuses.append(("pushed", None))
+                    else:
+                        # big result: stays here; the owner pulls on get()
+                        oid = ObjectID(oid_hex)
+                        store = self.runtime.object_store
+                        entry = store.create(oid)
+                        entry.custodial = True  # held for the owner; only its
+                        # free_object (or node death) releases the value
+                        store.seal(oid, value)
+                        self.gcs.kv_put(oid_hex, self.address, namespace=OBJDIR_NS)
+                        statuses.append(
+                            ("remote", self.address, _estimate_nbytes(value))
+                        )
+                reply.call("task_done", task_hex, statuses, None)
 
         self._deliver_with_retry(
             task_hex, msg["reply_addr"], deliver,
@@ -2340,6 +2396,15 @@ class ClusterContext:
         from ..util.events import events
 
         return events().list(since_seq=int(since_seq), limit=int(limit))
+
+    def _node_spans(self, trace_id: Optional[str] = None,
+                    limit: int = 10_000) -> List[Dict[str, Any]]:
+        """Serve this node's completed trace spans (util/tracing.py) —
+        the state API stitches one cross-process trace together from
+        every node's ring buffer by shared trace_id."""
+        from ..util.tracing import tracer
+
+        return tracer().spans(trace_id, int(limit))
 
     def _node_info(self) -> Dict[str, Any]:
         return {

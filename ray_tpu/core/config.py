@@ -143,6 +143,13 @@ define_flag("pg_reschedule_wait_s", 60.0,
             "How long dependents (bundle-actor restarts, gang re-mesh) "
             "wait for a RESCHEDULING placement group to re-reserve.")
 
+# tracing / observability
+define_flag("trace_sample_ratio", 1.0,
+            "Fraction of new traces recorded by util/tracing (0 disables; "
+            "the root's decision propagates to every descendant span).")
+define_flag("trace_buffer_spans", 50_000,
+            "Per-process ring-buffer capacity for completed trace spans.")
+
 # memory monitor / OOM
 define_flag("memory_monitor_interval_s", 0.25,
             "Polling interval of the host memory monitor (0 = disabled).")

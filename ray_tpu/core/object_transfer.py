@@ -185,19 +185,25 @@ def fetch_object(address: str, oid_hex: str, *, timeout: float = 30.0,
                  token: Optional[str] = None) -> Any:
     """Pull one object from a remote ObjectTransferServer (reference
     PullManager: locate by owner, fetch chunked, reassemble)."""
+    from ..util import tracing
+
     own = client is None
     client = client or RpcClient(address, timeout=timeout, token=token)
     try:
-        info = client.call("pull_begin", oid_hex, timeout)
-        tid = info["transfer_id"]
-        meta = bytearray(info["meta_nbytes"])
-        buffers = [bytearray(n) for n in info["buffer_nbytes"]]
-        for buf_index, dst in [(-1, meta)] + list(enumerate(buffers)):
-            for offset in _windows(len(dst)):
-                chunk = client.call("pull_chunk", tid, buf_index, offset)
-                dst[offset : offset + len(chunk)] = chunk
-        client.call("pull_end", tid)
-        return pickle.loads(bytes(meta), buffers=buffers)
+        with tracing.span("transfer.pull", peer=address, oid=oid_hex) as sp:
+            info = client.call("pull_begin", oid_hex, timeout)
+            tid = info["transfer_id"]
+            meta = bytearray(info["meta_nbytes"])
+            buffers = [bytearray(n) for n in info["buffer_nbytes"]]
+            sp.set_attribute(
+                "nbytes", info["meta_nbytes"] + sum(info["buffer_nbytes"])
+            )
+            for buf_index, dst in [(-1, meta)] + list(enumerate(buffers)):
+                for offset in _windows(len(dst)):
+                    chunk = client.call("pull_chunk", tid, buf_index, offset)
+                    dst[offset : offset + len(chunk)] = chunk
+            client.call("pull_end", tid)
+            return pickle.loads(bytes(meta), buffers=buffers)
     finally:
         if own:
             client.close()
@@ -210,22 +216,28 @@ def push_object(address: str, oid_hex: str, value: Any, *,
     """Push one object into a remote runtime's store (reference
     PushManager). Windows slice the original buffers — no monolithic
     payload copy on the sender."""
+    from ..util import tracing
+
     meta, buffers = _dumps_oob(value)
     own = client is None
     client = client or RpcClient(address, timeout=timeout, token=token)
     try:
-        tid = client.call(
-            "push_begin", oid_hex, len(meta), [len(b) for b in buffers]
-        )
-        for buf_index, src in [(-1, memoryview(meta))] + [
-            (i, memoryview(b)) for i, b in enumerate(buffers)
-        ]:
-            for offset in _windows(len(src)):
-                client.call(
-                    "push_chunk", tid, buf_index, offset,
-                    bytes(src[offset : offset + CHUNK_BYTES]),
-                )
-        client.call("push_end", tid, oid_hex)
+        with tracing.span(
+            "transfer.push", peer=address, oid=oid_hex,
+            nbytes=len(meta) + sum(len(b) for b in buffers),
+        ):
+            tid = client.call(
+                "push_begin", oid_hex, len(meta), [len(b) for b in buffers]
+            )
+            for buf_index, src in [(-1, memoryview(meta))] + [
+                (i, memoryview(b)) for i, b in enumerate(buffers)
+            ]:
+                for offset in _windows(len(src)):
+                    client.call(
+                        "push_chunk", tid, buf_index, offset,
+                        bytes(src[offset : offset + CHUNK_BYTES]),
+                    )
+            client.call("push_end", tid, oid_hex)
     finally:
         if own:
             client.close()

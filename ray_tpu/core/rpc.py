@@ -152,6 +152,8 @@ class RpcServer:
                 return True
 
             def _serve_loop(self, sock):
+                from ..util import tracing
+
                 while True:
                     try:
                         frame = _recv_frame(sock)
@@ -159,10 +161,21 @@ class RpcServer:
                         return  # client went away
                     try:
                         method, args, kwargs = pickle.loads(frame)
+                        # Distributed tracing: a client with an active
+                        # sampled span injected its context as _trace_ctx;
+                        # extract it (handlers never see the field) and
+                        # run the handler inside a server-side span that
+                        # parents back to the caller across the process
+                        # boundary — one trace_id end to end.
+                        ctx = tracing.extract_context(kwargs)
                         fn = outer.handlers.get(method)
                         if fn is None:
                             raise AttributeError(f"no rpc method {method!r}")
-                        reply = ("ok", fn(*args, **kwargs))
+                        if ctx is not None:
+                            with tracing.span(f"rpc.{method}", parent=ctx):
+                                reply = ("ok", fn(*args, **kwargs))
+                        else:
+                            reply = ("ok", fn(*args, **kwargs))
                     except BaseException as exc:  # noqa: BLE001 - ferried to caller
                         try:
                             pickle.dumps(exc)
@@ -262,7 +275,13 @@ class RpcClient:
     def call(self, method: str, *args, **kwargs) -> Any:
         """Invoke a remote method; handler exceptions re-raise here,
         transport failures retry then raise RpcError."""
-        payload = pickle.dumps((method, args, kwargs))
+        from ..util import tracing
+
+        # inject the active span context into the frame (no-op without a
+        # sampled current span, or for denylisted chatter like chunks)
+        payload = pickle.dumps(
+            (method, args, tracing.inject_context(kwargs, method))
+        )
         last: Optional[BaseException] = None
         for attempt in range(self._retries + 1):
             try:

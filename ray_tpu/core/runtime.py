@@ -388,9 +388,21 @@ class Runtime:
 
             gen = ObjectRefGenerator(task_id, self)
             spec.stream = weakref.ref(gen)
+        # Tracing root (or child, when submitted from inside a traced
+        # region — another task, a serve request): every downstream
+        # queue/dispatch/execute/result span shares this trace_id, across
+        # processes for remote dispatch.
+        from ..util import tracing
+
+        submit_span = tracing.tracer().start_span(
+            "task.submit",
+            attrs={"task": spec.name, "task_id": task_id.hex()},
+        )
+        spec.trace_ctx = submit_span.context
         for oid in return_ids:
             self.object_store.create(oid, owner_task=spec)
         self.scheduler.submit(spec)
+        submit_span.end()
         if streaming:
             return gen
         refs = [ObjectRef(oid, self) for oid in return_ids]
@@ -582,6 +594,8 @@ class Runtime:
         kwargs: Dict[str, Any],
         num_returns: Union[int, str] = 1,
     ) -> Union[ObjectRef, List[ObjectRef], "ObjectRefGenerator"]:
+        from ..util import tracing
+
         proxy = self._remote_actor_proxy(actor_id)
         if proxy is not None:
             if num_returns == "streaming":
@@ -595,9 +609,16 @@ class Runtime:
             ]
             for oid in return_ids:
                 self.object_store.create(oid)
-            self.cluster.submit_remote_actor_call(
-                proxy, method_name, args, kwargs, return_ids
+            call_span = tracing.tracer().start_span(
+                "actor.call",
+                attrs={"actor": proxy.display_name, "method": method_name,
+                       "task_id": r_task_id.hex(), "remote": True},
             )
+            self.cluster.submit_remote_actor_call(
+                proxy, method_name, args, kwargs, return_ids,
+                trace_ctx=call_span.context,
+            )
+            call_span.end()
             refs = [ObjectRef(oid, self) for oid in return_ids]
             return refs[0] if num_returns == 1 else refs
         task_id = TaskID.of(self.job_id)
@@ -611,6 +632,12 @@ class Runtime:
         return_ids = [ObjectID.for_task_return(task_id, i) for i in range(n_static)]
         for oid in return_ids:
             self.object_store.create(oid)
+        rt = self.actor_runtime(actor_id)
+        call_span = tracing.tracer().start_span(
+            "actor.call",
+            attrs={"actor": rt.name, "method": method_name,
+                   "task_id": task_id.hex()},
+        )
         call = ActorMethodCall(
             task_id=task_id,
             method_name=method_name,
@@ -620,8 +647,10 @@ class Runtime:
             num_returns=n_static,
             streaming=streaming,
             stream=ObjectRefGenerator(task_id, self) if streaming else None,
+            trace_ctx=call_span.context,
         )
-        self.actor_runtime(actor_id).submit(call)
+        rt.submit(call)
+        call_span.end()
         if streaming:
             return call.stream
         refs = [ObjectRef(oid, self) for oid in return_ids]

@@ -137,6 +137,12 @@ class TaskSpec:
     start_ts: float = 0.0
     end_ts: float = 0.0
     node_hex: str = ""
+    # distributed tracing (util/tracing): the driver's submit-span
+    # context; every queue/dispatch/execute/result span parents into it
+    # (across the RPC boundary for remote dispatch), and (re)submission
+    # stamps submit_wall_ts so queue time is measurable per attempt
+    trace_ctx: Any = None
+    submit_wall_ts: float = field(default_factory=time.time)
 
     def live_stream(self):
         """The consumer's ObjectRefGenerator, or None once the consumer
@@ -475,6 +481,7 @@ class ClusterScheduler:
 
     def submit(self, spec: TaskSpec) -> None:
         """Queue a task; it dispatches once its ObjectID args are ready."""
+        spec.submit_wall_ts = time.time()  # queue span measures THIS attempt
         deps = _collect_dependencies(spec.args, spec.kwargs)
         unresolved = {d for d in deps if not self._store.is_ready(d)}
         if unresolved:
@@ -1160,6 +1167,8 @@ class ClusterScheduler:
     # ------------------------------------------------------------- task runner
 
     def _run_task(self, spec: TaskSpec, node: Node, pool: ResourceSet) -> None:
+        from ..util import tracing
+
         error: Optional[BaseException] = None
         error_tb = ""
         spec.start_ts = time.time()
@@ -1168,30 +1177,48 @@ class ClusterScheduler:
         threading.current_thread().name = (
             f"ray_tpu-worker-{spec.name}-{spec.task_id.hex()[:6]}"
         )
+        lane = f"node:{spec.node_hex[:8]}"
+        span_attrs = {"task": spec.name, "task_id": spec.task_id.hex(),
+                      "attempt": spec.attempt}
+        # the wait between (re)submission and this thread picking the
+        # task up IS the scheduling/queue latency
+        tracing.tracer().record_span(
+            "task.queue", spec.submit_wall_ts, spec.start_ts,
+            parent=spec.trace_ctx, lane=lane, attrs=span_attrs,
+        )
+        exec_span = tracing.tracer().start_span(
+            "task.execute", parent=spec.trace_ctx, lane=lane, attrs=span_attrs,
+        )
         try:
             from . import chaos, runtime_env as _renv
 
-            chaos.maybe_inject(spec.name)
-            if spec.executor == "process":
-                # Pooled worker process (GIL-free); SHM-tier args ship
-                # as zero-copy arena descriptors (plasma handoff). One
-                # shared implementation with the cluster agent path.
-                from .worker_pool import execute_process_task
+            # current-span context active for the task body: nested
+            # submits/gets/transfers parent into this execution span
+            with tracing.use_context(exec_span.context):
+                chaos.maybe_inject(spec.name)
+                if spec.executor == "process":
+                    # Pooled worker process (GIL-free); SHM-tier args ship
+                    # as zero-copy arena descriptors (plasma handoff). One
+                    # shared implementation with the cluster agent path.
+                    from .worker_pool import execute_process_task
 
-                result = execute_process_task(
-                    self._store, spec.func, spec.args, spec.kwargs,
-                    spec.runtime_env,
-                )
-            else:
-                args = _resolve(spec.args, self._store)
-                kwargs = _resolve(spec.kwargs, self._store)
-                with _renv.applied(spec.runtime_env):
-                    result = spec.func(*args, **kwargs)
-            self._seal_returns(spec, result)
+                    result = execute_process_task(
+                        self._store, spec.func, spec.args, spec.kwargs,
+                        spec.runtime_env,
+                    )
+                else:
+                    args = _resolve(spec.args, self._store)
+                    kwargs = _resolve(spec.kwargs, self._store)
+                    with _renv.applied(spec.runtime_env):
+                        result = spec.func(*args, **kwargs)
+                with tracing.span("task.result", **span_attrs):
+                    self._seal_returns(spec, result)
+            exec_span.end()
         except BaseException as exc:  # noqa: BLE001 - boundary: remote error capture
             error = exc
             # process-executor errors carry the worker-side traceback
             error_tb = getattr(exc, "remote_traceback", None) or traceback.format_exc()
+            exec_span.end(status="ERROR", error=repr(exc))
         finally:
             pool.release(spec.resources)
             with node._lock:

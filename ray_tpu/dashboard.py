@@ -34,6 +34,7 @@ th{background:#eee} code{background:#eee;padding:0 .3em}
 <h2>Actors</h2><table id="actors"></table>
 <h2>Recent tasks</h2><table id="tasks"></table>
 <h2>Jobs</h2><table id="jobs"></table>
+<h2>Traces</h2><table id="traces"></table>
 <h2>Events</h2><table id="events"></table>
 <h2>Logs (per node, last lines)</h2><pre id="logs" style="font-size:.75em;background:#eee;padding:.6em;max-height:22em;overflow:auto"></pre>
 <script>
@@ -55,6 +56,12 @@ async function refresh() {
     const tasks = await (await fetch("/api/tasks")).json();
     fill("tasks", tasks.slice(-20).reverse());
     fill("jobs", await (await fetch("/api/jobs")).json());
+    const tr = await (await fetch("/api/traces")).json();
+    fill("traces", tr.slice(-15).reverse().map(t => ({
+      trace: `<a href="/trace?id=${t.trace_id}">${t.trace_id.slice(0,12)}</a>`,
+      root: t.root, spans: t.spans, errors: t.errors,
+      duration_s: t.duration_s.toFixed(4),
+    })));
     const ev = await (await fetch("/api/events")).json();
     fill("events", ev.slice(-15).reverse());
     const logs = await (await fetch("/api/logs")).json();
@@ -65,6 +72,54 @@ async function refresh() {
   } catch (e) { document.getElementById("err").textContent = "refresh failed: " + e; }
 }
 refresh(); setInterval(refresh, 2000);
+</script></body></html>"""
+
+
+_TRACE_PAGE = """<!doctype html>
+<html><head><title>ray_tpu trace</title><style>
+body{font-family:system-ui,sans-serif;margin:2em;background:#fafafa;color:#222}
+h1{font-size:1.2em} .row{position:relative;height:1.45em;margin:1px 0}
+.bar{position:absolute;height:1.25em;background:#7aa7d6;border-radius:2px;
+     font-size:.72em;padding:0 .3em;white-space:nowrap;overflow:visible;
+     color:#102a43;line-height:1.7}
+.bar.err{background:#d67a7a}
+.lane{font-size:.72em;color:#666;position:absolute;left:0;width:11em;
+      overflow:hidden;text-overflow:ellipsis}
+#chart{position:relative;margin-left:11.5em}
+#meta{font-size:.8em;color:#555;margin-bottom:1em}
+</style></head><body>
+<h1>trace waterfall</h1><div id="meta"></div>
+<div style="position:relative"><div id="lanes"></div><div id="chart"></div></div>
+<script>
+const id = new URLSearchParams(location.search).get("id");
+async function render() {
+  const spans = await (await fetch("/api/trace?id=" + id)).json();
+  if (!spans.length) { document.getElementById("meta").textContent =
+      "no spans for trace " + id; return; }
+  const t0 = Math.min(...spans.map(s => s.start_ts));
+  const t1 = Math.max(...spans.map(s => s.end_ts || s.start_ts));
+  const total = Math.max(t1 - t0, 1e-6);
+  document.getElementById("meta").textContent =
+    `trace ${id} — ${spans.length} spans, ${(total*1000).toFixed(2)} ms`;
+  const chart = document.getElementById("chart");
+  const lanes = document.getElementById("lanes");
+  spans.sort((a, b) => a.start_ts - b.start_ts);
+  spans.forEach((s, i) => {
+    const left = 100 * (s.start_ts - t0) / total;
+    const width = Math.max(100 * ((s.end_ts || s.start_ts) - s.start_ts) / total, 0.15);
+    const row = document.createElement("div"); row.className = "row";
+    const bar = document.createElement("div");
+    bar.className = "bar" + (s.status !== "OK" ? " err" : "");
+    bar.style.left = left + "%"; bar.style.width = width + "%";
+    bar.textContent = `${s.name} (${((s.duration_s||0)*1000).toFixed(2)} ms)`;
+    bar.title = JSON.stringify(s.attrs);
+    row.appendChild(bar); chart.appendChild(row);
+    const lane = document.createElement("div"); lane.className = "lane";
+    lane.style.top = (i * 1.45 + 3.2) + "em"; lane.textContent = s.lane || "";
+    lanes.appendChild(lane);
+  });
+}
+render();
 </script></body></html>"""
 
 
@@ -111,8 +166,15 @@ class _Handler(BaseHTTPRequestHandler):
             if self.path == "/" or self.path == "/index.html":
                 self._send(200, _PAGE, "text/html")
                 return
+            if self.path.split("?", 1)[0] == "/trace":
+                self._send(200, _TRACE_PAGE, "text/html")
+                return
             if self.path.startswith("/api/"):
-                self._send(200, json.dumps(self._api(self.path[5:])),
+                from urllib.parse import parse_qs, urlparse
+
+                parsed = urlparse(self.path)
+                query = {k: v[0] for k, v in parse_qs(parsed.query).items()}
+                self._send(200, json.dumps(self._api(parsed.path[5:], query)),
                            "application/json")
                 return
             if self.path == "/metrics":
@@ -124,9 +186,10 @@ class _Handler(BaseHTTPRequestHandler):
         except Exception as e:  # noqa: BLE001 - handler must answer something
             self._send(500, json.dumps({"error": repr(e)}), "application/json")
 
-    def _api(self, name: str):
+    def _api(self, name: str, query: Optional[dict] = None):
         from .util import state
 
+        query = query or {}
         if name == "summary":
             return state.summary()
         if name == "nodes":
@@ -139,6 +202,15 @@ class _Handler(BaseHTTPRequestHandler):
             return state.list_objects()
         if name == "timeline":
             return json.loads(state.chrome_tracing_dump())
+        if name == "traces":
+            return state.list_traces()
+        if name == "trace":
+            # per-trace waterfall data: spans stitched cluster-wide
+            if "id" not in query:
+                raise ValueError("trace endpoint needs ?id=<trace_id>")
+            return state.get_trace(query["id"])
+        if name == "trace_export":
+            return json.loads(state.trace_dump(trace_id=query.get("id")))
         if name == "events":
             return state.list_events()
         if name == "cluster_events":

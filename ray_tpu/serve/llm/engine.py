@@ -72,6 +72,44 @@ class _Request:
     # over tokens — the byte tokenizer makes strings == token sequences)
     stop_sequences: tuple = ()
     stop_tail: list = dataclasses.field(default_factory=list)
+    # observability: tokens emitted so far, and the engine.request span
+    # opened at submit — TTFT/TPOT/queue-time derive from it at retire
+    generated: int = 0
+    span: Any = None
+
+
+def _start_request_span(request: "_Request", engine_kind: str) -> None:
+    """Open the request's engine.request span at submit time (caller
+    thread: it nests under an active serve.route/actor.execute span).
+    Shared by the dense and paged engines."""
+    from ...util import tracing
+
+    request.span = tracing.tracer().start_span(
+        "engine.request",
+        lane=f"engine:{engine_kind}",
+        attrs={"rid": request.rid, "engine": engine_kind,
+               "prompt_tokens": len(request.prompt),
+               "max_tokens": request.max_tokens},
+    )
+
+
+def _finish_request_span(request: "_Request", status: str = "OK") -> None:
+    """Close the request span at retire: TTFT/TPOT/token counts become
+    span attributes, and the tracer derives raytpu_serve_ttft_seconds /
+    raytpu_serve_tpot_seconds from them — serving SLOs come from spans,
+    not ad-hoc timers."""
+    span = request.span
+    if span is None:
+        return
+    attrs: Dict[str, Any] = {"generated_tokens": request.generated}
+    if request.first_token_at is not None:
+        attrs["ttft_s"] = request.first_token_at - request.submitted_at
+        if request.generated > 1:
+            attrs["tpot_s"] = (
+                (time.perf_counter() - request.first_token_at)
+                / (request.generated - 1)
+            )
+    span.end(status=status, **attrs)
 
 
 def _normalize_stop_sequences(stop_sequences) -> tuple:
@@ -225,6 +263,7 @@ class LLMEngine:
             stop_token_ids=tuple(stop_token_ids or ()),
             stop_sequences=_normalize_stop_sequences(stop_sequences),
         )
+        _start_request_span(request, "dense")
         self._queue.put(request)
         _reject_if_dead(self, request)
         self._wake.set()
@@ -259,6 +298,20 @@ class LLMEngine:
             self._do_prefill(slot_idx, slot, request)
 
     def _do_prefill(self, slot_idx: int, slot: _Slot, request: _Request) -> None:
+        from ...util import tracing
+
+        if request.span is not None:
+            # admit time: everything between submit and this slot freeing
+            # up was queue wait
+            request.span.set_attribute(
+                "queue_s", time.perf_counter() - request.submitted_at
+            )
+        prefill_span = tracing.tracer().start_span(
+            "engine.prefill",
+            parent=request.span.context if request.span is not None else None,
+            lane=f"engine:slot{slot_idx}",
+            attrs={"rid": request.rid, "prompt_tokens": len(request.prompt)},
+        )
         prompt = np.asarray(request.prompt, dtype=np.int32)
         bucket = self._bucket(len(prompt))
         padded = np.zeros((1, bucket), dtype=np.int32)
@@ -276,6 +329,8 @@ class LLMEngine:
         temps = jnp.asarray([request.temperature], dtype=jnp.float32)
         first = int(self._sample(last_logits, sub, temps)[0])
         request.first_token_at = time.perf_counter()
+        prefill_span.end(bucket=bucket)
+        request.generated += 1
         request.out.put(first)
         slot.request = request
         slot.position = len(prompt)  # next write slot = first generated token
@@ -293,6 +348,7 @@ class LLMEngine:
 
     def _finish(self, slot: _Slot) -> None:
         if slot.request is not None:
+            _finish_request_span(slot.request)
             slot.request.out.put(None)
         slot.request = None
         slot.remaining = 0
@@ -317,6 +373,7 @@ class LLMEngine:
         for i in active:
             slot = self.slots[i]
             token = int(sampled[i])
+            slot.request.generated += 1
             slot.request.out.put(token)
             slot.last_token = token
             slot.position += 1
@@ -354,13 +411,16 @@ def _fail_all_requests(slots, request_queue, exc: BaseException) -> None:
     """Engine-death path: surface `exc` on every active and queued stream."""
     for slot in slots:
         if slot.request is not None:
+            _finish_request_span(slot.request, status="ERROR")
             slot.request.out.put(exc)
             slot.request = None
     while True:
         try:
-            request_queue.get_nowait().out.put(exc)
+            request = request_queue.get_nowait()
         except queue.Empty:
             return
+        _finish_request_span(request, status="ERROR")
+        request.out.put(exc)
 
 
 def _reject_if_dead(engine, request: "_Request") -> None:

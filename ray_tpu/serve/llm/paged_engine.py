@@ -46,9 +46,11 @@ from .engine import (
     ResponseStream,
     _Request,
     _fail_all_requests,
+    _finish_request_span,
     _hit_stop_sequence,
     _normalize_stop_sequences,
     _reject_if_dead,
+    _start_request_span,
 )
 from .paged import (
     PagedConfig,
@@ -209,6 +211,9 @@ class _PagedSlot:
     # emission-side bookkeeping
     emit_remaining: int = 0
     finished_emit: bool = False
+    # observability: admit wall time, so the per-request engine.prefill
+    # span covers chunked ingest end to end (chunks batch across lanes)
+    prefill_t0: float = 0.0
 
     @property
     def free(self) -> bool:
@@ -445,6 +450,7 @@ class PagedLLMEngine:
             stop_token_ids=tuple(stop_token_ids or ()),
             stop_sequences=_normalize_stop_sequences(stop_sequences),
         )
+        _start_request_span(request, "paged")
         self._queue.put(request)
         _reject_if_dead(self, request)
         self._wake.set()
@@ -484,6 +490,11 @@ class PagedLLMEngine:
             slot.pages = pages
             slot.position = 0
             slot.prefill_offset = 0
+            slot.prefill_t0 = time.time()
+            if request.span is not None:
+                request.span.set_attribute(
+                    "queue_s", time.perf_counter() - request.submitted_at
+                )
             slot.stalled = False
             slot.dispatch_remaining = 0
             slot.done_dispatching = False
@@ -564,6 +575,16 @@ class PagedLLMEngine:
             self.metrics["prefill_chunks"] += 1
             if not slot.prefilling:
                 request = slot.request
+                from ...util import tracing
+
+                tracing.tracer().record_span(
+                    "engine.prefill", slot.prefill_t0, time.time(),
+                    parent=(request.span.context
+                            if request.span is not None else None),
+                    lane=f"engine:slot{idx}",
+                    attrs={"rid": request.rid,
+                           "prompt_tokens": len(request.prompt)},
+                )
                 finished.append((lane, idx))
                 lane_slots[lane] = idx
                 temps[lane] = request.temperature
@@ -779,6 +800,7 @@ class PagedLLMEngine:
             return  # stale block for an already-retired stream
         if first and request.first_token_at is None:
             request.first_token_at = time.perf_counter()
+        request.generated += 1
         request.out.put(token)
         slot.emit_remaining -= 1
         self.metrics["generated_tokens"] += 1
@@ -801,6 +823,7 @@ class PagedLLMEngine:
 
     def _finish(self, idx: int, slot: _PagedSlot) -> None:
         if slot.request is not None:
+            _finish_request_span(slot.request)
             slot.request.out.put(None)
         self.allocator.free(slot.pages)
         slot.pages = []

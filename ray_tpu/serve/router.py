@@ -149,18 +149,29 @@ class _MethodCaller:
         self._model_id = model_id
 
     def remote(self, *args, **kwargs):
-        replica = self._set.pick(self._model_id)
-        if self._model_id:
-            kwargs["_multiplexed_model_id"] = self._model_id
-        try:
-            # replicas are _ReplicaWrapper actors: dispatch by method name
-            call = replica.call
-            if self._stream:
-                call = call.options(num_returns="streaming")
-            ref = call.remote(self._method, *args, **kwargs)
-        except BaseException:
-            self._set.release(replica)
-            raise
+        from ..util import tracing
+
+        # serve.route roots the request's trace (or nests, when called
+        # from a traced region): replica pick + submission. The replica's
+        # actor.call/actor.execute spans — and the engine's request span
+        # inside it — parent in through the context propagation.
+        with tracing.span(
+            "serve.route", deployment=self._set.name, method=self._method,
+            model_id=self._model_id or "",
+        ) as route_span:
+            replica = self._set.pick(self._model_id)
+            route_span.set_attribute("replica", _rkey(replica)[:12])
+            if self._model_id:
+                kwargs["_multiplexed_model_id"] = self._model_id
+            try:
+                # replicas are _ReplicaWrapper actors: dispatch by method name
+                call = replica.call
+                if self._stream:
+                    call = call.options(num_returns="streaming")
+                ref = call.remote(self._method, *args, **kwargs)
+            except BaseException:
+                self._set.release(replica)
+                raise
         _Reaper.instance().track(ref, self._set, replica)
         return ref
 

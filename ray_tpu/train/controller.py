@@ -102,6 +102,19 @@ class TrainController:
         return max(floor, min(want, feasible))
 
     def run(self) -> Result:
+        # The whole run is one trace: gang attempts, restarts and
+        # checkpoint restores nest as phase spans; device_annotate labels
+        # each attempt in the XLA device trace (util/profiling) so host
+        # phases line up with HLO activity.
+        from ..util import tracing
+
+        with tracing.span("train.run", run=self.run_config.name) as run_span:
+            result = self._run_traced(run_span)
+        return result
+
+    def _run_traced(self, run_span) -> Result:
+        from ..util import tracing
+
         policy = FailurePolicy(self.run_config.failure)
         error: Optional[str] = None
         while True:
@@ -118,14 +131,27 @@ class TrainController:
                 )
             from ..util.events import emit
 
+            attempt_span = tracing.tracer().start_span(
+                "train.attempt", parent=run_span.context,
+                lane=f"train:{self.run_config.name}",
+                attrs={"run": self.run_config.name, "workers": num_workers,
+                       "attempt": self.num_restarts + 1,
+                       "resume_from_step": self.latest_checkpoint_step},
+            )
             try:
-                group.start()
-                self.status = RunStatus.RUNNING
-                emit("INFO", "train",
-                     f"run {self.run_config.name}: gang of {num_workers} "
-                     f"running (attempt {self.num_restarts + 1})")
-                outcome = self._poll_until_done(group)
+                with tracing.use_context(attempt_span.context), \
+                        tracing.device_annotate(
+                            f"train.attempt:{self.run_config.name}"):
+                    group.start()
+                    self.status = RunStatus.RUNNING
+                    emit("INFO", "train",
+                         f"run {self.run_config.name}: gang of {num_workers} "
+                         f"running (attempt {self.num_restarts + 1})")
+                    outcome = self._poll_until_done(group)
                 if outcome is None:  # clean finish
+                    attempt_span.end(
+                        checkpoint_step=self.latest_checkpoint_step
+                    )
                     self.status = RunStatus.FINISHED
                     emit("INFO", "train",
                          f"run {self.run_config.name} finished "
@@ -136,6 +162,10 @@ class TrainController:
                     TimeoutError) as e:
                 error = repr(e)
             finally:
+                attempt_span.end(
+                    status="OK" if error is None else "ERROR",
+                    error=error, checkpoint_step=self.latest_checkpoint_step,
+                )
                 group.shutdown()
 
             if policy.should_restart():
@@ -147,10 +177,15 @@ class TrainController:
                      f"(restart {self.num_restarts}): {error}")
                 # the train_fn is responsible for resuming from
                 # latest_checkpoint_step (passed through train_config)
-                if self.train_config is not None:
-                    self.train_config["resume_from_step"] = self.latest_checkpoint_step
-                if self.restart_backoff_s > 0:
-                    time.sleep(self.restart_backoff_s)
+                with tracing.span("train.restore", parent=run_span.context,
+                                  lane=f"train:{self.run_config.name}",
+                                  run=self.run_config.name,
+                                  restart=self.num_restarts,
+                                  resume_from_step=self.latest_checkpoint_step):
+                    if self.train_config is not None:
+                        self.train_config["resume_from_step"] = self.latest_checkpoint_step
+                    if self.restart_backoff_s > 0:
+                        time.sleep(self.restart_backoff_s)
                 continue
             self.status = RunStatus.ERRORED
             emit("ERROR", "train",
@@ -173,11 +208,22 @@ class TrainController:
                     if rank == 0:
                         self.metrics_history.append(metrics)
                     if ckpt_step is not None:
+                        prev = self.latest_checkpoint_step
                         self.latest_checkpoint_step = (
-                            ckpt_step
-                            if self.latest_checkpoint_step is None
-                            else max(self.latest_checkpoint_step, ckpt_step)
+                            ckpt_step if prev is None else max(prev, ckpt_step)
                         )
+                        if prev is None or ckpt_step > prev:
+                            # instant span: checkpoint progress on the
+                            # run's waterfall
+                            from ..util import tracing
+
+                            now = time.time()
+                            tracing.tracer().record_span(
+                                "train.checkpoint", now, now,
+                                lane=f"train:{self.run_config.name}",
+                                attrs={"run": self.run_config.name,
+                                       "step": ckpt_step, "rank": rank},
+                            )
                 if p["error"]:
                     return p["error"]
             if all(p["done"] for p in polls):

@@ -4,18 +4,25 @@ from .metrics import (  # noqa: F401
     Counter,
     Gauge,
     Histogram,
+    get_or_create_counter,
+    get_or_create_gauge,
+    get_or_create_histogram,
     register_runtime_gauges,
     registry,
     start_metrics_server,
 )
 from .state import (  # noqa: F401
     chrome_tracing_dump,
+    get_trace,
     list_actors,
     list_nodes,
     list_objects,
     list_tasks,
+    list_traces,
     summary,
+    trace_dump,
 )
+from . import tracing  # noqa: F401
 from .actor_pool import ActorPool  # noqa: F401
 from .profiling import (  # noqa: F401
     annotate,

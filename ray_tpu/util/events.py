@@ -28,7 +28,16 @@ class EventLog:
         self._buf: "deque[Dict[str, Any]]" = deque(maxlen=capacity)
         self._lock = threading.Lock()
         self._sink_path = sink_path
+        self._sink_file = None  # cached handle: no per-event open()
         self._seq = 0
+
+    def _sink_handle(self):
+        """Caller holds the lock. Lazily (re)open the cached JSONL
+        handle — event-heavy failover drills must not pay an open() per
+        event; set_sink swaps it."""
+        if self._sink_file is None and self._sink_path:
+            self._sink_file = open(self._sink_path, "a")
+        return self._sink_file
 
     def emit(self, severity: str, source: str, message: str,
              **extra: Any) -> Dict[str, Any]:
@@ -47,14 +56,26 @@ class EventLog:
                 **({"extra": extra} if extra else {}),
             }
             self._buf.append(event)
-            sink = self._sink_path
-        if sink:
+            # write under the lock: concurrent emitters on one handle
+            # would otherwise interleave partial JSONL lines
             try:
-                with open(sink, "a") as f:
+                f = self._sink_handle()
+                if f is not None:
                     f.write(json.dumps(event, default=str) + "\n")
-            except OSError:
-                pass  # a full disk must not take the runtime down
+                    f.flush()
+            except (OSError, ValueError):
+                # a full disk must not take the runtime down; drop the
+                # handle so a later emit can retry a fresh open
+                self._close_sink_locked()
         return event
+
+    def _close_sink_locked(self) -> None:
+        if self._sink_file is not None:
+            try:
+                self._sink_file.close()
+            except OSError:
+                pass
+            self._sink_file = None
 
     def list(self, *, since_seq: int = 0, severity: Optional[str] = None,
              source: Optional[str] = None, limit: int = 1000) -> List[Dict[str, Any]]:
@@ -69,7 +90,13 @@ class EventLog:
 
     def set_sink(self, path: Optional[str]) -> None:
         with self._lock:
+            self._close_sink_locked()
             self._sink_path = path
+            if path:
+                try:
+                    self._sink_file = open(path, "a")
+                except OSError:
+                    self._sink_file = None  # emit retries lazily
 
     def clear(self) -> None:
         with self._lock:

@@ -21,6 +21,23 @@ def _tags_key(tags: Optional[TagDict]) -> Tuple[Tuple[str, str], ...]:
     return tuple(sorted((tags or {}).items()))
 
 
+def _escape_label(value: Any) -> str:
+    """Escape a label VALUE per the Prometheus exposition spec
+    (backslash, double-quote, newline) — raw occurrences of any of these
+    make the whole scrape payload unparseable."""
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+def _escape_help(text: str) -> str:
+    """HELP text escaping: backslash and newline only (quotes are legal)."""
+    return str(text).replace("\\", "\\\\").replace("\n", "\\n")
+
+
 class _Metric:
     def __init__(self, name: str, description: str = "", tag_keys: Sequence[str] = ()):
         self.name = name
@@ -50,10 +67,11 @@ class Counter(_Metric):
 class Gauge(_Metric):
     kind = "gauge"
 
-    def __init__(self, name, description="", tag_keys=(), fn: Optional[Callable[[], float]] = None):
+    def __init__(self, name, description="", tag_keys=(), fn: Optional[Callable[[], Any]] = None):
         super().__init__(name, description, tag_keys)
         self._values: Dict[tuple, float] = {}
         self._fn = fn  # callback gauge: sampled at scrape time
+        self._fn_warned = False
 
     def set(self, value: float, tags: Optional[TagDict] = None) -> None:
         with self._lock:
@@ -62,9 +80,25 @@ class Gauge(_Metric):
     def collect(self):
         if self._fn is not None:
             try:
-                return [({}, float(self._fn()))]
-            except Exception:
+                sampled = self._fn()
+            except Exception as exc:  # noqa: BLE001 - a sampler must not kill the scrape
+                # One WARNING event per gauge lifetime: a permanently
+                # broken sampler used to return [] forever, silently.
+                if not self._fn_warned:
+                    self._fn_warned = True
+                    from .events import emit
+
+                    emit("WARNING", "metrics",
+                         f"callback gauge {self.name} sampler raised; "
+                         f"series suppressed until it recovers: {exc!r}",
+                         metric=self.name)
                 return []
+            # A callback may honor tag_keys by returning tagged samples:
+            # an iterable of (tags_dict, value) pairs. A bare number stays
+            # the single untagged series.
+            if isinstance(sampled, (int, float)):
+                return [({}, float(sampled))]
+            return [(dict(tags or {}), float(value)) for tags, value in sampled]
         with self._lock:
             return [(dict(k), v) for k, v in self._values.items()]
 
@@ -124,11 +158,13 @@ class MetricsRegistry:
             metrics = list(self._metrics.values())
         lines: List[str] = []
         for m in metrics:
-            lines.append(f"# HELP {m.name} {m.description}")
+            lines.append(f"# HELP {m.name} {_escape_help(m.description)}")
             lines.append(f"# TYPE {m.name} {m.kind}")
             for tags, value in m.collect():
                 label = (
-                    "{" + ",".join(f'{k}="{v}"' for k, v in sorted(tags.items())) + "}"
+                    "{" + ",".join(
+                        f'{k}="{_escape_label(v)}"' for k, v in sorted(tags.items())
+                    ) + "}"
                     if tags
                     else ""
                 )
@@ -136,7 +172,7 @@ class MetricsRegistry:
                     # bucket lines carry the metric's tag labels plus le, so
                     # tagged histograms stay distinct series
                     tag_part = "".join(
-                        f'{k}="{v}",' for k, v in sorted(tags.items())
+                        f'{k}="{_escape_label(v)}",' for k, v in sorted(tags.items())
                     )
                     cumulative = 0
                     for bound, count in value["buckets"]:
@@ -179,6 +215,28 @@ def get_or_create_counter(name: str, description: str = "",
     if isinstance(existing, Counter):
         return existing
     return Counter(name, description, tag_keys)
+
+
+def get_or_create_gauge(name: str, description: str = "",
+                        tag_keys: Sequence[str] = (),
+                        fn: Optional[Callable[[], Any]] = None) -> Gauge:
+    """Idempotent Gauge accessor (see get_or_create_counter)."""
+    existing = _registry().get(name)
+    if isinstance(existing, Gauge):
+        return existing
+    return Gauge(name, description, tag_keys, fn=fn)
+
+
+def get_or_create_histogram(name: str, description: str = "",
+                            boundaries: Sequence[float] = (),
+                            tag_keys: Sequence[str] = ()) -> Histogram:
+    """Idempotent Histogram accessor (see get_or_create_counter) — the
+    span-derived latency observers run on every task/request, so they
+    must hit the registered series, never shadow it with a zeroed one."""
+    existing = _registry().get(name)
+    if isinstance(existing, Histogram):
+        return existing
+    return Histogram(name, description, boundaries, tag_keys)
 
 
 def register_runtime_gauges() -> None:

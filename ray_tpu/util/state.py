@@ -76,11 +76,69 @@ def summary() -> Dict[str, Any]:
     }
 
 
+def list_traces(limit: int = 100) -> List[Dict[str, Any]]:
+    """Trace summaries of THIS process's tracer (newest last): trace_id,
+    root span name, span count, wall duration. Works without a live
+    runtime — the tracer is per-process."""
+    from .tracing import tracer
+
+    return tracer().list_traces(limit=limit)
+
+
+def get_trace(trace_id: str) -> List[Dict[str, Any]]:
+    """Every span of one trace, stitched cluster-wide: local ring buffer
+    plus each node agent's (node_spans RPC), sorted by start time. A
+    remote task's execute/result spans live on the agent that ran it —
+    this is where the cross-process trace becomes one waterfall."""
+    from .tracing import tracer
+
+    spans = {s["span_id"]: s for s in tracer().spans(trace_id)}
+    if _rt.is_initialized():
+        ctx = getattr(_rt.get_runtime(), "cluster", None)
+        if ctx is not None:
+            fanned = ctx.fanout_nodes(
+                "node_spans", trace_id, 10_000, placeholder=lambda e: []
+            )
+            for node_spans in fanned.values():
+                for s in node_spans or []:
+                    spans.setdefault(s["span_id"], s)
+    return sorted(spans.values(), key=lambda s: s["start_ts"])
+
+
+def trace_dump(path: Optional[str] = None,
+               trace_id: Optional[str] = None) -> str:
+    """Perfetto/chrome-trace JSON of runtime SPANS (util/tracing) — the
+    causal, nested view that supersedes and subsumes the completed-task
+    `chrome_tracing_dump`: spans nest, one lane per node/actor/engine
+    slot, and remote spans are stitched in cluster-wide. Exported by
+    `ray_tpu timeline --trace` and the dashboard's trace endpoints."""
+    from .tracing import export_chrome_trace, tracer
+
+    if trace_id is not None:
+        spans = get_trace(trace_id)
+    else:
+        spans = {s["span_id"]: s for s in tracer().spans()}
+        if _rt.is_initialized():
+            ctx = getattr(_rt.get_runtime(), "cluster", None)
+            if ctx is not None:
+                fanned = ctx.fanout_nodes(
+                    "node_spans", None, 10_000, placeholder=lambda e: []
+                )
+                for node_spans in fanned.values():
+                    for s in node_spans or []:
+                        spans.setdefault(s["span_id"], s)
+        spans = sorted(spans.values(), key=lambda s: s["start_ts"])
+    return export_chrome_trace(spans, path)
+
+
 def chrome_tracing_dump(path: Optional[str] = None) -> str:
     """Chrome trace-event JSON of completed tasks (one lane per node).
 
     Returns the JSON string; writes it to `path` when given. Open in
-    chrome://tracing or https://ui.perfetto.dev.
+    chrome://tracing or https://ui.perfetto.dev. Superseded by
+    `trace_dump`, which exports the full span tree (queue/dispatch/
+    execute/result causality) instead of flat completed-task intervals;
+    this stays for the legacy `ray_tpu timeline` shape.
     """
     events = []
     for e in list_tasks(limit=100_000):

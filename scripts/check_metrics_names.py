@@ -1,0 +1,81 @@
+#!/usr/bin/env python
+"""Static check: metric naming + registration discipline in ray_tpu/.
+
+Two rules, enforced over every literal-name Counter(/Gauge(/Histogram(
+instantiation (including the get_or_create_* accessors) in the package:
+
+1. Every metric name carries the ``raytpu_`` prefix — the scrape
+   namespace stays collision-free against other exporters.
+2. A literal name may be DIRECTLY constructed (bare ``Counter("x"``,
+   not ``get_or_create_counter("x"``) at most once across the package:
+   a second direct construction would shadow the registered series with
+   a fresh zeroed one (MetricsRegistry.register overwrites). Re-runnable
+   emitters must go through get_or_create_*.
+
+Exits non-zero listing violations; run by tier-1 via
+tests/test_observability.py.
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+from collections import defaultdict
+from pathlib import Path
+
+# literal-first-arg metric instantiations; group 1 = constructor,
+# group 2 = metric name
+_PATTERN = re.compile(
+    r"""(?<![\w.])(Counter|Gauge|Histogram|
+        get_or_create_counter|get_or_create_gauge|get_or_create_histogram)
+        \(\s*["']([^"']+)["']""",
+    re.VERBOSE,
+)
+_DIRECT = {"Counter", "Gauge", "Histogram"}
+
+
+def check(package_root: Path):
+    errors = []
+    direct_sites = defaultdict(list)  # metric name -> [file:line]
+    for path in sorted(package_root.rglob("*.py")):
+        text = path.read_text()
+        for lineno, line in enumerate(text.splitlines(), 1):
+            stripped = line.strip()
+            if stripped.startswith(("class ", "def ", "#")):
+                continue
+            for match in _PATTERN.finditer(line):
+                ctor, name = match.group(1), match.group(2)
+                site = f"{path.relative_to(package_root.parent)}:{lineno}"
+                if not name.startswith("raytpu_"):
+                    errors.append(
+                        f"{site}: metric {name!r} missing the raytpu_ prefix"
+                    )
+                if ctor in _DIRECT:
+                    direct_sites[name].append(site)
+    for name, sites in sorted(direct_sites.items()):
+        if len(sites) > 1:
+            errors.append(
+                f"metric {name!r} directly constructed at {len(sites)} sites "
+                f"({', '.join(sites)}): all but the first silently shadow the "
+                f"registered series — use get_or_create_*"
+            )
+    return errors
+
+
+def main(argv) -> int:
+    root = Path(argv[1]) if len(argv) > 1 else (
+        Path(__file__).resolve().parent.parent / "ray_tpu"
+    )
+    errors = check(root)
+    for err in errors:
+        print(f"check_metrics_names: {err}", file=sys.stderr)
+    if errors:
+        print(f"check_metrics_names: {len(errors)} violation(s)",
+              file=sys.stderr)
+        return 1
+    print("check_metrics_names: ok")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv))

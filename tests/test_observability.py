@@ -1,6 +1,7 @@
-"""Metrics registry, /metrics endpoint, state API, chrome-trace timeline."""
+"""Metrics registry, /metrics endpoint, state API, distributed tracing."""
 
 import json
+import re
 import urllib.request
 
 import pytest
@@ -11,12 +12,15 @@ from ray_tpu.util import (
     Gauge,
     Histogram,
     chrome_tracing_dump,
+    get_trace,
     list_nodes,
     list_objects,
     list_tasks,
+    list_traces,
     registry,
     start_metrics_server,
     summary,
+    trace_dump,
 )
 
 
@@ -110,6 +114,310 @@ def test_chrome_tracing_dump(tmp_path):
         assert e["ph"] == "X"
         assert e["dur"] >= 10_000  # ≥10ms in microseconds
     assert path.exists()
+
+
+# ---------------------------------------------------------- exposition format
+
+# one exposition line: name{labels} value  (labels optional)
+_EXPO_LINE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{([a-zA-Z_][a-zA-Z0-9_]*="(\\.|[^"\\])*",?)*\})? '
+    r"[0-9.eE+-]+(inf|nan)?$"
+)
+
+
+def test_metrics_scrape_parses_with_escaped_labels():
+    """Fetch /metrics and validate the exposition format line by line:
+    tagged histogram series stay distinct, and backslash/quote/newline in
+    label values are escaped instead of corrupting the payload."""
+    c = Counter("evil_labels_total", 'desc with "quotes"\nand newline',
+                tag_keys=("path",))
+    c.inc(tags={"path": 'C:\\tmp\n"quoted"'})
+    h = Histogram("lat_seconds", "latency", boundaries=[0.1, 1.0],
+                  tag_keys=("route",))
+    h.observe(0.05, tags={"route": "a"})
+    h.observe(5.0, tags={"route": 'b\\"x\n'})
+    port = start_metrics_server()
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics", timeout=10
+    ) as r:
+        body = r.read().decode()
+    for line in body.strip().splitlines():
+        if line.startswith("#"):
+            continue
+        assert _EXPO_LINE.match(line), f"unparseable exposition line: {line!r}"
+    # escaped sequences present, raw ones absent
+    assert '\\\\tmp' in body and '\\"quoted\\"' in body and "\\n" in body
+    # tagged histogram series: labels + le on bucket lines, both routes
+    assert re.search(r'lat_seconds_bucket\{route="a",le="0.1"\} 1', body)
+    assert re.search(r'lat_seconds_count\{route="a"\} 1', body)
+    assert 'route="b' in body
+
+
+def test_callback_gauge_tagged_samples_and_sampler_warning():
+    state = {"fail": False}
+
+    def sample():
+        if state["fail"]:
+            raise RuntimeError("sampler broke")
+        return [({"shard": "a"}, 1.0), ({"shard": "b"}, 2.0)]
+
+    Gauge("cb_tagged", "tagged callback", tag_keys=("shard",), fn=sample)
+    text = registry().prometheus_text()
+    assert 'cb_tagged{shard="a"} 1.0' in text
+    assert 'cb_tagged{shard="b"} 2.0' in text
+    # a raising sampler suppresses the series AND emits one WARNING event
+    from ray_tpu.util.events import events
+
+    before = len(events().list(severity="WARNING", source="metrics",
+                               limit=1000))
+    state["fail"] = True
+    assert registry().prometheus_text().count("cb_tagged") == 2  # HELP/TYPE only
+    registry().prometheus_text()  # second failing scrape: no duplicate event
+    warnings = events().list(severity="WARNING", source="metrics", limit=1000)
+    mine = [w for w in warnings if "cb_tagged" in w["message"]]
+    assert len(mine) == 1 and len(warnings) == before + 1
+
+
+def test_event_sink_cached_handle(tmp_path):
+    from ray_tpu.util.events import EventLog
+
+    path = str(tmp_path / "ev.jsonl")
+    log = EventLog()
+    log.set_sink(path)
+    log.emit("INFO", "test", "one")
+    log.emit("INFO", "test", "two")
+    lines = [json.loads(l) for l in open(path).read().splitlines()]
+    assert [e["message"] for e in lines] == ["one", "two"]
+    # the handle is cached (no reopen per event) and swapped on set_sink
+    first_handle = log._sink_file
+    assert first_handle is not None
+    log.emit("INFO", "test", "three")
+    assert log._sink_file is first_handle
+    other = str(tmp_path / "ev2.jsonl")
+    log.set_sink(other)
+    assert log._sink_file is not first_handle
+    log.emit("INFO", "test", "four")
+    assert "four" in open(other).read()
+    log.set_sink(None)
+    log.emit("INFO", "test", "five")
+    assert "five" not in open(other).read()
+
+
+# ------------------------------------------------------------------- tracing
+
+
+def test_local_task_trace_spans_and_metrics():
+    """submit → queue → execute → result share one trace; queue/exec
+    histograms derive from the spans."""
+
+    @ray_tpu.remote
+    def traced_work():
+        import time
+
+        time.sleep(0.01)
+        return 1
+
+    assert ray_tpu.get(traced_work.remote(), timeout=30) == 1
+    trace = [t for t in list_traces() if t["root"] == "task.submit"][-1]
+    spans = get_trace(trace["trace_id"])
+    names = {s["name"] for s in spans}
+    assert {"task.submit", "task.queue", "task.execute", "task.result"} <= names
+    by_id = {s["span_id"]: s for s in spans}
+    for s in spans:
+        assert s["trace_id"] == trace["trace_id"]
+        if s["parent_id"] is not None:
+            assert s["parent_id"] in by_id, f"orphan parent for {s['name']}"
+    execute = next(s for s in spans if s["name"] == "task.execute")
+    assert execute["duration_s"] >= 0.01
+    text = registry().prometheus_text()
+    assert "raytpu_task_queue_seconds_count" in text
+    assert "raytpu_task_exec_seconds_count" in text
+
+
+def test_trace_export_valid_chrome_json(tmp_path):
+    @ray_tpu.remote
+    def exported():
+        return 2
+
+    ray_tpu.get(exported.remote(), timeout=30)
+    path = tmp_path / "spans.json"
+    payload = trace_dump(str(path))
+    trace = json.loads(payload)  # must load as valid chrome-trace JSON
+    assert path.exists() and json.loads(path.read_text()) == trace
+    events = trace["traceEvents"]
+    assert events, "no span events exported"
+    for e in events:
+        assert e["ph"] == "X"
+        assert isinstance(e["ts"], float) and e["dur"] >= 0.0
+        assert "trace_id" in e["args"]
+    assert any(e["name"] == "task.execute" for e in events)
+    # CLI path: ray_tpu timeline --trace
+    from ray_tpu.cli import main as cli_main
+
+    out = tmp_path / "cli_trace.json"
+    assert cli_main(["timeline", "--trace", str(out)]) == 0
+    assert json.loads(out.read_text())["traceEvents"]
+
+
+def test_trace_sampling_knob():
+    from ray_tpu.core.config import cfg
+    from ray_tpu.util.tracing import tracer
+
+    @ray_tpu.remote
+    def unsampled():
+        return 3
+
+    cfg.set(trace_sample_ratio=0.0)
+    try:
+        before = len(tracer().spans())
+        ray_tpu.get(unsampled.remote(), timeout=30)
+        new = [
+            s for s in tracer().spans()[before:]
+            if s["attrs"].get("task") == "unsampled"
+        ]
+        assert new == [], f"unsampled trace still recorded: {new}"
+    finally:
+        cfg.reset("trace_sample_ratio")
+
+
+def test_remote_task_span_parents_to_driver_submit_across_rpc():
+    """Acceptance: a remote task yields ONE trace whose execute span (on
+    the agent process) walks back to the driver's submit span, stitched
+    through the state API across the RPC boundary."""
+    import time as _time
+
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.core.scheduler import NodeAffinitySchedulingStrategy
+
+    ray_tpu.shutdown()  # the autouse fixture runtime is not a cluster head
+    from ray_tpu.core.config import cfg
+
+    c = Cluster(head_node_args={
+        "num_cpus": 2,
+        "_system_config": {"node_stale_s": 5.0, "node_heartbeat_s": 0.2},
+    })
+    try:
+        c.add_node(num_cpus=2, system_config={"node_heartbeat_s": 0.2})
+        c.wait_for_nodes(2)
+        remote_node = next(
+            n for n in c.runtime.scheduler.nodes() if n.is_remote
+        )
+
+        @ray_tpu.remote
+        def remote_probe():
+            import os
+
+            return os.getpid()
+
+        pid = ray_tpu.get(
+            remote_probe.options(
+                scheduling_strategy=NodeAffinitySchedulingStrategy(
+                    remote_node.node_id
+                )
+            ).remote(),
+            timeout=60,
+        )
+        import os
+
+        assert pid != os.getpid(), "task did not land on the agent"
+        _time.sleep(0.3)  # let the agent finish recording result spans
+        trace = next(
+            t for t in reversed(list_traces())
+            if t["root"] == "task.submit"
+        )
+        spans = get_trace(trace["trace_id"])
+        names = {s["name"] for s in spans}
+        assert {"task.submit", "task.queue", "task.dispatch",
+                "task.execute", "task.result"} <= names, names
+        by_id = {s["span_id"]: s for s in spans}
+        execute = next(s for s in spans if s["name"] == "task.execute")
+        assert execute["attrs"].get("remote") is True  # ran on the agent
+        chain = []
+        cur = execute
+        while cur["parent_id"] is not None:
+            cur = by_id[cur["parent_id"]]
+            chain.append(cur["name"])
+        assert chain[-1] == "task.submit", chain
+        assert all(s["trace_id"] == trace["trace_id"] for s in spans)
+        # exportable as valid chrome JSON through the state API
+        exported = json.loads(trace_dump(trace_id=trace["trace_id"]))
+        assert any(
+            e["name"] == "task.execute" for e in exported["traceEvents"]
+        )
+        # span-derived histograms visible on the scrape
+        text = registry().prometheus_text()
+        assert "raytpu_task_queue_seconds_count" in text
+    finally:
+        c.shutdown()
+        cfg.reset()
+
+
+def test_serve_request_spans_yield_ttft_tpot():
+    """An engine request span carries token counts and yields TTFT/TPOT
+    observations into the serve histograms."""
+    import jax
+
+    from ray_tpu.models import get_config, init_params
+    from ray_tpu.serve.llm.engine import EngineConfig, LLMEngine
+    from ray_tpu.util.tracing import tracer
+
+    config = get_config("llama-tiny")
+    params = init_params(config, jax.random.PRNGKey(0))
+    engine = LLMEngine(config, params, EngineConfig(max_slots=2))
+    try:
+        tokens = engine.generate([5, 17, 42, 7], max_tokens=8)
+        assert len(tokens) == 8
+    finally:
+        engine.shutdown()
+    req = next(
+        s for s in reversed(tracer().spans())
+        if s["name"] == "engine.request"
+    )
+    assert req["attrs"]["generated_tokens"] == 8
+    assert req["attrs"]["ttft_s"] > 0
+    assert req["attrs"]["tpot_s"] > 0
+    assert req["attrs"]["queue_s"] >= 0
+    text = registry().prometheus_text()
+    assert "raytpu_serve_ttft_seconds_count" in text
+    assert "raytpu_serve_tpot_seconds_count" in text
+    assert any(
+        s["name"] == "engine.prefill" for s in tracer().spans()
+    )
+
+
+def test_metric_names_static_check():
+    """Tier-1 wiring for scripts/check_metrics_names.py: the package obeys
+    the raytpu_ prefix + no-duplicate-direct-registration rules, and the
+    checker actually catches violations."""
+    import pathlib
+    import subprocess
+    import sys as _sys
+
+    repo = pathlib.Path(__file__).resolve().parent.parent
+    script = repo / "scripts" / "check_metrics_names.py"
+    proc = subprocess.run(
+        [_sys.executable, str(script)], capture_output=True, text=True
+    )
+    assert proc.returncode == 0, proc.stderr
+    # the checker must flag a bad package, not just pass everything
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("cmn", script)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as tmp:
+        bad = pathlib.Path(tmp) / "pkg"
+        bad.mkdir()
+        (bad / "m.py").write_text(
+            'c = Counter("unprefixed_total", "x")\n'
+            'd = Counter("raytpu_dup_total", "x")\n'
+        )
+        (bad / "n.py").write_text('e = Counter("raytpu_dup_total", "x")\n')
+        errors = mod.check(bad)
+        assert any("unprefixed_total" in e for e in errors)
+        assert any("raytpu_dup_total" in e and "2 sites" in e for e in errors)
 
 
 def test_device_trace_captures_xla_profile(tmp_path):
