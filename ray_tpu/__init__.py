@@ -38,9 +38,19 @@ from .api import (  # noqa: F401
 )
 from .core.exceptions import (  # noqa: F401
     ActorDiedError,
+    ActorError,
+    ActorUnavailableError,
+    BackPressureError,
+    DeploymentUnavailableError,
     GetTimeoutError,
     ObjectLostError,
+    ObjectStoreFullError,
+    OutOfResourcesError,
+    PlacementGroupUnschedulableError,
     RayTpuError,
+    ReplicaDrainingError,
+    RequestTimeoutError,
+    RuntimeNotInitializedError,
     TaskCancelledError,
     TaskError,
 )

@@ -386,6 +386,13 @@ class ActorRuntime:
                 self._mailbox.put(_POISON)
                 result = None
             else:
+                # chaos boundary for actor calls (the task path injects in
+                # the scheduler): serve replicas are actors, so resilience
+                # drills arm name_filter="actor:" (or a deployment name)
+                # to perturb replica calls like real faults
+                from . import chaos
+
+                chaos.maybe_inject(f"actor:{self.name}.{call.method_name}")
                 args = tuple(
                     a.resolve() if getattr(a, "__ray_tpu_lazy__", False) else a
                     for a in call.args

@@ -41,6 +41,12 @@ class ChaosConfig:
     name_filter: Optional[str] = None  # substring match on task name
     seed: int = 0
     kill_node: bool = False  # matching task kills THIS process (node death)
+    # RPC-layer injection (RpcClient.call): probabilistic transport
+    # errors, added call latency, and connection drops — the knobs the
+    # serve resilience drills arm (env: RAY_TPU_CHAOS="rpc_error_prob=...")
+    rpc_error_prob: float = 0.0
+    rpc_delay_s: float = 0.0
+    rpc_drop_prob: float = 0.0
 
 
 class _ChaosState:
@@ -61,11 +67,14 @@ def set_chaos(
     name_filter: Optional[str] = None,
     seed: int = 0,
     kill_node: bool = False,
+    rpc_error_prob: float = 0.0,
+    rpc_delay_s: float = 0.0,
+    rpc_drop_prob: float = 0.0,
 ) -> None:
     with _state.lock:
         _state.config = ChaosConfig(
             failure_prob, delay_s, max_injections, name_filter, seed,
-            kill_node,
+            kill_node, rpc_error_prob, rpc_delay_s, rpc_drop_prob,
         )
         _state.injected = 0
         _state.rng = np.random.default_rng(seed)
@@ -89,7 +98,8 @@ def load_from_env() -> None:
     for part in raw.split(","):
         k, _, v = part.partition("=")
         k = k.strip()
-        if k in ("failure_prob", "delay_s"):
+        if k in ("failure_prob", "delay_s", "rpc_error_prob", "rpc_delay_s",
+                 "rpc_drop_prob"):
             kwargs[k] = float(v)
         elif k in ("max_injections", "seed"):
             kwargs[k] = int(v)
@@ -145,3 +155,45 @@ def maybe_inject(task_name: str) -> None:
         raise ChaosInjectedError(
             f"chaos: injected failure in task {task_name!r} (#{fail_ordinal})"
         )
+
+
+def rpc_action(method: str) -> Optional[dict]:
+    """Called by RpcClient.call before touching the wire. Returns the
+    injected perturbation for this call, or None:
+      {"delay": seconds, "fail": bool, "drop": bool}
+    `fail` simulates a transport error BEFORE the frame is sent (so the
+    client's reconnect policy may retry it); `drop` severs the client's
+    persistent connection first, forcing a reconnect. All three count
+    against max_injections and honor name_filter (matched on the RPC
+    method name)."""
+    config = _state.config
+    if config is None:
+        return None
+    if not (config.rpc_error_prob or config.rpc_delay_s or config.rpc_drop_prob):
+        return None
+    if config.name_filter and config.name_filter not in method:
+        return None
+    action = {"delay": 0.0, "fail": False, "drop": False}
+    with _state.lock:
+        if 0 <= config.max_injections <= _state.injected:
+            return None
+        if config.rpc_delay_s > 0:
+            action["delay"] = config.rpc_delay_s
+            _state.injected += 1
+        if (
+            config.rpc_drop_prob > 0
+            and not (0 <= config.max_injections <= _state.injected)
+            and _state.rng.random() < config.rpc_drop_prob
+        ):
+            action["drop"] = True
+            _state.injected += 1
+        if (
+            config.rpc_error_prob > 0
+            and not (0 <= config.max_injections <= _state.injected)
+            and _state.rng.random() < config.rpc_error_prob
+        ):
+            action["fail"] = True
+            _state.injected += 1
+    if action["delay"] or action["fail"] or action["drop"]:
+        return action
+    return None

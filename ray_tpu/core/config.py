@@ -143,6 +143,31 @@ define_flag("pg_reschedule_wait_s", 60.0,
             "How long dependents (bundle-actor restarts, gang re-mesh) "
             "wait for a RESCHEDULING placement group to re-reserve.")
 
+# serve resilience (deadlines / retry / admission / draining)
+define_flag("serve_default_timeout_s", 0.0,
+            "Default end-to-end deadline for serve requests in seconds "
+            "(0 = no deadline); per-handle options(timeout_s=...) wins.")
+define_flag("serve_retry_max_attempts", 3,
+            "Total router attempts per serve request (1 = no failover); "
+            "retried only on replica-death/transport-class errors.")
+define_flag("serve_retry_backoff_s", 0.05,
+            "Base jittered backoff between router failover attempts "
+            "(doubles per attempt, capped at 2s).")
+define_flag("serve_drain_timeout_s", 10.0,
+            "Default grace a DRAINING replica gets to finish in-flight "
+            "requests before the controller force-kills it.")
+define_flag("serve_reaper_max_tracked", 4096,
+            "Cap on request refs the serve reaper tracks; overflow "
+            "releases + drops the oldest entry and bumps a warning metric.")
+
+# rpc client reconnect policy
+define_flag("rpc_reconnect_attempts", 4,
+            "Max RpcClient connection attempts per call (connect/send-phase "
+            "failures only — a fully-sent frame is never resent).")
+define_flag("rpc_reconnect_backoff_s", 0.1,
+            "Base jittered backoff between RpcClient reconnect attempts "
+            "(doubles per attempt, capped at 2s).")
+
 # tracing / observability
 define_flag("trace_sample_ratio", 1.0,
             "Fraction of new traces recorded by util/tracing (0 disables; "

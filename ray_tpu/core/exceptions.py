@@ -80,6 +80,44 @@ class TaskCancelledError(RayTpuError):
     pass
 
 
+class RequestTimeoutError(RayTpuError, TimeoutError):
+    """A serve request outlived its end-to-end deadline.
+
+    Raised router-side (the deadline expired while queued or in flight)
+    and engine-side (the slot was cancelled/evicted mid-generation).
+    Subclasses TimeoutError so generic timeout handlers still fire.
+    """
+
+
+class BackPressureError(RayTpuError):
+    """Admission control shed this request: the deployment's queue bound
+    (`max_queued_requests`) or an engine's admit-queue bound was full.
+    Retryable by the CLIENT after backoff — HTTP layers map it to 429
+    with a Retry-After header."""
+
+
+class ReplicaDrainingError(RayTpuError):
+    """The picked replica is DRAINING (scale-down/redeploy): it finishes
+    in-flight work but accepts no new requests. The router treats this as
+    retryable and fails over to a live replica."""
+
+
+class DeploymentUnavailableError(RayTpuError):
+    """A deployment currently has no routable replicas (all dead or
+    draining). HTTP layers map it to 503."""
+
+
+def unwrap_error(err: BaseException) -> BaseException:
+    """Peel TaskError wrappers off an exception that crossed task/actor
+    boundaries, returning the innermost cause — the type callers (router
+    retry policy, HTTP status mapping) actually dispatch on."""
+    seen = 0
+    while isinstance(err, TaskError) and err.cause is not None and seen < 16:
+        err = err.cause
+        seen += 1
+    return err
+
+
 class OutOfResourcesError(RayTpuError):
     """A task requires resources no node in the cluster can ever satisfy."""
 

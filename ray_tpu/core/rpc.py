@@ -225,19 +225,39 @@ class RpcServer:
 
 
 class RpcClient:
-    """One persistent connection, retried on transport failure.
+    """One persistent connection with a bounded reconnect policy.
+
+    Non-idempotent-safe: an attempt is retried ONLY when its request
+    frame provably never reached the server whole — connect failures,
+    send-phase failures, and the stale-persistent-connection case (a
+    REUSED socket that died before yielding a single reply byte, i.e.
+    the server closed it before this frame arrived). A frame that was
+    fully sent on a fresh connection is never resent: the handler may
+    have executed, and re-executing non-idempotent handlers (dispatch,
+    actor restarts) is worse than surfacing the transport error.
 
     Thread-safe: calls serialize on a lock (open N clients for
     parallelism — connections are cheap)."""
 
     def __init__(self, address: str, *, timeout: Optional[float] = 30.0,
-                 retries: int = 2, retry_wait_s: float = 0.2,
+                 retries: Optional[int] = None,
+                 retry_wait_s: Optional[float] = None,
                  token: Optional[str] = None):
+        from .config import cfg
+
         host, _, port = address.rpartition(":")
         self._addr = (host or "127.0.0.1", int(port))
         self._timeout = timeout
-        self._retries = retries
-        self._retry_wait = retry_wait_s
+        # retries = reconnect attempts AFTER the first try; defaults come
+        # from the flag registry (rpc_reconnect_attempts counts attempts)
+        self._retries = (
+            retries if retries is not None
+            else max(0, int(cfg.rpc_reconnect_attempts) - 1)
+        )
+        self._retry_wait = (
+            retry_wait_s if retry_wait_s is not None
+            else float(cfg.rpc_reconnect_backoff_s)
+        )
         self._token = token or None
         self._sock: Optional[socket.socket] = None
         self._lock = threading.Lock()
@@ -272,9 +292,20 @@ class RpcClient:
                 raise RpcAuthError(f"bad auth ack from {self._addr}")
         return sock
 
+    def _backoff(self, attempt: int) -> None:
+        """Jittered exponential backoff between reconnect attempts."""
+        import random
+
+        wait = min(2.0, self._retry_wait * (2 ** attempt))
+        time.sleep(wait * (0.5 + random.random()))
+
     def call(self, method: str, *args, **kwargs) -> Any:
         """Invoke a remote method; handler exceptions re-raise here,
-        transport failures retry then raise RpcError."""
+        transport failures reconnect under the bounded policy (class
+        docstring) then raise RpcError. When retries happened inside a
+        sampled trace, the attempt count surfaces as an `attempts` span
+        attribute (`rpc.client_retries`)."""
+        from . import chaos
         from ..util import tracing
 
         # inject the active span context into the frame (no-op without a
@@ -283,13 +314,31 @@ class RpcClient:
             (method, args, tracing.inject_context(kwargs, method))
         )
         last: Optional[BaseException] = None
+        t0 = time.time()
+        attempt = 0
         for attempt in range(self._retries + 1):
+            sent = False
+            fresh = False
+            reply_bytes = [0]
             try:
+                act = chaos.rpc_action(method)
+                if act is not None:
+                    if act["delay"]:
+                        time.sleep(act["delay"])
+                    if act["drop"]:
+                        self.close()  # sever: the attempt reconnects
+                    if act["fail"]:
+                        raise RpcError(
+                            f"chaos: injected rpc transport error on "
+                            f"{method!r}"
+                        )
                 with self._lock:
                     if self._sock is None:
                         self._sock = self._connect()
+                        fresh = True
                     _send_frame(self._sock, payload)
-                    frame = _recv_frame(self._sock)
+                    sent = True
+                    frame = self._recv_frame_counting(self._sock, reply_bytes)
                 if frame.startswith(_AUTH_MAGIC):
                     # a tokenless client on an auth-requiring server: the
                     # server's first frame is its challenge, not a reply
@@ -312,18 +361,61 @@ class RpcClient:
                         except OSError:
                             pass
                         self._sock = None
+                # Non-idempotent safety: a fully-sent frame is resent only
+                # in the stale-connection case — the REUSED socket died
+                # without a single reply byte, i.e. the server shut the
+                # connection before this frame could have been dispatched.
+                retry_safe = (not sent) or (not fresh and reply_bytes[0] == 0)
+                if not retry_safe:
+                    raise RpcError(
+                        f"rpc {method!r} to {self._addr} failed after the "
+                        f"request frame was delivered; not retried "
+                        f"(non-idempotent): {exc!r}"
+                    ) from exc
                 if attempt < self._retries:
-                    time.sleep(self._retry_wait * (attempt + 1))
+                    self._backoff(attempt)
                 continue
             # Server-side handler errors re-raise OUTSIDE the retried
             # try: a handler exception that subclasses OSError (e.g.
             # FileNotFoundError from a working_dir handler) must not be
             # mistaken for a transport failure — that would tear down a
             # healthy connection and re-execute non-idempotent handlers.
+            if attempt > 0:
+                ctx = tracing.current_context()
+                if ctx is not None:
+                    tracing.tracer().record_span(
+                        "rpc.client_retries", t0, time.time(), parent=ctx,
+                        attrs={"method": method, "attempts": attempt + 1},
+                    )
             if status == "err":
                 raise value
             return value
         raise RpcError(f"rpc to {self._addr} failed after retries: {last!r}")
+
+    @staticmethod
+    def _recv_frame_counting(sock: socket.socket, counter) -> bytes:
+        """_recv_frame with a received-byte count, so the retry policy can
+        distinguish 'stale connection, no reply started' from 'reply torn
+        mid-frame' (the latter proves the server got the request)."""
+        need = _HDR.size
+        buf = bytearray()
+        while len(buf) < need:
+            chunk = sock.recv(min(need - len(buf), 1 << 20))
+            if not chunk:
+                raise RpcError("connection closed mid-frame")
+            buf.extend(chunk)
+            counter[0] += len(chunk)
+        (length,) = _HDR.unpack(bytes(buf))
+        if length > MAX_FRAME:
+            raise RpcError(f"frame of {length} bytes exceeds the 2 GiB bound")
+        body = bytearray()
+        while len(body) < length:
+            chunk = sock.recv(min(length - len(body), 1 << 20))
+            if not chunk:
+                raise RpcError("connection closed mid-frame")
+            body.extend(chunk)
+            counter[0] += len(chunk)
+        return bytes(body)
 
     def close(self) -> None:
         with self._lock:

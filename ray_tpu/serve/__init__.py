@@ -5,6 +5,12 @@ autoscaling, stdlib HTTP ingress, and a TPU continuous-batching LLM engine
 (static slot grid over a dense KV cache — compiles once, batches forever).
 """
 
+from ..core.exceptions import (  # noqa: F401 - serve-facing typed errors
+    BackPressureError,
+    DeploymentUnavailableError,
+    ReplicaDrainingError,
+    RequestTimeoutError,
+)
 from .api import (  # noqa: F401
     delete,
     get_handle,
@@ -13,6 +19,7 @@ from .api import (  # noqa: F401
     start_http,
     status,
 )
+from .context import get_request_deadline, remaining_s  # noqa: F401
 from .deployment import (  # noqa: F401
     Application,
     AutoscalingConfig,

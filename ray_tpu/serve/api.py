@@ -97,6 +97,7 @@ class _HttpProxy:
             protocol_version = "HTTP/1.1"
 
             def do_POST(self):  # noqa: N802
+                retry_after = None
                 try:
                     from urllib.parse import parse_qs, urlsplit
 
@@ -105,6 +106,7 @@ class _HttpProxy:
                     length = int(self.headers.get("Content-Length", 0))
                     payload = json.loads(self.rfile.read(length) or b"{}")
                     # path = /<deployment>[/<method>][?stream=1][&model_id=m]
+                    #        [&timeout_s=5]
                     parts = [p for p in url.path.split("/") if p]
                     if not parts:
                         raise KeyError("missing deployment in path")
@@ -112,6 +114,9 @@ class _HttpProxy:
                     model_id = query.get("model_id", [None])[0]
                     if model_id:
                         handle = handle.options(multiplexed_model_id=model_id)
+                    timeout_s = query.get("timeout_s", [None])[0]
+                    if timeout_s:
+                        handle = handle.options(timeout_s=float(timeout_s))
                     method = parts[1] if len(parts) > 1 else "__call__"
                     if query.get("stream", ["0"])[0] in ("1", "true"):
                         self._stream_response(handle, method, payload)
@@ -124,10 +129,36 @@ class _HttpProxy:
                     body = json.dumps({"error": f"not found: {e}"}).encode()
                     self.send_response(404)
                 except Exception as e:
-                    body = json.dumps({"error": repr(e)}).encode()
-                    self.send_response(500)
+                    # typed serve errors keep their HTTP semantics: shed →
+                    # 429 + Retry-After, no replicas → 503, deadline → 504
+                    from ..core.exceptions import (
+                        BackPressureError,
+                        DeploymentUnavailableError,
+                        GetTimeoutError,
+                        ReplicaDrainingError,
+                        RequestTimeoutError,
+                        unwrap_error,
+                    )
+
+                    cause = unwrap_error(e)
+                    if isinstance(cause, BackPressureError):
+                        code, retry_after = 429, 1
+                    elif isinstance(
+                        cause, (DeploymentUnavailableError, ReplicaDrainingError)
+                    ):
+                        code, retry_after = 503, 1
+                    elif isinstance(
+                        cause, (RequestTimeoutError, GetTimeoutError)
+                    ):
+                        code = 504
+                    else:
+                        code = 500
+                    body = json.dumps({"error": repr(cause)}).encode()
+                    self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                if retry_after is not None:
+                    self.send_header("Retry-After", str(retry_after))
                 self.end_headers()
                 self.wfile.write(body)
 

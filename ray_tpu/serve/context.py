@@ -1,0 +1,45 @@
+"""Per-request serve context: the end-to-end deadline.
+
+The router stamps each request with an absolute deadline (epoch seconds,
+``_deadline_ts`` kwarg — the same kwargs channel tracing context rides).
+`_ReplicaWrapper.call` pops it and makes it ambient here so deployment
+code — and anything it calls, notably `LLMServer._submit` handing the
+deadline to an engine, or a downstream `DeploymentHandle` hop — inherits
+the remaining budget instead of starting a fresh clock per hop
+(reference parity: Serve's request-context deadline propagation).
+"""
+
+from __future__ import annotations
+
+import contextvars
+import time
+from typing import Optional
+
+_deadline: contextvars.ContextVar[Optional[float]] = contextvars.ContextVar(
+    "raytpu_serve_deadline", default=None
+)
+
+
+def get_request_deadline() -> Optional[float]:
+    """Absolute deadline (time.time() epoch seconds) of the serve request
+    currently executing on this thread, or None when no deadline is set."""
+    return _deadline.get()
+
+
+def remaining_s() -> Optional[float]:
+    """Seconds left before the ambient deadline (None = no deadline;
+    never negative)."""
+    deadline = _deadline.get()
+    if deadline is None:
+        return None
+    return max(0.0, deadline - time.time())
+
+
+def _set_request_deadline(deadline_ts: Optional[float]):
+    """Internal: installs the deadline for the executing request; returns
+    the reset token. Only `_ReplicaWrapper` should call this."""
+    return _deadline.set(deadline_ts)
+
+
+def _reset_request_deadline(token) -> None:
+    _deadline.reset(token)

@@ -3,7 +3,10 @@
 Reference parity: serve/_private/controller.py:86 ServeController +
 deployment_state.py (DeploymentStateManager :2343, DeploymentState FSM
 :1248) + autoscaling_state.py. One reconcile thread owns: replica start/
-stop, health checks with restarts, and ongoing-request autoscaling.
+stop, health checks with restarts, ongoing-request autoscaling, and
+graceful draining — scale-down and redeploy mark replicas DRAINING (the
+router stops picking them; in-flight requests finish up to a drain
+deadline) before the actor is killed.
 """
 
 from __future__ import annotations
@@ -11,34 +14,58 @@ from __future__ import annotations
 import logging
 import threading
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from .. import api
 from ..core.actors import ActorState
+from ..core.exceptions import ReplicaDrainingError, RequestTimeoutError
 from .deployment import Application, Deployment
-from .router import _rkey, DeploymentHandle, ReplicaSet
+from .router import _counter, _rkey, DeploymentHandle, ReplicaSet
+
+logger = logging.getLogger(__name__)
 
 
 class _ReplicaWrapper:
     """Actor body: hosts the user's deployment instance."""
 
     def __init__(self, cls, args, kwargs):
+        self._draining = False
         self._instance = cls(*args, **kwargs)
 
+    def prepare_drain(self) -> str:
+        """Controller marks this replica DRAINING: in-flight calls finish,
+        new calls are rejected with a typed (router-retryable) error."""
+        self._draining = True
+        return "draining"
+
     def call(self, method: str, *args, **kwargs):
+        from . import context as serve_ctx
         from .multiplex import _set_model_id
 
         model_id = kwargs.pop("_multiplexed_model_id", None)
+        deadline = kwargs.pop("_deadline_ts", None)
+        if self._draining:
+            # a call that raced the drain mark: bounce it so the router
+            # fails over instead of queueing work behind a dying replica
+            raise ReplicaDrainingError(
+                f"replica is draining; retry {method!r} on a live replica"
+            )
+        if deadline is not None and time.time() >= deadline:
+            raise RequestTimeoutError(
+                f"request deadline expired before {method!r} started"
+            )
         _set_model_id(model_id)
+        token = serve_ctx._set_request_deadline(deadline)
         try:
             result = getattr(self._instance, method)(*args, **kwargs)
-            if model_id and hasattr(result, "__next__"):
+            if hasattr(result, "__next__") and (model_id or deadline is not None):
                 # generator bodies run at iteration time (the streaming
                 # executor drains them after this returns): re-establish
-                # the model-id context around the actual execution
-                return _with_model_id(result, model_id)
+                # the model-id + deadline context around actual execution
+                return _with_request_context(result, model_id, deadline)
             return result
         finally:
+            serve_ctx._reset_request_deadline(token)
             _set_model_id(None)
 
     def health(self) -> str:
@@ -48,13 +75,17 @@ class _ReplicaWrapper:
         return "ok"
 
 
-def _with_model_id(gen, model_id: str):
+def _with_request_context(gen, model_id: Optional[str],
+                          deadline: Optional[float]):
+    from . import context as serve_ctx
     from .multiplex import _set_model_id
 
     _set_model_id(model_id)
+    token = serve_ctx._set_request_deadline(deadline)
     try:
         yield from gen
     finally:
+        serve_ctx._reset_request_deadline(token)
         _set_model_id(None)
 
 
@@ -73,7 +104,11 @@ class _DeploymentState:
         if deployment.config.autoscaling:
             self.target_replicas = deployment.config.autoscaling.min_replicas
         self.replicas: List[Any] = []
-        self.replica_set = ReplicaSet(deployment.name)
+        self.replica_set = ReplicaSet(
+            deployment.name,
+            max_ongoing=deployment.config.max_ongoing_requests,
+            max_queued=deployment.config.max_queued_requests,
+        )
         self.last_scale_down = time.time()
         # readiness/probe tracking for the health pruner (keyed by actor
         # id hex — stable, unlike id() which recycles addresses)
@@ -81,6 +116,10 @@ class _DeploymentState:
         self.ready_at: Dict[str, float] = {}
         self.probe_refs: Dict[str, Any] = {}   # key -> (ref, sent_at)
         self.last_probe: Dict[str, float] = {}
+        # DRAINING replicas: key -> (handle, force-kill deadline). Out of
+        # `replicas` (never routed/probed) but kept alive until their
+        # ongoing count hits zero or the drain deadline passes.
+        self.draining: Dict[str, Tuple[Any, float]] = {}
 
     def forget(self, key: str) -> None:
         for d in (self.started_at, self.ready_at, self.probe_refs, self.last_probe):
@@ -92,6 +131,8 @@ class ServeController:
 
     def __init__(self, reconcile_interval_s: float = 0.2):
         self._states: Dict[str, _DeploymentState] = {}
+        # deleted/redeployed deployments whose replicas are still draining
+        self._condemned: List[_DeploymentState] = []
         self._lock = threading.Lock()
         self._interval = reconcile_interval_s
         self._stop = threading.Event()
@@ -113,7 +154,7 @@ class ServeController:
             # fresh .bind() is a different object and replaces below
             return DeploymentHandle(existing.replica_set)
         if existing is not None:
-            self.delete(dep.name)  # redeploy: release old replicas
+            self.delete(dep.name)  # redeploy: old replicas drain out
         source_app = app
         init_args = tuple(
             self.deploy(a, _is_child=True) if isinstance(a, Application) else a
@@ -137,13 +178,32 @@ class ServeController:
                 raise KeyError(f"no deployment {name!r}; have {list(self._states)}")
             return DeploymentHandle(self._states[name].replica_set)
 
-    def delete(self, name: str) -> None:
+    def delete(self, name: str, drain: bool = True) -> None:
+        """Remove a deployment. With drain=True (the default) its live
+        replicas go DRAINING — they finish in-flight requests up to the
+        drain deadline before being killed; drain=False kills instantly."""
         with self._lock:
             state = self._states.pop(name, None)
-        if state:
-            for r in state.replicas:
-                _kill_quietly(r)
+        if not state:
+            return
+        if drain:
+            for r in list(state.replicas):
+                self._begin_drain(state, r)
+            state.replicas = []
             state.replica_set.set_replicas([])
+            with self._lock:
+                if state.draining:
+                    self._condemned.append(state)
+            if state.draining:
+                self._ensure_thread()
+            return
+        for r in state.replicas:
+            _kill_quietly(r)
+        for key, (r, _) in list(state.draining.items()):
+            _kill_quietly(r)
+            state.replica_set.finish_draining(key)
+        state.draining.clear()
+        state.replica_set.set_replicas([])
 
     def shutdown(self) -> None:
         self._stop.set()
@@ -151,8 +211,15 @@ class ServeController:
             self._thread.join(timeout=5)
         with self._lock:
             names = list(self._states)
+            condemned = list(self._condemned)
+            self._condemned = []
         for name in names:
-            self.delete(name)
+            self.delete(name, drain=False)
+        for state in condemned:
+            for key, (r, _) in list(state.draining.items()):
+                _kill_quietly(r)
+                state.replica_set.finish_draining(key)
+            state.draining.clear()
 
     def status(self) -> Dict[str, Dict[str, Any]]:
         with self._lock:
@@ -160,6 +227,7 @@ class ServeController:
                 name: {
                     "target_replicas": s.target_replicas,
                     "live_replicas": len(s.replicas),
+                    "draining_replicas": len(s.draining),
                     "ongoing": s.replica_set.total_ongoing(),
                 }
                 for name, s in self._states.items()
@@ -179,12 +247,64 @@ class ServeController:
         while not self._stop.wait(self._interval):
             with self._lock:
                 states = list(self._states.values())
+                condemned = list(self._condemned)
             for state in states:
                 try:
                     self._autoscale(state)
                     self._reconcile_one(state)
                 except Exception:
                     logger.exception("reconcile failed for %s", state.deployment.name)
+            for state in condemned:
+                try:
+                    self._reap_draining(state)
+                except Exception:
+                    logger.exception("drain reap failed for %s", state.deployment.name)
+                if not state.draining:
+                    with self._lock:
+                        try:
+                            self._condemned.remove(state)
+                        except ValueError:
+                            pass
+
+    def _begin_drain(self, state: _DeploymentState, victim: Any) -> None:
+        """Move a replica to DRAINING: the router stops picking it, the
+        replica bounces new calls, and the reaper below kills it once its
+        ongoing count drains (or the drain deadline passes)."""
+        key = _rkey(victim)
+        state.replica_set.mark_draining(key)
+        state.forget(key)
+        state.draining[key] = (
+            victim,
+            time.monotonic() + state.deployment.config.drain_timeout_s,
+        )
+        try:
+            victim.prepare_drain.remote()  # best-effort flag on the actor
+        except Exception:
+            pass
+
+    def _reap_draining(self, state: _DeploymentState) -> None:
+        now = time.monotonic()
+        for key, (victim, kill_at) in list(state.draining.items()):
+            ongoing = state.replica_set.ongoing_for(key)
+            if ongoing <= 0 or now >= kill_at:
+                if ongoing > 0:
+                    _counter(
+                        "raytpu_serve_drain_forced_total",
+                        "draining replicas force-killed at the drain deadline",
+                    ).inc()
+                    logger.warning(
+                        "drain deadline passed for %s replica %s with %d "
+                        "request(s) still in flight; force-killing",
+                        state.deployment.name, key[:12], ongoing,
+                    )
+                else:
+                    _counter(
+                        "raytpu_serve_drained_total",
+                        "replicas drained cleanly before removal",
+                    ).inc()
+                _kill_quietly(victim)
+                state.replica_set.finish_draining(key)
+                del state.draining[key]
 
     def _reconcile_one(self, state: _DeploymentState) -> None:
         dep = state.deployment
@@ -216,11 +336,17 @@ class ServeController:
             replica = actor_cls.remote(dep.cls, state.app.init_args, state.app.init_kwargs)
             state.started_at[_rkey(replica)] = time.monotonic()
             state.replicas.append(replica)
-        # scale down (newest first)
+        # scale down (newest first): drain, don't guillotine — READY
+        # replicas may be mid-request; unready ones die immediately
         while len(state.replicas) > state.target_replicas:
             victim = state.replicas.pop()
-            _kill_quietly(victim)
-            state.forget(_rkey(victim))
+            key = _rkey(victim)
+            if key in state.ready_at and dep.config.drain_timeout_s > 0:
+                self._begin_drain(state, victim)
+            else:
+                _kill_quietly(victim)
+                state.forget(key)
+        self._reap_draining(state)
         # route only to READY replicas so requests never queue behind a
         # replica's __init__; fall back to all replicas during initial
         # bring-up (an empty set would hard-fail callers instead of

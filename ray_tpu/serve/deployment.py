@@ -25,6 +25,15 @@ class AutoscalingConfig:
 class DeploymentConfig:
     num_replicas: int = 1
     max_ongoing_requests: int = 8
+    # admission control: requests beyond (replicas x max_ongoing_requests)
+    # + max_queued_requests are SHED at the router with a typed
+    # BackPressureError (HTTP layers map it to 429 + Retry-After).
+    # -1 = unlimited queueing (the pre-resilience behavior).
+    max_queued_requests: int = -1
+    # graceful scale-down/redeploy: a removed replica goes DRAINING (no
+    # new requests routed) and gets this long to finish in-flight work
+    # before the controller force-kills it. 0 = kill immediately.
+    drain_timeout_s: float = 10.0
     autoscaling: Optional[AutoscalingConfig] = None
     health_check_period_s: float = 1.0
     # probe budget for a RUNNING replica (reference
@@ -75,16 +84,25 @@ def deployment(
     name: Optional[str] = None,
     num_replicas: int = 1,
     max_ongoing_requests: int = 8,
+    max_queued_requests: int = -1,
+    drain_timeout_s: float = 10.0,
     autoscaling: Optional[AutoscalingConfig] = None,
     resources_per_replica: Optional[Dict[str, float]] = None,
     max_restarts: int = 3,
 ) -> Any:
-    """@serve.deployment decorator (reference serve/api.py:deployment)."""
+    """@serve.deployment decorator (reference serve/api.py:deployment).
+
+    max_queued_requests bounds router-side queueing (overflow sheds with
+    BackPressureError → HTTP 429); drain_timeout_s is the grace a
+    replica gets to finish in-flight requests on scale-down/redeploy.
+    """
 
     def wrap(c: type) -> Deployment:
         config = DeploymentConfig(
             num_replicas=num_replicas,
             max_ongoing_requests=max_ongoing_requests,
+            max_queued_requests=max_queued_requests,
+            drain_timeout_s=drain_timeout_s,
             autoscaling=autoscaling,
             resources_per_replica=resources_per_replica,
             max_restarts=max_restarts,

@@ -40,6 +40,10 @@ class EngineConfig:
     max_seq: Optional[int] = None  # KV capacity per slot (default model max)
     eos_id: int = -1  # -1: never stop on a token
     prefill_bucket_min: int = 16
+    # admission bound on the submit queue: overflow raises a typed
+    # BackPressureError instead of queueing unboundedly. 0 = auto
+    # (8 x max_slots); negative disables the bound.
+    max_queued_requests: int = 0
 
 
 @dataclasses.dataclass
@@ -76,6 +80,9 @@ class _Request:
     # opened at submit — TTFT/TPOT/queue-time derive from it at retire
     generated: int = 0
     span: Any = None
+    # end-to-end deadline (epoch seconds): expired requests fail fast at
+    # admit and are cancelled/evicted mid-generation
+    deadline_ts: Optional[float] = None
 
 
 def _start_request_span(request: "_Request", engine_kind: str) -> None:
@@ -110,6 +117,45 @@ def _finish_request_span(request: "_Request", status: str = "OK") -> None:
                 / (request.generated - 1)
             )
     span.end(status=status, **attrs)
+
+
+def _queue_bound(config) -> int:
+    """Resolve the engine's admit-queue bound: explicit, auto
+    (8 x max_slots when 0), or unlimited (-1)."""
+    bound = getattr(config, "max_queued_requests", 0)
+    if bound == 0:
+        return 8 * config.max_slots
+    return bound
+
+
+def _check_admission(engine, deadline_ts) -> None:
+    """Shared submit-time gate for both engines: bound the queue (typed
+    BackPressureError on overflow) and fail already-expired deadlines
+    fast instead of queueing work nobody will wait for."""
+    from ...core.exceptions import BackPressureError, RequestTimeoutError
+
+    bound = _queue_bound(engine.config)
+    if bound >= 0 and engine._queue.qsize() >= bound:
+        engine.metrics["shed"] = engine.metrics.get("shed", 0.0) + 1
+        raise BackPressureError(
+            f"engine admit queue is full ({bound} waiting requests)"
+        )
+    if deadline_ts is not None and time.time() >= deadline_ts:
+        engine.metrics["timeouts"] = engine.metrics.get("timeouts", 0.0) + 1
+        raise RequestTimeoutError("request deadline expired before submit")
+
+
+def _timeout_request(request: "_Request") -> None:
+    """Fail a request on deadline expiry: the stream raises a typed
+    RequestTimeoutError and the request span closes as TIMEOUT."""
+    from ...core.exceptions import RequestTimeoutError
+
+    _finish_request_span(request, status="TIMEOUT")
+    request.span = None  # _finish must not double-close the span
+    request.out.put(RequestTimeoutError(
+        f"request {request.rid} cancelled: deadline exceeded after "
+        f"{request.generated} generated token(s)"
+    ))
 
 
 def _normalize_stop_sequences(stop_sequences) -> tuple:
@@ -227,6 +273,8 @@ class LLMEngine:
             "decode_steps": 0.0,
             "prefills": 0.0,
             "ongoing": 0.0,
+            "shed": 0.0,
+            "timeouts": 0.0,
         }
         self._thread = threading.Thread(target=self._loop, daemon=True, name="llm-engine")
         self._thread.start()
@@ -243,6 +291,7 @@ class LLMEngine:
         stop_sequences: Optional[List[List[int]]] = None,
         top_k: int = 0,
         top_p: float = 1.0,
+        deadline_ts: Optional[float] = None,
     ) -> ResponseStream:
         if len(prompt_tokens) + max_tokens > self.max_seq:
             raise ValueError(
@@ -254,6 +303,7 @@ class LLMEngine:
                 "top_k/top_p sampling lives in PagedLLMEngine (the dense "
                 "engine samples temperature-only); use PagedEngineConfig"
             )
+        _check_admission(self, deadline_ts)
         request = _Request(
             rid=next(self._rid),
             prompt=list(prompt_tokens),
@@ -262,6 +312,7 @@ class LLMEngine:
             out=queue.Queue(),
             stop_token_ids=tuple(stop_token_ids or ()),
             stop_sequences=_normalize_stop_sequences(stop_sequences),
+            deadline_ts=deadline_ts,
         )
         _start_request_span(request, "dense")
         self._queue.put(request)
@@ -291,10 +342,23 @@ class LLMEngine:
         for slot_idx, slot in enumerate(self.slots):
             if not slot.free:
                 continue
-            try:
-                request = self._queue.get_nowait()
-            except queue.Empty:
-                return
+            while True:
+                try:
+                    request = self._queue.get_nowait()
+                except queue.Empty:
+                    return
+                if (
+                    request.deadline_ts is not None
+                    and time.time() >= request.deadline_ts
+                ):
+                    # expired while queued: fail fast, never prefill
+                    self.metrics["timeouts"] = (
+                        self.metrics.get("timeouts", 0.0) + 1
+                    )
+                    _timeout_request(request)
+                    request.out.put(None)
+                    continue
+                break
             self._do_prefill(slot_idx, slot, request)
 
     def _do_prefill(self, slot_idx: int, slot: _Slot, request: _Request) -> None:
@@ -353,6 +417,19 @@ class LLMEngine:
         slot.request = None
         slot.remaining = 0
 
+    def _deadline_sweep(self) -> None:
+        """Cancel slots whose request outlived its deadline — the lane
+        frees for queued work instead of generating into the void."""
+        now = time.time()
+        for slot in self.slots:
+            request = slot.request
+            if request is None or request.deadline_ts is None:
+                continue
+            if now >= request.deadline_ts:
+                self.metrics["timeouts"] = self.metrics.get("timeouts", 0.0) + 1
+                _timeout_request(request)
+                self._finish(slot)
+
     def _decode_round(self) -> None:
         tokens = np.zeros(len(self.slots), dtype=np.int32)
         positions = np.zeros(len(self.slots), dtype=np.int32)
@@ -394,6 +471,7 @@ class LLMEngine:
         try:
             while not self._stop.is_set():
                 self._admit()
+                self._deadline_sweep()
                 n_active = sum(1 for s in self.slots if not s.free)
                 self.metrics["ongoing"] = float(n_active) + self._queue.qsize()
                 if n_active == 0:

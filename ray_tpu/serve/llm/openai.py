@@ -25,8 +25,31 @@ import uuid
 from http.server import BaseHTTPRequestHandler
 from typing import Any, Dict, List, Optional
 
+from ...core.exceptions import (
+    BackPressureError,
+    DeploymentUnavailableError,
+    GetTimeoutError,
+    ReplicaDrainingError,
+    RequestTimeoutError,
+    unwrap_error,
+)
 from .. import api as serve_api
 from ..api import EgresslessHTTPServer, write_chunk
+
+
+def _http_status_for(err: BaseException):
+    """(status, error-type, retry_after | None) for a serve-layer typed
+    error, or None when `err` is not an overload/availability/deadline
+    condition. BackPressure → 429 (client should back off and retry),
+    unavailability/draining → 503, deadline expiry → 504."""
+    cause = unwrap_error(err)
+    if isinstance(cause, BackPressureError):
+        return 429, "overloaded_error", 1
+    if isinstance(cause, (DeploymentUnavailableError, ReplicaDrainingError)):
+        return 503, "service_unavailable_error", 1
+    if isinstance(cause, (RequestTimeoutError, GetTimeoutError)):
+        return 504, "timeout_error", None
+    return None
 
 
 class ByteTokenizer:
@@ -77,11 +100,19 @@ class OpenAIFrontend:
                 self.end_headers()
                 self.wfile.write(body)
 
-            def _error(self, code: int, message: str, etype: str) -> None:
-                self._json(code, {"error": {
+            def _error(self, code: int, message: str, etype: str,
+                       retry_after: Optional[int] = None) -> None:
+                body = json.dumps({"error": {
                     "message": message, "type": etype, "param": None,
                     "code": None,
-                }})
+                }}).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                if retry_after is not None:
+                    self.send_header("Retry-After", str(retry_after))
+                self.end_headers()
+                self.wfile.write(body)
 
             def do_GET(self):  # noqa: N802 - /v1/models
                 if self.path.rstrip("/") == "/v1/models":
@@ -120,7 +151,19 @@ class OpenAIFrontend:
                 except ValueError as e:
                     self._error(400, str(e), "invalid_request_error")
                 except Exception as e:  # noqa: BLE001 - schema'd 500
-                    self._error(500, repr(e), "internal_error")
+                    mapped = _http_status_for(e)
+                    cause = unwrap_error(e)
+                    if mapped is not None:
+                        code, etype, retry_after = mapped
+                        self._error(code, str(cause), etype,
+                                    retry_after=retry_after)
+                    elif isinstance(cause, ValueError):
+                        # replica-side validation (e.g. max_tokens over the
+                        # engine budget) crosses the actor boundary wrapped
+                        # in TaskError: still the client's 400, not a 500
+                        self._error(400, str(cause), "invalid_request_error")
+                    else:
+                        self._error(500, repr(e), "internal_error")
 
         self._server = EgresslessHTTPServer((host, port), Handler)
         self.port = self._server.server_address[1]
@@ -207,6 +250,11 @@ class OpenAIFrontend:
 
         model_id = req.get("model") or next(iter(self.models))
         handle = self._handle_for(model_id)
+        # `timeout_s` (our extension to the OpenAI schema) sets the
+        # request's end-to-end deadline; cfg.serve_default_timeout_s
+        # applies when absent. Expiry surfaces as HTTP 504.
+        if "timeout_s" in req:
+            handle = handle.options(timeout_s=float(req["timeout_s"]))
         payload = self._to_payload(req, chat)
         rid = ("chatcmpl-" if chat else "cmpl-") + uuid.uuid4().hex[:24]
         created = int(time.time())
@@ -341,8 +389,10 @@ class OpenAIFrontend:
                         final["logprobs"] = None
                     send(chunk_body(final, usage=item.get("usage")))
         except Exception as e:  # noqa: BLE001 - surfaces as an SSE error event
-            send(json.dumps({"error": {"message": repr(e),
-                                       "type": "internal_error"}}))
+            mapped = _http_status_for(e)
+            etype = mapped[1] if mapped is not None else "internal_error"
+            send(json.dumps({"error": {"message": repr(unwrap_error(e)),
+                                       "type": etype}}))
         send("[DONE]")
         http.wfile.write(b"0\r\n\r\n")
 

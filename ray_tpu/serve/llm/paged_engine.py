@@ -45,12 +45,14 @@ from ...models.transformer import TransformerConfig
 from .engine import (
     ResponseStream,
     _Request,
+    _check_admission,
     _fail_all_requests,
     _finish_request_span,
     _hit_stop_sequence,
     _normalize_stop_sequences,
     _reject_if_dead,
     _start_request_span,
+    _timeout_request,
 )
 from .paged import (
     PagedConfig,
@@ -67,6 +69,10 @@ class PagedEngineConfig:
     eos_id: int = -1
     decode_block_steps: int = 16  # K: fused decode+sample steps per dispatch
     max_inflight_blocks: int = 8  # device blocks outstanding before gating
+    # admission bound on the submit queue: overflow raises a typed
+    # BackPressureError instead of queueing unboundedly. 0 = auto
+    # (8 x max_slots); negative disables the bound.
+    max_queued_requests: int = 0
     # Compile every prefill bucket + both decode variants at construction
     # (vLLM pre-captures its batch-size graphs the same way). Off by
     # default: tests build many engines; serving/bench wants it on so the
@@ -362,6 +368,8 @@ class PagedLLMEngine:
             "ongoing": 0.0,
             "page_stalls": 0.0,
             "pages_in_use": 0.0,
+            "shed": 0.0,
+            "timeouts": 0.0,
         }
         if self.config.precompile:
             self._precompile()
@@ -428,6 +436,7 @@ class PagedLLMEngine:
         top_p: float = 1.0,
         stop_token_ids: Optional[List[int]] = None,
         stop_sequences: Optional[List[List[int]]] = None,
+        deadline_ts: Optional[float] = None,
     ) -> ResponseStream:
         limit = self.paged.max_slot_tokens
         if len(prompt_tokens) + max_tokens > limit:
@@ -439,6 +448,7 @@ class PagedLLMEngine:
             raise ValueError("empty prompt")
         if not 0.0 < top_p <= 1.0:
             raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+        _check_admission(self, deadline_ts)
         request = _Request(
             rid=next(self._rid),
             prompt=list(prompt_tokens),
@@ -449,6 +459,7 @@ class PagedLLMEngine:
             top_p=float(top_p),
             stop_token_ids=tuple(stop_token_ids or ()),
             stop_sequences=_normalize_stop_sequences(stop_sequences),
+            deadline_ts=deadline_ts,
         )
         _start_request_span(request, "paged")
         self._queue.put(request)
@@ -481,11 +492,25 @@ class PagedLLMEngine:
             if pages is None:
                 self.metrics["page_stalls"] += 1
                 return
-            try:
-                request = self._queue.get_nowait()
-            except queue.Empty:
-                self.allocator.free(pages)
-                return
+            request = None
+            while request is None:
+                try:
+                    candidate = self._queue.get_nowait()
+                except queue.Empty:
+                    self.allocator.free(pages)
+                    return
+                if (
+                    candidate.deadline_ts is not None
+                    and time.time() >= candidate.deadline_ts
+                ):
+                    # expired while queued: fail fast, never take a slot
+                    self.metrics["timeouts"] = (
+                        self.metrics.get("timeouts", 0.0) + 1
+                    )
+                    _timeout_request(candidate)
+                    candidate.out.put(None)
+                    continue
+                request = candidate
             slot.request = request
             slot.pages = pages
             slot.position = 0
@@ -836,6 +861,26 @@ class PagedLLMEngine:
 
     # ------------------------------------------------------------------ loop
 
+    def _deadline_sweep(self) -> None:
+        """Evict slots whose request outlived its deadline: the stream
+        fails with a typed RequestTimeoutError and the slot's pages
+        return to the pool (late in-flight blocks for the evicted lane
+        are benign — same guarantee as EOS retirement, module header)."""
+        now = time.time()
+        for idx, slot in enumerate(self.slots):
+            request = slot.request
+            if (
+                request is None
+                or slot.finished_emit
+                or request.deadline_ts is None
+                or now < request.deadline_ts
+            ):
+                continue
+            self.metrics["timeouts"] = self.metrics.get("timeouts", 0.0) + 1
+            _timeout_request(request)
+            slot.finished_emit = True
+            self._maybe_retire(idx, request)
+
     def _all_stalled_deadlock(self) -> Optional[int]:
         """Every occupied slot waits on an empty pool and nothing is in
         flight: truncate the largest page-holder rather than deadlock."""
@@ -860,6 +905,7 @@ class PagedLLMEngine:
         pc = self.paged
         while not self._stop.is_set():
             self._admit()
+            self._deadline_sweep()
             progressed = self._prefill_tick()
             # Prefer draining the prefill backlog before launching a decode
             # block: chunks are sub-millisecond, and grouping admissions

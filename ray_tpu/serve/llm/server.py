@@ -59,9 +59,14 @@ class LLMServer:
 
     def _submit(self, payload: Dict[str, Any]):
         """One place parses the OpenAI-ish payload for both entry points
-        (sampling params flow to the paged engine)."""
+        (sampling params flow to the paged engine). The serve request's
+        ambient deadline (router timeout_s → replica context) rides into
+        the engine so an expired request is cancelled/evicted instead of
+        generating into the void."""
+        from ..context import get_request_deadline
+
         prompt = payload["prompt_tokens"]
-        kwargs = {}
+        kwargs = {"deadline_ts": get_request_deadline()}
         for name, cast in (("top_k", int), ("top_p", float),
                            ("stop_token_ids", list),
                            ("stop_sequences", list)):

@@ -1,17 +1,52 @@
-"""Handle-side routing: power-of-two-choices over replicas.
+"""Handle-side routing: power-of-two-choices over replicas, with the
+serve resilience layer — end-to-end deadlines, bounded retry/failover,
+and admission control with load shedding.
 
 Reference parity: serve/_private/router.py:340 AsyncioRouter +
 replica_scheduler/pow_2_scheduler.py:52 PowerOfTwoChoicesReplicaScheduler —
-sample two replicas, pick the one with the smaller ongoing-request count.
+sample two replicas, pick the one with the smaller ongoing-request count —
+plus the router-side pieces of Serve's fault tolerance: retries re-pick a
+*different* live replica on replica-death-class errors, `max_queued_requests`
+sheds with a typed BackPressureError, and requests carry an absolute
+deadline that fails fast once expired (handle.options(timeout_s=...)).
 """
 
 from __future__ import annotations
 
+import logging
 import random
 import threading
-from typing import Any, Dict, List, Optional
+import time
+from typing import Any, Dict, List, Optional, Set
 
 from .. import api
+from ..core.chaos import ChaosInjectedError
+from ..core.exceptions import (
+    ActorDiedError,
+    ActorUnavailableError,
+    BackPressureError,
+    DeploymentUnavailableError,
+    GetTimeoutError,
+    ReplicaDrainingError,
+    RequestTimeoutError,
+    unwrap_error,
+)
+from ..core.rpc import RpcError
+from ..core.streaming import ObjectRefGenerator
+
+logger = logging.getLogger(__name__)
+
+# Errors that indicate the REPLICA or transport failed (not the request):
+# safe to fail over to a different replica. A user-code exception is not
+# retryable — re-running it elsewhere would just fail the same way.
+_RETRYABLE = (
+    ActorDiedError,
+    ActorUnavailableError,
+    ReplicaDrainingError,
+    RpcError,
+    ConnectionError,
+    ChaosInjectedError,
+)
 
 
 def _rkey(replica: Any) -> str:
@@ -21,24 +56,62 @@ def _rkey(replica: Any) -> str:
     return replica._actor_id.hex()
 
 
-class ReplicaSet:
-    """Live replica handles + ongoing counts, shared router/controller."""
+def _counter(name: str, doc: str):
+    from ..util.metrics import get_or_create_counter
 
-    def __init__(self, name: str):
+    return get_or_create_counter(name, doc)
+
+
+def _retryable(err: BaseException) -> bool:
+    return isinstance(unwrap_error(err), _RETRYABLE)
+
+
+def _retry_backoff_s(attempt: int) -> float:
+    """Jittered exponential backoff before failover attempt N (1-based)."""
+    from ..core.config import cfg
+
+    base = float(cfg.serve_retry_backoff_s)
+    return min(2.0, base * (2 ** max(0, attempt - 1))) * (0.5 + random.random())
+
+
+class ReplicaSet:
+    """Live replica handles + ongoing counts, shared router/controller.
+
+    Also owns the deployment's admission bound: when `max_queued` >= 0,
+    requests beyond (routable replicas x max_ongoing) + max_queued are
+    shed at pick time with BackPressureError. DRAINING replicas stay
+    known (their ongoing counts must drain to zero before the controller
+    reaps them) but are never picked."""
+
+    def __init__(self, name: str, *, max_ongoing: int = 8,
+                 max_queued: int = -1):
         self.name = name
         self._lock = threading.Lock()
         self._replicas: List[Any] = []  # ActorHandles
         self._ongoing: Dict[str, int] = {}  # actor-id hex -> count
+        self._draining: Set[str] = set()
+        self.max_ongoing = max_ongoing
+        self.max_queued = max_queued  # -1 = unlimited
         # model-multiplex affinity: model_id -> MRU list of replica keys
         # (reference pow_2_scheduler.py is multiplex-aware the same way)
         self._affinity: Dict[str, List[str]] = {}
 
     _key = staticmethod(_rkey)
 
+    def configure(self, *, max_ongoing: Optional[int] = None,
+                  max_queued: Optional[int] = None) -> None:
+        with self._lock:
+            if max_ongoing is not None:
+                self.max_ongoing = int(max_ongoing)
+            if max_queued is not None:
+                self.max_queued = int(max_queued)
+
     def set_replicas(self, replicas: List[Any]) -> None:
         with self._lock:
             self._replicas = list(replicas)
-            live = {self._key(r) for r in replicas}
+            # draining replicas keep their ongoing entries: the controller
+            # watches them hit zero before killing the actor
+            live = {self._key(r) for r in replicas} | self._draining
             self._ongoing = {k: v for k, v in self._ongoing.items() if k in live}
             for r in replicas:
                 self._ongoing.setdefault(self._key(r), 0)
@@ -54,25 +127,80 @@ class ReplicaSet:
         with self._lock:
             return list(self._replicas)
 
-    def pick(self, model_id: Optional[str] = None) -> Any:
-        """Pow-2 choice by ongoing count; with a multiplexed model id,
-        prefer a replica that already holds the model (affinity)."""
+    # ------------------------------------------------------------- draining
+
+    def mark_draining(self, key: str) -> None:
         with self._lock:
-            if not self._replicas:
-                raise RuntimeError(f"deployment {self.name!r} has no replicas")
+            self._draining.add(key)
+            self._ongoing.setdefault(key, 0)
+            self._replicas = [r for r in self._replicas if self._key(r) != key]
+
+    def finish_draining(self, key: str) -> None:
+        with self._lock:
+            self._draining.discard(key)
+            self._ongoing.pop(key, None)
+
+    def draining_keys(self) -> Set[str]:
+        with self._lock:
+            return set(self._draining)
+
+    def ongoing_for(self, key: str) -> int:
+        with self._lock:
+            return self._ongoing.get(key, 0)
+
+    # ----------------------------------------------------------------- pick
+
+    def pick(self, model_id: Optional[str] = None, *,
+             exclude: Optional[Set[str]] = None,
+             admission: bool = True) -> Any:
+        """Pow-2 choice by ongoing count; with a multiplexed model id,
+        prefer a replica that already holds the model (affinity).
+
+        exclude: replica keys a failover retry must avoid (the attempt
+        that just failed there); relaxed when nothing else is alive.
+        admission=False skips the queue bound (retries already held and
+        released a slot — shedding them would double-count)."""
+        with self._lock:
+            routable = [
+                r for r in self._replicas
+                if self._key(r) not in self._draining
+            ]
+            if not routable:
+                raise DeploymentUnavailableError(
+                    f"deployment {self.name!r} has no routable replicas "
+                    f"({len(self._draining)} draining)"
+                )
+            if admission and self.max_queued >= 0:
+                ongoing = sum(
+                    self._ongoing.get(self._key(r), 0) for r in routable
+                )
+                capacity = len(routable) * max(1, self.max_ongoing)
+                if ongoing - capacity >= self.max_queued:
+                    raise BackPressureError(
+                        f"deployment {self.name!r} is overloaded: "
+                        f"{ongoing} ongoing over {capacity} capacity "
+                        f"(max_queued_requests={self.max_queued})"
+                    )
+            cands = routable
+            if exclude:
+                preferred = [r for r in routable if self._key(r) not in exclude]
+                if preferred:
+                    cands = preferred
             chosen = None
             if model_id:
-                cands = [
-                    r for r in self._replicas
+                affine = [
+                    r for r in cands
                     if self._key(r) in self._affinity.get(model_id, ())
                 ]
-                if cands:
-                    chosen = min(cands, key=lambda r: self._ongoing[self._key(r)])
+                if affine:
+                    chosen = min(
+                        affine, key=lambda r: self._ongoing[self._key(r)]
+                    )
             if chosen is None:
-                if len(self._replicas) == 1:
-                    chosen = self._replicas[0]
+                if len(cands) == 1:
+                    chosen = cands[0]
                 else:
-                    a, b = random.sample(self._replicas, 2)
+                    a, b = random.sample(cands, 2)
                     chosen = (
                         a
                         if self._ongoing[self._key(a)] <= self._ongoing[self._key(b)]
@@ -89,10 +217,12 @@ class ReplicaSet:
             return chosen
 
     def release(self, replica: Any) -> None:
+        self.release_key(self._key(replica))
+
+    def release_key(self, key: str) -> None:
         with self._lock:
-            k = self._key(replica)
-            if self._ongoing.get(k, 0) > 0:
-                self._ongoing[k] -= 1
+            if self._ongoing.get(key, 0) > 0:
+                self._ongoing[key] -= 1
 
     def total_ongoing(self) -> int:
         with self._lock:
@@ -107,32 +237,45 @@ class DeploymentHandle:
     """What users call: handle.method.remote(args) → ObjectRef (reference
     serve/handle.py DeploymentHandle). options(stream=True) streams a
     generator method's yields; options(multiplexed_model_id=...) routes
-    with model affinity and exposes the id via
-    serve.get_multiplexed_model_id() inside the replica."""
+    with model affinity; options(timeout_s=...) sets the request's
+    end-to-end deadline (expired → typed RequestTimeoutError);
+    options(max_retries=...) bounds router failover attempts."""
 
     def __init__(self, replica_set: ReplicaSet, *, stream: bool = False,
-                 multiplexed_model_id: Optional[str] = None):
+                 multiplexed_model_id: Optional[str] = None,
+                 timeout_s: Optional[float] = None,
+                 max_retries: Optional[int] = None):
         self._set = replica_set
         self._stream = stream
         self._model_id = multiplexed_model_id
+        self._timeout_s = timeout_s
+        self._max_retries = max_retries
 
     def options(self, *, stream: Optional[bool] = None,
-                multiplexed_model_id: Optional[str] = None) -> "DeploymentHandle":
+                multiplexed_model_id: Optional[str] = None,
+                timeout_s: Optional[float] = None,
+                max_retries: Optional[int] = None) -> "DeploymentHandle":
         return DeploymentHandle(
             self._set,
             stream=self._stream if stream is None else stream,
             multiplexed_model_id=multiplexed_model_id or self._model_id,
+            timeout_s=self._timeout_s if timeout_s is None else timeout_s,
+            max_retries=(
+                self._max_retries if max_retries is None else max_retries
+            ),
         )
 
     def __getattr__(self, method: str) -> "_MethodCaller":
         if method.startswith("_"):
             raise AttributeError(method)
-        return _MethodCaller(self._set, method, self._stream, self._model_id)
+        return _MethodCaller(self._set, method, self._stream, self._model_id,
+                             self._timeout_s, self._max_retries)
 
     def remote(self, *args, **kwargs):
         """Callable deployments: handle.remote(x) → instance.__call__(x)."""
         return _MethodCaller(
-            self._set, "__call__", self._stream, self._model_id
+            self._set, "__call__", self._stream, self._model_id,
+            self._timeout_s, self._max_retries,
         ).remote(*args, **kwargs)
 
     @property
@@ -140,17 +283,71 @@ class DeploymentHandle:
         return self._set.name
 
 
+def _mint_promise():
+    """A router-owned future: the reaper seals the winning attempt's
+    result (or the typed failure) into it, so the ref handed to the
+    caller survives replica failover."""
+    from ..core.ids import ObjectID
+    from ..core.runtime import ObjectRef, get_runtime
+
+    rt = get_runtime()
+    oid = ObjectID.for_put(rt.job_id)
+    rt.object_store.create(oid)
+    return ObjectRef(oid, rt), oid, rt
+
+
+class _FailoverStream(ObjectRefGenerator):
+    """Router-owned stream that survives replica failover: the feeder
+    thread copies item refs from successive attempt streams into it,
+    skipping the prefix already delivered to the consumer."""
+
+    def __init__(self, first_attempt: ObjectRefGenerator):
+        super().__init__(first_attempt._task_id, first_attempt._runtime)
+
+    def _append_ref(self, ref: Any) -> None:
+        with self._cond:
+            self._refs.append(ref)
+            self._cond.notify_all()
+
+
 class _MethodCaller:
     def __init__(self, replica_set: ReplicaSet, method: str,
-                 stream: bool = False, model_id: Optional[str] = None):
+                 stream: bool = False, model_id: Optional[str] = None,
+                 timeout_s: Optional[float] = None,
+                 max_retries: Optional[int] = None):
         self._set = replica_set
         self._method = method
         self._stream = stream
         self._model_id = model_id
+        self._timeout_s = timeout_s
+        self._max_retries = max_retries
+
+    def _resolve_policy(self):
+        """(deadline_ts | None, max_attempts >= 1) for this call.
+
+        The deadline is the MIN of the handle's timeout_s (default:
+        cfg.serve_default_timeout_s; 0 disables) and the ambient request
+        deadline when this call happens inside another serve request
+        (composition hop) — a downstream hop never outlives its parent."""
+        from ..core.config import cfg
+        from . import context as serve_ctx
+
+        timeout_s = self._timeout_s
+        if timeout_s is None:
+            timeout_s = float(cfg.serve_default_timeout_s)
+        deadline = time.time() + timeout_s if timeout_s > 0 else None
+        ambient = serve_ctx.get_request_deadline()
+        if ambient is not None:
+            deadline = ambient if deadline is None else min(deadline, ambient)
+        attempts = self._max_retries
+        if attempts is None:
+            attempts = int(cfg.serve_retry_max_attempts)
+        return deadline, max(1, attempts)
 
     def remote(self, *args, **kwargs):
         from ..util import tracing
 
+        deadline, max_attempts = self._resolve_policy()
         # serve.route roots the request's trace (or nests, when called
         # from a traced region): replica pick + submission. The replica's
         # actor.call/actor.execute spans — and the engine's request span
@@ -159,10 +356,31 @@ class _MethodCaller:
             "serve.route", deployment=self._set.name, method=self._method,
             model_id=self._model_id or "",
         ) as route_span:
-            replica = self._set.pick(self._model_id)
+            if deadline is not None:
+                route_span.set_attribute("deadline_ts", deadline)
+                if time.time() >= deadline:
+                    _counter(
+                        "raytpu_serve_timeouts_total",
+                        "serve requests failed on an expired deadline",
+                    ).inc()
+                    raise RequestTimeoutError(
+                        f"request to {self._set.name!r}.{self._method} "
+                        f"expired before routing"
+                    )
+            try:
+                replica = self._set.pick(self._model_id)
+            except BackPressureError:
+                _counter(
+                    "raytpu_serve_shed_total",
+                    "serve requests shed by admission control",
+                ).inc()
+                route_span.set_attribute("shed", True)
+                raise
             route_span.set_attribute("replica", _rkey(replica)[:12])
             if self._model_id:
                 kwargs["_multiplexed_model_id"] = self._model_id
+            if deadline is not None:
+                kwargs["_deadline_ts"] = deadline
             try:
                 # replicas are _ReplicaWrapper actors: dispatch by method name
                 call = replica.call
@@ -172,22 +390,175 @@ class _MethodCaller:
             except BaseException:
                 self._set.release(replica)
                 raise
-        _Reaper.instance().track(ref, self._set, replica)
-        return ref
+        resilient = max_attempts > 1 or deadline is not None
+        if self._stream:
+            if not resilient:
+                _Reaper.instance().track(ref, self._set, replica)
+                return ref
+            proxy = _FailoverStream(ref)
+            feeder = threading.Thread(
+                target=_stream_failover_loop,
+                args=(proxy, self._set, self._model_id, self._method,
+                      args, kwargs, replica, ref, deadline, max_attempts),
+                daemon=True,
+                name=f"serve-stream-{self._set.name}",
+            )
+            feeder.start()
+            return proxy
+        if not resilient:
+            _Reaper.instance().track(ref, self._set, replica)
+            return ref
+        promise_ref, promise_oid, rt = _mint_promise()
+        _Reaper.instance().track_failover(
+            ref, self._set, replica, promise_oid, rt,
+            method=self._method, args=args, kwargs=kwargs,
+            model_id=self._model_id, deadline=deadline,
+            max_attempts=max_attempts,
+        )
+        return promise_ref
+
+
+def _stream_failover_loop(proxy: _FailoverStream, rset: ReplicaSet,
+                          model_id: Optional[str], method: str,
+                          args, kwargs, replica, stream,
+                          deadline: Optional[float],
+                          max_attempts: int) -> None:
+    """Feeder thread for resilient streaming calls: copies item refs from
+    the live attempt into the proxy; on a retryable mid-stream failure it
+    re-picks a different replica, replays the generator, and skips the
+    prefix the consumer already saw. Deadline expiry fails the stream
+    with RequestTimeoutError (the engine cancels its slot on its own)."""
+    delivered = 0
+    attempts = 1
+    skip = 0
+    key = _rkey(replica)
+    while True:
+        try:
+            while True:
+                if proxy._abandoned:
+                    rset.release_key(key)
+                    return
+                timeout = None
+                if deadline is not None:
+                    timeout = deadline - time.time()
+                    if timeout <= 0:
+                        raise GetTimeoutError("stream deadline expired")
+                try:
+                    ref = stream.next_ready(timeout=timeout)
+                except StopIteration:
+                    rset.release_key(key)
+                    proxy._finish()
+                    return
+                if skip > 0:
+                    skip -= 1  # replayed prefix: consumer already has it
+                    continue
+                proxy._append_ref(ref)
+                delivered += 1
+        except BaseException as err:  # noqa: BLE001 - classified below
+            rset.release_key(key)
+            cause = unwrap_error(err)
+            if isinstance(cause, (GetTimeoutError, RequestTimeoutError)):
+                _counter(
+                    "raytpu_serve_timeouts_total",
+                    "serve requests failed on an expired deadline",
+                ).inc()
+                proxy._finish(RequestTimeoutError(
+                    f"stream from {rset.name!r}.{method} exceeded its "
+                    f"deadline after {delivered} items"
+                ))
+                return
+            if attempts >= max_attempts or not isinstance(cause, _RETRYABLE):
+                proxy._finish(err)
+                return
+            wait = _retry_backoff_s(attempts)
+            if deadline is not None and time.time() + wait >= deadline:
+                _counter(
+                    "raytpu_serve_timeouts_total",
+                    "serve requests failed on an expired deadline",
+                ).inc()
+                proxy._finish(RequestTimeoutError(
+                    f"stream from {rset.name!r}.{method}: no retry budget "
+                    f"left before the deadline"
+                ))
+                return
+            time.sleep(wait)
+            try:
+                replica = rset.pick(model_id, exclude={key}, admission=False)
+            except BaseException:
+                proxy._finish(err)
+                return
+            key = _rkey(replica)
+            attempts += 1
+            _counter(
+                "raytpu_serve_failovers_total",
+                "serve requests failed over to a different replica",
+            ).inc()
+            try:
+                stream = replica.call.options(num_returns="streaming").remote(
+                    method, *args, **kwargs
+                )
+            except BaseException as sub_err:  # noqa: BLE001
+                rset.release_key(key)
+                proxy._finish(sub_err)
+                return
+            skip = delivered
+
+
+class _TrackedCall:
+    """One router-tracked request: either a plain ref (release-on-done)
+    or a failover call with a promise the reaper must eventually seal."""
+
+    __slots__ = (
+        "ref", "rset", "key", "promise_oid", "runtime", "method", "args",
+        "kwargs", "model_id", "deadline", "max_attempts", "attempts",
+        "failed_keys", "next_retry_ts", "last_error",
+    )
+
+    def __init__(self, ref, rset, key, promise_oid=None, runtime=None,
+                 method=None, args=(), kwargs=None, model_id=None,
+                 deadline=None, max_attempts=1):
+        self.ref = ref
+        self.rset = rset
+        self.key = key
+        self.promise_oid = promise_oid
+        self.runtime = runtime
+        self.method = method
+        self.args = args
+        self.kwargs = kwargs or {}
+        self.model_id = model_id
+        self.deadline = deadline
+        self.max_attempts = max_attempts
+        self.attempts = 1
+        self.failed_keys: Set[str] = set()
+        self.next_retry_ts: Optional[float] = None
+        self.last_error: Optional[BaseException] = None
 
 
 class _Reaper:
-    """Decrements ongoing counts when request refs complete — one background
-    thread over api.wait, the in-process analogue of the reference's asyncio
-    done-callbacks."""
+    """Request-lifecycle owner on the router side: one background thread
+    that (a) releases ongoing counts when request refs complete — success
+    OR error, so failed calls stop skewing least-loaded picks, (b) drives
+    failover resubmission with jittered backoff onto a different replica,
+    (c) enforces deadlines by sealing RequestTimeoutError into the
+    promise, and (d) caps its tracked list so one stuck ref can't grow it
+    unboundedly (overflow releases + fails the oldest entry and bumps
+    raytpu_serve_reaper_overflow_total)."""
 
     _inst: Optional["_Reaper"] = None
     _inst_lock = threading.Lock()
 
     def __init__(self):
+        from ..util.metrics import get_or_create_gauge
+
         self._lock = threading.Lock()
-        self._tracked: List[Any] = []  # (ref, set, replica)
+        self._tracked: List[_TrackedCall] = []
         self._event = threading.Event()
+        self._overflow_warned = False
+        get_or_create_gauge(
+            "raytpu_serve_reaper_tracked",
+            "request refs currently tracked by the serve reaper",
+            fn=lambda: float(len(self._tracked)),
+        )
         self._thread = threading.Thread(target=self._loop, daemon=True, name="serve-reaper")
         self._thread.start()
 
@@ -198,46 +569,211 @@ class _Reaper:
                 cls._inst = cls()
             return cls._inst
 
+    # ------------------------------------------------------------- tracking
+
     def track(self, ref, replica_set, replica) -> None:
+        self._track_record(
+            _TrackedCall(ref, replica_set, _rkey(replica))
+        )
+
+    def track_failover(self, ref, replica_set, replica, promise_oid, runtime,
+                       *, method, args, kwargs, model_id, deadline,
+                       max_attempts) -> None:
+        self._track_record(_TrackedCall(
+            ref, replica_set, _rkey(replica), promise_oid, runtime,
+            method=method, args=args, kwargs=kwargs, model_id=model_id,
+            deadline=deadline, max_attempts=max_attempts,
+        ))
+
+    def _track_record(self, rec: _TrackedCall) -> None:
+        from ..core.config import cfg
+
+        overflow = None
         with self._lock:
-            self._tracked.append((ref, replica_set, replica))
+            cap = int(cfg.serve_reaper_max_tracked)
+            if cap > 0 and len(self._tracked) >= cap:
+                overflow = self._tracked.pop(0)
+            self._tracked.append(rec)
         self._event.set()
+        if overflow is not None:
+            overflow.rset.release_key(overflow.key)
+            self._seal_error(overflow, RuntimeError(
+                "serve reaper overflow: request dropped to bound tracking "
+                f"(serve_reaper_max_tracked={cfg.serve_reaper_max_tracked})"
+            ))
+            _counter(
+                "raytpu_serve_reaper_overflow_total",
+                "tracked requests dropped by the reaper's size cap",
+            ).inc()
+            if not self._overflow_warned:
+                self._overflow_warned = True
+                logger.warning(
+                    "serve reaper hit its tracked-ref cap (%d); oldest "
+                    "request dropped — a replica is likely stuck",
+                    cap,
+                )
+
+    # ----------------------------------------------------------- seal paths
+
+    @staticmethod
+    def _seal(rec: _TrackedCall, value: Any) -> None:
+        if rec.promise_oid is not None:
+            try:
+                rec.runtime.object_store.seal(rec.promise_oid, value)
+            except Exception:
+                logger.exception("reaper failed to seal promise")
+
+    @staticmethod
+    def _seal_error(rec: _TrackedCall, err: BaseException) -> None:
+        if rec.promise_oid is not None:
+            try:
+                rec.runtime.object_store.seal_error(rec.promise_oid, err)
+            except Exception:
+                logger.exception("reaper failed to seal promise error")
+
+    # ----------------------------------------------------------------- loop
 
     def _loop(self) -> None:
-        from ..core.streaming import ObjectRefGenerator
-
         while True:
-            self._event.wait()
             with self._lock:
                 tracked = list(self._tracked)
-                if not tracked:
-                    self._event.clear()
-                    continue
-            # streams complete on their own flag; plain refs via api.wait
-            done_set = set()
-            refs = []
-            for ref, _, _ in tracked:
-                if isinstance(ref, ObjectRefGenerator):
-                    if ref.completed():
-                        done_set.add(id(ref))
-                else:
-                    refs.append(ref)
-            if refs:
+            if not tracked:
+                self._event.clear()
+                self._event.wait()
+                continue
+            # Block until SOME in-flight ref completes (api.wait returns
+            # on the first completion, so request latency is not gated on
+            # the poll cadence); the bounded timeout keeps deadline and
+            # backoff bookkeeping ticking and picks up newly tracked refs.
+            inflight = [
+                rec.ref for rec in tracked
+                if rec.ref is not None
+                and not isinstance(rec.ref, ObjectRefGenerator)
+            ]
+            if inflight:
                 try:
-                    done, _ = api.wait(refs, num_returns=1, timeout=0.1)
-                    done_set.update(id(r) for r in done)
-                except BaseException:
-                    pass
+                    api.wait(inflight, num_returns=1, timeout=0.02)
+                except BaseException:  # noqa: BLE001 - torn refs handled below
+                    time.sleep(0.005)
             else:
-                import time as _time
-
-                _time.sleep(0.05)  # stream polling cadence
-            if done_set:
+                self._event.wait(timeout=0.02)
+                self._event.clear()
+            with self._lock:
+                tracked = list(self._tracked)
+            done: List[_TrackedCall] = []
+            for rec in tracked:
+                try:
+                    if self._advance(rec):
+                        done.append(rec)
+                except Exception:
+                    logger.exception("serve reaper: tracking entry failed")
+                    rec.rset.release_key(rec.key)
+                    self._seal_error(rec, RuntimeError("serve reaper error"))
+                    done.append(rec)
+            if done:
+                done_ids = {id(r) for r in done}
                 with self._lock:
-                    remaining = []
-                    for ref, rset, replica in self._tracked:
-                        if id(ref) in done_set:
-                            rset.release(replica)
-                        else:
-                            remaining.append((ref, rset, replica))
-                    self._tracked = remaining
+                    self._tracked = [
+                        r for r in self._tracked if id(r) not in done_ids
+                    ]
+
+    def _advance(self, rec: _TrackedCall) -> bool:
+        """Step one tracked call; True = finished, drop it."""
+        now = time.time()
+        # deadline enforcement (promise-backed calls fail fast; plain
+        # tracked refs have no promise to seal, their caller owns timeouts)
+        if (
+            rec.promise_oid is not None
+            and rec.deadline is not None
+            and now >= rec.deadline
+        ):
+            rec.rset.release_key(rec.key)
+            _counter(
+                "raytpu_serve_timeouts_total",
+                "serve requests failed on an expired deadline",
+            ).inc()
+            self._seal_error(rec, RequestTimeoutError(
+                f"request to {rec.rset.name!r}.{rec.method} exceeded its "
+                f"deadline (attempt {rec.attempts}/{rec.max_attempts})"
+            ))
+            return True
+        if rec.next_retry_ts is not None:
+            if now < rec.next_retry_ts:
+                return False
+            return self._resubmit(rec)
+        # completion check: streams complete on their flag; refs on seal
+        if isinstance(rec.ref, ObjectRefGenerator):
+            if not rec.ref.completed():
+                return False
+            rec.rset.release_key(rec.key)
+            return True
+        try:
+            ready = rec.ref.is_ready()
+        except Exception:
+            ready = True  # a torn ref must not pin the replica forever
+        if not ready:
+            return False
+        if rec.promise_oid is None:
+            rec.rset.release_key(rec.key)
+            return True
+        try:
+            value = api.get(rec.ref, timeout=1.0)
+        except BaseException as err:  # noqa: BLE001 - classified below
+            return self._on_error(rec, err)
+        rec.rset.release_key(rec.key)
+        self._seal(rec, value)
+        return True
+
+    def _on_error(self, rec: _TrackedCall, err: BaseException) -> bool:
+        rec.rset.release_key(rec.key)
+        rec.failed_keys.add(rec.key)
+        rec.last_error = err
+        now = time.time()
+        wait = _retry_backoff_s(rec.attempts)
+        can_retry = (
+            rec.attempts < rec.max_attempts
+            and _retryable(err)
+            and (rec.deadline is None or now + wait < rec.deadline)
+        )
+        if not can_retry:
+            self._seal_error(rec, err)
+            return True
+        rec.next_retry_ts = now + wait
+        rec.ref = None
+        _counter(
+            "raytpu_serve_retries_total",
+            "serve request attempts retried after a replica failure",
+        ).inc()
+        return False
+
+    def _resubmit(self, rec: _TrackedCall) -> bool:
+        rec.next_retry_ts = None
+        try:
+            replica = rec.rset.pick(
+                rec.model_id, exclude=rec.failed_keys, admission=False
+            )
+        except BaseException as pick_err:  # noqa: BLE001
+            # nothing routable right now (controller may still be
+            # restarting replicas): burn one attempt waiting, or give up
+            rec.attempts += 1
+            now = time.time()
+            wait = _retry_backoff_s(rec.attempts)
+            if (
+                rec.attempts < rec.max_attempts
+                and (rec.deadline is None or now + wait < rec.deadline)
+            ):
+                rec.next_retry_ts = now + wait
+                return False
+            self._seal_error(rec, rec.last_error or pick_err)
+            return True
+        rec.key = _rkey(replica)
+        rec.attempts += 1
+        _counter(
+            "raytpu_serve_failovers_total",
+            "serve requests failed over to a different replica",
+        ).inc()
+        try:
+            rec.ref = replica.call.remote(rec.method, *rec.args, **rec.kwargs)
+        except BaseException as err:  # noqa: BLE001
+            return self._on_error(rec, err)
+        return False

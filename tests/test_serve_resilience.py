@@ -1,0 +1,597 @@
+"""Serve-layer overload & failure resilience drills.
+
+Chaos-driven coverage for the resilience tentpole: end-to-end deadlines
+(typed RequestTimeoutError, engine slot cancellation), router retry/
+failover onto a different live replica, admission control with load
+shedding (BackPressureError → HTTP 429 + Retry-After), graceful replica
+draining, RPC-layer chaos injection, and the 200-request capstone drill
+(replica killed mid-run + injected call failures, zero hung requests).
+"""
+
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.core import chaos
+from ray_tpu.core.chaos import ChaosInjectedError
+from ray_tpu.core.exceptions import (
+    BackPressureError,
+    ReplicaDrainingError,
+    RequestTimeoutError,
+    TaskError,
+    unwrap_error,
+)
+
+
+@pytest.fixture(autouse=True)
+def rt():
+    runtime = ray_tpu.init(num_cpus=8, detect_accelerators=False)
+    yield runtime
+    chaos.clear_chaos()
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+# shared blocking gates: replicas run in-process, so module-level Events
+# are visible to deployment instances without arg plumbing
+_GATES = {}
+
+
+def _gate(name: str) -> threading.Event:
+    return _GATES.setdefault(name, threading.Event())
+
+
+# ------------------------------------------------------------------ deadlines
+
+
+def test_deadline_fails_fast_with_typed_error():
+    @serve.deployment
+    class Sleepy:
+        def __call__(self, payload):
+            time.sleep(5.0)
+            return payload
+
+    handle = serve.run(Sleepy.options(name="sleepy").bind())
+    t0 = time.time()
+    ref = handle.options(timeout_s=0.3).remote("x")
+    with pytest.raises(RequestTimeoutError):
+        ray_tpu.get(ref, timeout=10)
+    # fail-fast: the typed error lands near the deadline, not after the
+    # replica's 5s sleep finishes
+    assert time.time() - t0 < 3.0
+
+
+def test_deadline_propagates_to_replica_context():
+    from ray_tpu.serve import context as serve_ctx
+
+    @serve.deployment
+    class Probe:
+        def __call__(self, payload):
+            return serve_ctx.get_request_deadline()
+
+    handle = serve.run(Probe.options(name="probe").bind())
+    # no deadline configured -> ambient deadline is None
+    assert ray_tpu.get(handle.remote("x"), timeout=10) is None
+    deadline = ray_tpu.get(
+        handle.options(timeout_s=30).remote("x"), timeout=10
+    )
+    assert deadline is not None and deadline - time.time() < 31
+
+
+def test_deadline_cancels_engine_slot():
+    from ray_tpu.models import get_config, init_params
+    from ray_tpu.serve.llm import EngineConfig, LLMEngine
+
+    config = get_config("gpt2-tiny")
+    params = init_params(config, jax.random.PRNGKey(0))
+    engine = LLMEngine(config, params, EngineConfig(max_slots=2))
+    try:
+        budget = engine.max_seq - 8
+        stream = engine.submit(
+            [1, 2, 3], max_tokens=budget, deadline_ts=time.time() + 0.4
+        )
+        with pytest.raises(RequestTimeoutError):
+            stream.result(timeout=30)
+        # the slot was evicted, not left generating into the void
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if all(s.free for s in engine.slots):
+                break
+            time.sleep(0.05)
+        assert all(s.free for s in engine.slots)
+        assert engine.metrics["timeouts"] >= 1
+        # an already-expired deadline fails at submit, before queueing
+        with pytest.raises(RequestTimeoutError):
+            engine.submit([1, 2, 3], max_tokens=4,
+                          deadline_ts=time.time() - 1)
+    finally:
+        engine.shutdown()
+
+
+def test_paged_engine_deadline_evicts_slot():
+    from ray_tpu.models import get_config, init_params
+    from ray_tpu.serve.llm import PagedEngineConfig, PagedLLMEngine
+
+    config = get_config("gpt2-tiny")
+    params = init_params(config, jax.random.PRNGKey(0))
+    engine = PagedLLMEngine(config, params, PagedEngineConfig(max_slots=2))
+    try:
+        budget = engine.paged.max_slot_tokens - 8
+        stream = engine.submit(
+            [1, 2, 3], max_tokens=budget, deadline_ts=time.time() + 0.4
+        )
+        with pytest.raises(RequestTimeoutError):
+            stream.result(timeout=30)
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if all(s.free for s in engine.slots):
+                break
+            time.sleep(0.05)
+        assert all(s.free for s in engine.slots)
+        assert engine.metrics["timeouts"] >= 1
+    finally:
+        engine.shutdown()
+
+
+# ----------------------------------------------------------- retry/failover
+
+
+def test_router_fails_over_when_replica_dies_mid_request():
+    @serve.deployment(num_replicas=2, max_ongoing_requests=4)
+    class Slow:
+        def __call__(self, payload):
+            time.sleep(0.3)
+            return f"ok-{payload}"
+
+    handle = serve.run(Slow.options(name="failover").bind())
+    refs = [handle.options(timeout_s=30).remote(i) for i in range(8)]
+    # kill one replica while its requests are mid-sleep: the router must
+    # re-pick the surviving replica for every failed attempt
+    from ray_tpu.serve import api as serve_api
+
+    state = serve_api._controller._states["failover"]
+    time.sleep(0.05)
+    ray_tpu.kill(state.replicas[0])
+    results = ray_tpu.get(refs, timeout=60)
+    assert results == [f"ok-{i}" for i in range(8)]
+
+
+def test_stream_fails_over_when_replica_killed_mid_stream():
+    @serve.deployment(num_replicas=2)
+    class Streamer:
+        def stream(self, payload):
+            for i in range(10):
+                time.sleep(0.05)
+                yield i
+
+    handle = serve.run(Streamer.options(name="streamer").bind())
+    from ray_tpu.serve import api as serve_api
+
+    state = serve_api._controller._states["streamer"]
+    stream = handle.options(stream=True, timeout_s=60).stream.remote("x")
+    got = []
+    it = iter(stream)
+    for _ in range(2):
+        got.append(ray_tpu.get(next(it), timeout=30))
+    # kill whichever replica is producing: the feeder must fail over to
+    # the survivor, replay the generator, and skip the delivered prefix
+    ongoing = {
+        state.replica_set._key(r): r for r in state.replicas
+    }
+    busy = [
+        r for k, r in ongoing.items()
+        if state.replica_set.ongoing_for(k) > 0
+    ]
+    assert busy, "no replica shows the in-flight stream"
+    ray_tpu.kill(busy[0])
+    for ref in it:
+        got.append(ray_tpu.get(ref, timeout=30))
+    assert got == list(range(10)), got
+
+
+def test_reaper_releases_ongoing_on_error():
+    @serve.deployment
+    class Boom:
+        def __call__(self, payload):
+            raise ValueError("user error: not retryable")
+
+    handle = serve.run(Boom.options(name="boom").bind())
+    refs = [handle.remote(i) for i in range(4)]
+    for ref in refs:
+        with pytest.raises(TaskError):
+            ray_tpu.get(ref, timeout=10)
+    state_set = serve.get_handle("boom")._set
+    deadline = time.time() + 5
+    while time.time() < deadline and state_set.total_ongoing() > 0:
+        time.sleep(0.05)
+    # errored refs must release their ongoing counts or every failure
+    # would permanently skew least-loaded picks
+    assert state_set.total_ongoing() == 0
+
+
+def test_user_errors_are_not_retried():
+    calls = {"n": 0}
+
+    @serve.deployment
+    class Once:
+        def __call__(self, payload):
+            calls["n"] += 1
+            raise ValueError("deterministic app failure")
+
+    handle = serve.run(Once.options(name="once").bind())
+    with pytest.raises(TaskError):
+        ray_tpu.get(handle.remote("x"), timeout=10)
+    time.sleep(0.3)  # any (buggy) retry would have landed by now
+    assert calls["n"] == 1
+
+
+# -------------------------------------------------------- admission control
+
+
+def test_admission_control_sheds_then_recovers():
+    gate = _gate("shed")
+    gate.clear()
+
+    @serve.deployment(num_replicas=1, max_ongoing_requests=1,
+                      max_queued_requests=1)
+    class Gated:
+        def __call__(self, payload):
+            gate.wait(timeout=30)
+            return f"done-{payload}"
+
+    handle = serve.run(Gated.options(name="gated").bind())
+    admitted = [handle.options(timeout_s=30).remote(i) for i in range(2)]
+    time.sleep(0.1)
+    # capacity (1x1) + queue (1) is full: the next request sheds
+    # synchronously with the typed error
+    with pytest.raises(BackPressureError):
+        handle.remote("overflow")
+    gate.set()
+    results = ray_tpu.get(admitted, timeout=30)
+    assert results == ["done-0", "done-1"]
+    # load drained: admission recovers
+    assert ray_tpu.get(handle.remote("again"), timeout=30) == "done-again"
+
+
+def test_http_proxy_maps_backpressure_to_429():
+    gate = _gate("http429")
+    gate.clear()
+
+    @serve.deployment(num_replicas=1, max_ongoing_requests=1,
+                      max_queued_requests=0)
+    class Busy:
+        def __call__(self, payload):
+            gate.wait(timeout=30)
+            return "ok"
+
+    serve.run(Busy.options(name="busy").bind())
+    port = serve.start_http()
+    blocked = serve.get_handle("busy").options(timeout_s=30).remote("x")
+    time.sleep(0.1)
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/busy", data=b'"y"',
+        headers={"Content-Type": "application/json"},
+    )
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(req, timeout=30)
+    assert e.value.code == 429
+    assert e.value.headers.get("Retry-After") == "1"
+    gate.set()
+    assert ray_tpu.get(blocked, timeout=30) == "ok"
+
+
+def test_openai_maps_typed_errors_to_http_status():
+    from ray_tpu.serve.llm.openai import OpenAIFrontend
+
+    state = {"n": 0}
+
+    @serve.deployment
+    class FlakyLLM:
+        def generate(self, payload):
+            state["n"] += 1
+            if state["n"] == 1:
+                raise BackPressureError("engine admit queue is full")
+            if state["n"] == 2:
+                raise RequestTimeoutError("deadline exceeded")
+            tokens = [104, 105]  # "hi"
+            return {"tokens": tokens, "usage": {
+                "prompt_tokens": 1, "completion_tokens": 2,
+                "total_tokens": 3,
+            }}
+
+    serve.run(FlakyLLM.options(name="flaky-llm").bind())
+    frontend = OpenAIFrontend({"flaky": "flaky-llm"})
+    try:
+        def post():
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{frontend.port}/v1/completions",
+                data=b'{"model": "flaky", "prompt": "x", "max_tokens": 2}',
+                headers={"Content-Type": "application/json"},
+            )
+            return urllib.request.urlopen(req, timeout=30)
+
+        # overload -> 429 with Retry-After, then deadline -> 504, then 200
+        with pytest.raises(urllib.error.HTTPError) as e:
+            post()
+        assert e.value.code == 429
+        assert e.value.headers.get("Retry-After") == "1"
+        with pytest.raises(urllib.error.HTTPError) as e:
+            post()
+        assert e.value.code == 504
+        import json as _json
+
+        body = _json.loads(post().read())
+        assert body["choices"][0]["text"] == "hi"
+    finally:
+        frontend.stop()
+
+
+def test_engine_admission_bound_sheds():
+    from ray_tpu.models import get_config, init_params
+    from ray_tpu.serve.llm import EngineConfig, LLMEngine
+
+    config = get_config("gpt2-tiny")
+    params = init_params(config, jax.random.PRNGKey(0))
+    # 1 slot, 1 queued: the third concurrent submit must shed
+    engine = LLMEngine(
+        config, params, EngineConfig(max_slots=1, max_queued_requests=1)
+    )
+    try:
+        budget = engine.max_seq - 8
+        first = engine.submit([1, 2, 3], max_tokens=budget)
+        time.sleep(0.3)  # let it take the slot
+        second = engine.submit([1, 2, 3], max_tokens=4)
+        with pytest.raises(BackPressureError):
+            engine.submit([1, 2, 3], max_tokens=4)
+        assert engine.metrics["shed"] >= 1
+    finally:
+        engine.shutdown()
+
+
+# ------------------------------------------------------------------ draining
+
+
+def test_drain_completes_inflight_before_kill():
+    gate = _gate("drain")
+    gate.clear()
+
+    @serve.deployment(num_replicas=2, max_ongoing_requests=2,
+                      drain_timeout_s=20.0)
+    class Draining:
+        def __call__(self, payload):
+            gate.wait(timeout=30)
+            return f"finished-{payload}"
+
+    handle = serve.run(Draining.options(name="drainer").bind())
+    from ray_tpu.serve import api as serve_api
+
+    state = serve_api._controller._states["drainer"]
+    # both replicas must be READY (probed healthy) — scale-down only
+    # drains ready replicas; unready ones are killed outright
+    deadline = time.time() + 15
+    while time.time() < deadline and len(state.ready_at) < 2:
+        time.sleep(0.05)
+    assert len(state.ready_at) >= 2
+    # one in-flight request on each replica (pow-2 picks the idle one)
+    refs = [handle.options(timeout_s=60).remote(i) for i in range(2)]
+    time.sleep(0.2)
+    state.target_replicas = 1  # scale down: newest replica must DRAIN
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if serve.status()["drainer"]["draining_replicas"] == 1:
+            break
+        time.sleep(0.05)
+    assert serve.status()["drainer"]["draining_replicas"] == 1
+    # in-flight work is NOT dead: release the gate, both requests finish
+    gate.set()
+    results = sorted(ray_tpu.get(refs, timeout=30))
+    assert results == ["finished-0", "finished-1"]
+    # once drained, the replica is reaped
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        st = serve.status()["drainer"]
+        if st["draining_replicas"] == 0 and st["live_replicas"] == 1:
+            break
+        time.sleep(0.1)
+    st = serve.status()["drainer"]
+    assert st["draining_replicas"] == 0 and st["live_replicas"] == 1
+
+
+def test_draining_replica_bounces_new_calls():
+    from ray_tpu.serve.controller import _ReplicaWrapper
+
+    class Echo:
+        def __call__(self, payload):
+            return payload
+
+    wrapper = _ReplicaWrapper(Echo, (), {})
+    assert wrapper.call("__call__", "x") == "x"
+    wrapper.prepare_drain()
+    with pytest.raises(ReplicaDrainingError):
+        wrapper.call("__call__", "x")
+
+
+# ----------------------------------------------------------------- rpc chaos
+
+
+def test_rpc_chaos_error_injection_is_retried():
+    from ray_tpu.core.rpc import RpcClient, RpcServer
+
+    calls = {"n": 0}
+
+    def handler():
+        calls["n"] += 1
+        return calls["n"]
+
+    server = RpcServer({"hit": handler})
+    try:
+        chaos.set_chaos(rpc_error_prob=1.0, max_injections=2, seed=1)
+        client = RpcClient(server.url, retries=4, retry_wait_s=0.01)
+        # two injected pre-send transport errors, then the real call:
+        # the handler runs exactly once (injections never reach the wire)
+        assert client.call("hit") == 1
+        assert calls["n"] == 1
+        assert chaos.num_injected() == 2
+        client.close()
+    finally:
+        chaos.clear_chaos()
+        server.stop()
+
+
+def test_rpc_chaos_connection_drop_reconnects():
+    from ray_tpu.core.rpc import RpcClient, RpcServer
+
+    server = RpcServer({"val": lambda: 7})
+    try:
+        client = RpcClient(server.url, retries=2, retry_wait_s=0.01)
+        assert client.call("val") == 7  # warm the persistent connection
+        chaos.set_chaos(rpc_drop_prob=1.0, max_injections=1, seed=2)
+        assert client.call("val") == 7  # dropped, reconnected, served
+        assert chaos.num_injected() == 1
+        client.close()
+    finally:
+        chaos.clear_chaos()
+        server.stop()
+
+
+def test_rpc_fully_sent_frame_is_not_retried():
+    """Non-idempotent safety: a server that dies AFTER receiving the
+    frame (fresh connection, zero reply bytes) must not trigger a
+    resend — the handler may have executed."""
+    import socket
+    import struct
+
+    conns = {"n": 0}
+
+    def one_shot_server(sock):
+        while True:
+            try:
+                conn, _ = sock.accept()
+            except OSError:
+                return
+            conns["n"] += 1
+            try:
+                hdr = conn.recv(8)
+                if len(hdr) == 8:
+                    (length,) = struct.Struct(">Q").unpack(hdr)
+                    got = 0
+                    while got < length:
+                        chunk = conn.recv(min(65536, length - got))
+                        if not chunk:
+                            break
+                        got += len(chunk)
+            finally:
+                conn.close()  # frame consumed, no reply: simulated death
+
+    lsock = socket.socket()
+    lsock.bind(("127.0.0.1", 0))
+    lsock.listen(8)
+    port = lsock.getsockname()[1]
+    t = threading.Thread(target=one_shot_server, args=(lsock,), daemon=True)
+    t.start()
+    try:
+        from ray_tpu.core.rpc import RpcClient, RpcError
+
+        client = RpcClient(f"127.0.0.1:{port}", retries=3, retry_wait_s=0.01,
+                           timeout=5.0)
+        with pytest.raises(RpcError, match="not retried"):
+            client.call("anything")
+        assert conns["n"] == 1, "fully-sent frame was resent"
+        client.close()
+    finally:
+        lsock.close()
+
+
+# ------------------------------------------------------------ static checker
+
+
+def test_typed_errors_static_check():
+    """Tier-1 wiring for scripts/check_typed_errors.py: the serve path
+    has no bare excepts, every core exception is exported, and the
+    checker actually catches violations."""
+    import pathlib
+    import subprocess
+    import sys as _sys
+
+    repo = pathlib.Path(__file__).resolve().parent.parent
+    script = repo / "scripts" / "check_typed_errors.py"
+    proc = subprocess.run(
+        [_sys.executable, str(script)], capture_output=True, text=True
+    )
+    assert proc.returncode == 0, proc.stderr
+    # the checker must flag a bad tree, not just pass everything
+    import importlib.util
+    import tempfile
+
+    spec = importlib.util.spec_from_file_location("cte", script)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    with tempfile.TemporaryDirectory() as tmp:
+        bad = pathlib.Path(tmp) / "serve"
+        bad.mkdir()
+        (bad / "oops.py").write_text(
+            "try:\n    x = 1\nexcept:\n    pass\n"
+        )
+        errors = mod.check_bare_except(bad)
+        assert len(errors) == 1 and "bare" in errors[0]
+
+
+# ------------------------------------------------------------ capstone drill
+
+
+def test_chaos_drill_200_requests_no_hangs():
+    """Acceptance drill: with call-failure injection armed and a replica
+    killed mid-run, a 200-request load completes with ZERO hung requests —
+    every request either succeeds (possibly after failover) or fails fast
+    with a typed timeout/backpressure error."""
+    @serve.deployment(num_replicas=3, max_ongoing_requests=8)
+    class Drill:
+        def __call__(self, payload):
+            time.sleep(0.01)
+            return payload * 2
+
+    handle = serve.run(Drill.options(name="drill").bind())
+    # wait for all replicas to be routable so the kill below leaves two
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        if serve.status()["drill"]["live_replicas"] == 3:
+            break
+        time.sleep(0.05)
+    # arm chaos on replica CALLS only (".call" spares health probes):
+    # ~15% of calls fail like real faults, bounded to 30 injections
+    chaos.set_chaos(failure_prob=0.15, max_injections=30,
+                    name_filter=".call", seed=7)
+    caller = handle.options(timeout_s=30, max_retries=6)
+    refs = [caller.remote(i) for i in range(100)]
+    # kill a replica mid-run: its in-flight requests must fail over
+    from ray_tpu.serve import api as serve_api
+
+    state = serve_api._controller._states["drill"]
+    ray_tpu.kill(state.replicas[1])
+    refs += [caller.remote(i) for i in range(100, 200)]
+    ok, typed_fail, hung = 0, 0, []
+    for i, ref in enumerate(refs):
+        try:
+            assert ray_tpu.get(ref, timeout=60) == i * 2
+            ok += 1
+        except ray_tpu.GetTimeoutError:
+            hung.append(i)
+        except Exception as e:  # noqa: BLE001 - drill classification
+            cause = unwrap_error(e)
+            assert isinstance(
+                cause, (RequestTimeoutError, BackPressureError,
+                        ChaosInjectedError)
+            ), f"request {i} failed with untyped {cause!r}"
+            typed_fail += 1
+    assert not hung, f"hung requests: {hung}"
+    assert ok >= 190, (ok, typed_fail)
+    assert chaos.num_injected() > 0, "drill never injected a fault"
+    chaos.clear_chaos()
+    # the killed replica is replaced and the deployment still serves
+    assert ray_tpu.get(handle.remote(7), timeout=30) == 14
