@@ -84,6 +84,11 @@ def _cmd_start(args) -> int:
     else:
         print(f"node {ctx.node_id.hex()[:12]} joined {args.address}, "
               f"agent at {ctx.address}", flush=True)
+    # SIGTERM = announced preemption (cloud spot/maintenance semantics):
+    # announce + drain for the warning window, then shut down gracefully.
+    from .core.health import install_preemption_signal_handler
+
+    install_preemption_signal_handler(ctx)
     try:
         while not ctx.shutdown_requested.wait(0.5):
             pass

@@ -230,8 +230,11 @@ class ActorRuntime:
                     self.death_cause = f"affinity node {strategy.node_id} not found"
                     return False
             else:
+                # draining (PREEMPTING) nodes take no new actors — a
+                # restartless actor placed there would die with the host
                 nodes = sorted(
-                    (n for n in self._scheduler.nodes() if not n.is_remote),
+                    (n for n in self._scheduler.nodes()
+                     if not n.is_remote and n.placeable()),
                     key=lambda n: n.utilization(),
                 )
                 feasible = [n for n in nodes if n.resources.can_ever_fit(self.resources)]
@@ -392,7 +395,9 @@ class ActorRuntime:
                 # to perturb replica calls like real faults
                 from . import chaos
 
-                chaos.maybe_inject(f"actor:{self.name}.{call.method_name}")
+                chaos.maybe_inject(
+                    f"actor:{self.name}.{call.method_name}", node=self._node
+                )
                 args = tuple(
                     a.resolve() if getattr(a, "__ray_tpu_lazy__", False) else a
                     for a in call.args

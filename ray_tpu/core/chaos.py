@@ -16,6 +16,15 @@ a host loss. Set it through the env on a worker agent and dispatch a
 task matching `name_filter` there — the node-death recovery paths
 (heartbeat staleness, task failover, actor restart, placement-group
 rescheduling) then run against a real process kill instead of a mock.
+
+`preempt_node=1` models ANNOUNCED node loss — the dominant failure mode
+on spot/preemptible TPU fleets: a matching task's node first enters a
+PREEMPTING state with a `preempt_warning_s` warning window (published
+through the GCS pubsub so schedulers stop placing there and training
+controllers can take an emergency checkpoint), and only after the window
+does the node actually die. The mechanics live with whoever registered
+the preemption hook (core/runtime.py for in-process logical nodes,
+core/cluster.py for a whole node agent); chaos only pulls the trigger.
 """
 
 from __future__ import annotations
@@ -47,6 +56,11 @@ class ChaosConfig:
     rpc_error_prob: float = 0.0
     rpc_delay_s: float = 0.0
     rpc_drop_prob: float = 0.0
+    # announced preemption: a matching task's node drains for
+    # preempt_warning_s (pubsub-announced), THEN dies — instead of the
+    # abrupt kill_node death
+    preempt_node: bool = False
+    preempt_warning_s: float = 5.0
 
 
 class _ChaosState:
@@ -55,6 +69,10 @@ class _ChaosState:
         self.injected = 0
         self.rng = np.random.default_rng(0)
         self.lock = threading.Lock()
+        # callable(node, warning_s, reason) installed by the runtime:
+        # node is the scheduler's logical Node when known (task/actor
+        # boundaries), None for "this whole process" (agent boundary)
+        self.preempt_hook = None
 
 
 _state = _ChaosState()
@@ -70,14 +88,24 @@ def set_chaos(
     rpc_error_prob: float = 0.0,
     rpc_delay_s: float = 0.0,
     rpc_drop_prob: float = 0.0,
+    preempt_node: bool = False,
+    preempt_warning_s: float = 5.0,
 ) -> None:
     with _state.lock:
         _state.config = ChaosConfig(
             failure_prob, delay_s, max_injections, name_filter, seed,
             kill_node, rpc_error_prob, rpc_delay_s, rpc_drop_prob,
+            preempt_node, preempt_warning_s,
         )
         _state.injected = 0
         _state.rng = np.random.default_rng(seed)
+
+
+def set_preemption_hook(hook) -> None:
+    """Register the callable that actually drains+kills a node when a
+    preempt_node injection fires: hook(node, warning_s, reason). The
+    runtime installs its own at init; tests may swap it."""
+    _state.preempt_hook = hook
 
 
 def clear_chaos() -> None:
@@ -99,19 +127,22 @@ def load_from_env() -> None:
         k, _, v = part.partition("=")
         k = k.strip()
         if k in ("failure_prob", "delay_s", "rpc_error_prob", "rpc_delay_s",
-                 "rpc_drop_prob"):
+                 "rpc_drop_prob", "preempt_warning_s"):
             kwargs[k] = float(v)
         elif k in ("max_injections", "seed"):
             kwargs[k] = int(v)
-        elif k == "kill_node":
+        elif k in ("kill_node", "preempt_node"):
             kwargs[k] = v.strip().lower() in ("1", "true", "yes", "on")
         elif k == "name_filter":
             kwargs[k] = v
     set_chaos(**kwargs)
 
 
-def maybe_inject(task_name: str) -> None:
-    """Called by the scheduler before running a task body."""
+def maybe_inject(task_name: str, node=None) -> None:
+    """Called by the scheduler before running a task body. `node` is the
+    logical Node executing the task when the boundary knows it (local
+    scheduler, actor mailbox); None at the agent boundary, where the
+    injection target is this whole process."""
     config = _state.config
     if config is None:
         return
@@ -124,17 +155,22 @@ def maybe_inject(task_name: str) -> None:
     delay = 0.0
     fail_ordinal = 0
     kill = False
+    preempt = False
     with _state.lock:
         if 0 <= config.max_injections <= _state.injected:
             return
-        if config.kill_node:
+        if config.preempt_node and _state.preempt_hook is not None:
+            _state.injected += 1
+            preempt = True
+        if not preempt and config.kill_node:
             _state.injected += 1
             kill = True
-        if not kill and config.delay_s > 0:
+        if not kill and not preempt and config.delay_s > 0:
             delay = config.delay_s
             _state.injected += 1
         if (
             not kill
+            and not preempt
             and config.failure_prob > 0
             # A failure is its own injection event even when a delay fired in
             # the same call: re-check the budget (the delay may have consumed
@@ -145,6 +181,16 @@ def maybe_inject(task_name: str) -> None:
         ):
             _state.injected += 1
             fail_ordinal = _state.injected
+    if preempt:
+        # Announced death: the hook drains the task's node for the
+        # warning window (pubsub-announced) and kills it afterwards. The
+        # triggering task itself keeps running — the POINT of the window
+        # is that in-flight work gets a chance to checkpoint.
+        hook = _state.preempt_hook
+        if hook is not None:  # may race a runtime shutdown
+            hook(node, config.preempt_warning_s,
+                 f"chaos: preemption notice via task {task_name!r}")
+        return
     if kill:
         # Abrupt node death: no cleanup, no deregistration — the rest of
         # the cluster must discover it through heartbeat staleness.

@@ -76,6 +76,7 @@ from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
 from .exceptions import ActorDiedError
+from .gcs import PREEMPT_CHANNEL
 from .gcs_service import PG_NS, GcsClient
 from .ids import ActorID, NodeID, ObjectID
 from .object_transfer import ObjectTransferServer, fetch_object, push_object
@@ -555,6 +556,11 @@ class ClusterContext:
         self._borrow_state: Dict[Tuple[str, str], str] = {}
         self._stop = threading.Event()
         self.shutdown_requested = threading.Event()
+        # announced preemption of THIS node (SIGTERM/maintenance hook or
+        # chaos preempt_node on the agent): one-shot latch + the pubsub
+        # cursor the watch loop reads peer preemptions from
+        self._preempting = False
+        self._preempt_since = 0.0
 
         store.set_cluster_hooks(
             fetch_remote=self._fetch_remote,
@@ -639,6 +645,7 @@ class ClusterContext:
             try:
                 self._heartbeat()
                 self._refresh_nodes()
+                self._poll_preemptions()
             except (RpcError, OSError) as exc:
                 # GCS unreachable: keep trying — if the head died, the user
                 # tears the cluster down; a transient blip must not.
@@ -673,6 +680,13 @@ class ClusterContext:
             if known is not None:
                 known.client.close()  # don't leak the quarantined socket
             self.runtime.scheduler.add_node(node)
+            if info.get("preempting"):
+                # late discovery of an already-draining node (we joined
+                # after its announcement): never place anything there
+                self.runtime.scheduler.mark_node_draining(
+                    node_hex, info.get("preempt_reason", "preempting"),
+                    info.get("preempt_deadline", 0.0),
+                )
             from ..util.events import emit
 
             emit("INFO", "cluster",
@@ -760,6 +774,92 @@ class ClusterContext:
         if freed:
             logger.info("released %d PG bundles reserved by dead node %s",
                         freed, node_hex[:12])
+
+    # ------------------------------------------------------------ preemption
+
+    def begin_preemption(self, reason: str, warning_s: Optional[float] = None,
+                         fate: str = "shutdown") -> None:
+        """THIS node received an announced-death notice (cloud maintenance
+        SIGTERM, spot preemption, chaos preempt_node). Announce it
+        cluster-wide through the GCS pubsub + node table, stop local
+        placement onto this node, and after the warning window either
+        request a graceful shutdown (fate="shutdown", the SIGTERM hook)
+        or hard-exit like the VM being reclaimed (fate="exit", chaos)."""
+        from .config import cfg
+
+        if warning_s is None:
+            warning_s = cfg.preempt_warning_s
+        with self._lock:
+            if self._preempting:
+                return  # a second notice never shortens or doubles the drill
+            self._preempting = True
+        deadline = time.time() + warning_s
+        msg = {
+            "node_hex": self.node_id.hex(),
+            "reason": reason,
+            "warning_s": warning_s,
+            "deadline": deadline,
+        }
+        # announce FIRST: peers must stop placing here before we vanish
+        try:
+            self.gcs.publish(PREEMPT_CHANNEL, msg)
+        except (RpcError, OSError):
+            pass  # partitioned from the GCS: drain locally anyway
+        try:
+            info = self.gcs.kv_get(self.node_id.hex(), namespace=NODE_NS) or {}
+            info.update({
+                "preempting": True,
+                "preempt_reason": reason,
+                "preempt_deadline": deadline,
+            })
+            self.gcs.kv_put(self.node_id.hex(), info, namespace=NODE_NS)
+        except (RpcError, OSError):
+            pass
+        # our own scheduler view + in-process subscribers (controllers)
+        self.runtime.scheduler.mark_node_draining(
+            self.node_id.hex(), reason, deadline
+        )
+        self.runtime.gcs.pubsub.publish(PREEMPT_CHANNEL, msg)
+        from ..util.events import emit
+
+        emit("WARNING", "cluster",
+             f"node {self.node_id.hex()[:12]} preempting: {reason} "
+             f"({warning_s:.1f}s warning, fate={fate})",
+             deadline=deadline)
+        logger.warning("preemption notice (%s): %s warning %.1fs",
+                       fate, reason, warning_s)
+
+        def _expire() -> None:
+            if fate == "exit":
+                # the VM is reclaimed: abrupt death, peers discover the
+                # rest through heartbeat staleness (like kill_node)
+                os._exit(137)
+            self.shutdown_requested.set()
+
+        timer = threading.Timer(warning_s, _expire)
+        timer.daemon = True
+        timer.start()
+
+    def _poll_preemptions(self) -> None:
+        """Watch-loop arm: read peer preemption announcements from the
+        head GCS pubsub history, drain those nodes in the local scheduler
+        view, and relay into the in-process pubsub so local subscribers
+        (train controllers) see cluster-wide preemptions too."""
+        msgs = self.gcs.poll(PREEMPT_CHANNEL, self._preempt_since)
+        for ts, msg in msgs:
+            self._preempt_since = max(self._preempt_since, ts)
+            node_hex = (msg or {}).get("node_hex")
+            if not node_hex or node_hex == self.node_id.hex():
+                continue  # our own announcement: begin_preemption handled it
+            with self._lock:
+                node = self._remote_nodes.get(node_hex)
+            if node is None or node.draining:
+                continue
+            self.runtime.scheduler.mark_node_draining(
+                node_hex, msg.get("reason", "preempted"),
+                msg.get("deadline", 0.0),
+            )
+            self.runtime.gcs.pubsub.publish(PREEMPT_CHANNEL, msg)
 
     def nodes(self) -> List[Dict[str, Any]]:
         """Cluster membership as recorded in the GCS node table."""
@@ -1311,7 +1411,10 @@ class ClusterContext:
         if any(fits_now(n) for n in local):
             return None
         with self._lock:
-            remotes = [n for n in self._remote_nodes.values() if n.alive]
+            # draining (PREEMPTING) agents take no new actors
+            remotes = [
+                n for n in self._remote_nodes.values() if n.placeable()
+            ]
         # saturated-but-feasible local must NOT hoard the actor while an
         # agent idles (round-4 verdict Weak#4): spill to a remote node
         # with room now
@@ -1483,7 +1586,7 @@ class ClusterContext:
                 with self._lock:
                     candidates = [
                         n for n in self._remote_nodes.values()
-                        if n.alive and n.resources.can_ever_fit(resources)
+                        if n.placeable() and n.resources.can_ever_fit(resources)
                     ]
                 candidates.sort(key=lambda n: n.utilization())
                 for cand in candidates:

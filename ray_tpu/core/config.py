@@ -142,6 +142,14 @@ define_flag("pg_reschedule_backoff_s", 0.5,
 define_flag("pg_reschedule_wait_s", 60.0,
             "How long dependents (bundle-actor restarts, gang re-mesh) "
             "wait for a RESCHEDULING placement group to re-reserve.")
+define_flag("preempt_warning_s", 10.0,
+            "Warning window a SIGTERM-preempted node agent announces "
+            "before it shuts down (cloud maintenance/spot semantics).")
+
+# train resilience
+define_flag("train_ckpt_keep", 2,
+            "Session (pickle) checkpoints retained per trial dir when "
+            "RunConfig.checkpoint.session_keep is unset.")
 
 # serve resilience (deadlines / retry / admission / draining)
 define_flag("serve_default_timeout_s", 0.0,

@@ -18,6 +18,13 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 logger = logging.getLogger(__name__)
 
+# Pub/sub channel carrying announced node preemptions: messages are
+# {node_hex, reason, warning_s, deadline}. Published by whoever receives
+# the preemption notice (chaos drill, agent SIGTERM hook); consumed by
+# schedulers (stop placing there) and train controllers (emergency
+# checkpoint + restart excluding the node).
+PREEMPT_CHANNEL = "node_preemption"
+
 
 class KVStore:
     """Namespaced key-value store (reference: gcs_kv_manager.h)."""
@@ -59,6 +66,9 @@ class PubSub:
         self._subs: Dict[str, List[Callable[[Any], None]]] = {}
         self._history: Dict[str, List[Tuple[float, Any]]] = {}
         self._lock = threading.Lock()
+        # (channel, callback) pairs that already produced one WARNING:
+        # a permanently broken subscriber must be visible, not spam
+        self._warned: set = set()
 
     def publish(self, channel: str, message: Any) -> None:
         with self._lock:
@@ -70,8 +80,22 @@ class PubSub:
         for cb in subs:
             try:
                 cb(message)
-            except Exception:  # noqa: BLE001 - subscriber bugs must not kill publishers
-                pass
+            except Exception as exc:  # noqa: BLE001 - subscriber bugs must not kill publishers
+                # One WARNING event per (channel, callback) lifetime (the
+                # metrics-sampler pattern): a dead preemption/failover
+                # listener used to swallow its exceptions silently.
+                key = (channel, cb)
+                with self._lock:
+                    first = key not in self._warned
+                    self._warned.add(key)
+                if first:
+                    from ..util.events import emit
+
+                    emit("WARNING", "gcs",
+                         f"pubsub subscriber on channel {channel!r} raised; "
+                         f"further failures suppressed: {exc!r}",
+                         channel=channel, callback=repr(cb))
+                logger.warning("pubsub subscriber on %r failed: %r", channel, exc)
 
     def subscribe(self, channel: str, callback: Callable[[Any], None]) -> None:
         with self._lock:

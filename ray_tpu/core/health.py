@@ -127,6 +127,23 @@ def probe_agent(node) -> bool:
         return False
 
 
+def install_preemption_signal_handler(ctx, warning_s: Optional[float] = None):
+    """Wire a real preemption notice into the drain pipeline: cloud
+    providers deliver spot/maintenance preemption as SIGTERM with a grace
+    window, so a node agent receiving SIGTERM announces PREEMPTING
+    (cluster.begin_preemption: pubsub + node table + local drain) and
+    shuts down gracefully when the window expires instead of dying with
+    state on the floor. Returns the previous handler. Main thread only
+    (signal module constraint) — the CLI agent loop installs it."""
+    import signal
+
+    def _on_sigterm(signum, frame):  # noqa: ARG001 - signal signature
+        ctx.begin_preemption("SIGTERM (preemption notice)",
+                             warning_s=warning_s, fate="shutdown")
+
+    return signal.signal(signal.SIGTERM, _on_sigterm)
+
+
 def read_memory_usage_fraction() -> float:
     """Fraction of host memory in use, from /proc/meminfo (no psutil
     needed; matches the reference's MemoryMonitor source)."""

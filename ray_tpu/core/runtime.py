@@ -204,6 +204,13 @@ class Runtime:
             from .cluster import join_cluster
 
             self.cluster = join_cluster(self, address, token=cluster_token)
+        # Announced-preemption plumbing: chaos (preempt_node mode) and the
+        # agent SIGTERM hook pull the trigger; this runtime drains the
+        # node, announces on the GCS pubsub, and kills it after the window.
+        from . import chaos as _chaos
+
+        self._preempt_timers: List[threading.Timer] = []
+        _chaos.set_preemption_hook(self._chaos_preempt)
         self._snapshot_stop = threading.Event()
         self._snapshot_path = cfg.gcs_snapshot_path or None
         if self._snapshot_path:
@@ -733,7 +740,86 @@ class Runtime:
     def task_events(self) -> List[Dict[str, Any]]:
         return list(self._task_events)
 
+    # ------------------------------------------------------------- preemption
+
+    def _chaos_preempt(self, node, warning_s: float, reason: str) -> None:
+        """Chaos preempt_node trigger. `node` is the logical node the
+        matching task ran on; None means the injection fired at an agent
+        boundary and the whole PROCESS is being preempted."""
+        if node is None or getattr(node, "is_remote", False):
+            if self.cluster is not None:
+                # a cluster member: announce through the head GCS, drain,
+                # and hard-exit after the window (spot-VM semantics)
+                self.cluster.begin_preemption(reason, warning_s, fate="exit")
+                return
+            node = self.scheduler.head_node()
+        self.preempt_node(node, warning_s=warning_s, reason=reason)
+
+    def preempt_node(self, node: Node, warning_s: Optional[float] = None,
+                     reason: str = "preempted") -> None:
+        """Put an in-process logical node into the PREEMPTING state:
+        placement stops immediately, the preemption is published on the
+        GCS pubsub (PREEMPT_CHANNEL) for train controllers et al., and
+        after `warning_s` the node actually dies — running work gets the
+        window to checkpoint and evacuate. Preempting the only node of a
+        single-node runtime kills the whole runtime's capacity; drills
+        should target a non-head node."""
+        from .config import cfg
+        from .gcs import PREEMPT_CHANNEL
+
+        if warning_s is None:
+            warning_s = cfg.preempt_warning_s
+        deadline = time.time() + warning_s
+        marked = self.scheduler.mark_node_draining(
+            node.node_id.hex(), reason, deadline
+        )
+        if marked is None or not node.alive:
+            return  # unknown or already gone
+        self.gcs.pubsub.publish(PREEMPT_CHANNEL, {
+            "node_hex": node.node_id.hex(),
+            "reason": reason,
+            "warning_s": warning_s,
+            "deadline": deadline,
+        })
+        timer = threading.Timer(
+            warning_s, self._kill_local_node, args=(node, reason)
+        )
+        timer.daemon = True
+        timer.start()
+        self._preempt_timers.append(timer)
+
+    def _kill_local_node(self, node: Node, reason: str) -> None:
+        """The warning window expired: the preempted node is gone. Actors
+        hosted there die (restart elsewhere when budgeted — the node is
+        already out of every placement path), and placement groups with
+        bundles there reschedule."""
+        if not node.alive:
+            return
+        from ..util.events import emit
+
+        node_hex = node.node_id.hex()
+        emit("WARNING", "cluster",
+             f"preempted node {node_hex[:12]} died after its warning "
+             f"window", reason=reason)
+        self.scheduler.remove_node(node.node_id)
+        with self._lock:
+            doomed = [
+                ar for ar in self._actors.values() if ar._node is node
+            ]
+        for ar in doomed:
+            ar.kill(
+                no_restart=False,
+                reason=f"node {node_hex[:12]} preempted: {reason}",
+            )
+        self.scheduler.handle_node_death(node_hex, f"preempted: {reason}")
+
     def shutdown(self) -> None:
+        from . import chaos as _chaos
+
+        _chaos.set_preemption_hook(None)
+        for timer in self._preempt_timers:
+            timer.cancel()
+        self._preempt_timers = []
         if self.cluster is not None:
             self.cluster.stop()
             gcs_server = getattr(self.cluster, "gcs_server", None)

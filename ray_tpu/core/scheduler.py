@@ -164,9 +164,21 @@ class Node:
         self.resources = ResourceSet(resources)
         self.is_head = is_head
         self.alive = True
+        # PREEMPTING/draining: the node received an announced-death
+        # notice (spot preemption, maintenance SIGTERM, chaos drill).
+        # Still alive — running work may finish or checkpoint inside the
+        # warning window — but every placement path skips it, so nothing
+        # NEW lands on a host that is about to vanish.
+        self.draining = False
+        self.drain_reason = ""
+        self.drain_deadline = 0.0  # wall-clock ts the node expects to die
         self.labels = labels or {}
         self.running_tasks: Dict[TaskID, TaskSpec] = {}
         self._lock = threading.Lock()
+
+    def placeable(self) -> bool:
+        """Eligible to receive NEW tasks/actors/bundles."""
+        return self.alive and not self.draining
 
     def utilization(self) -> float:
         total = self.resources.total
@@ -415,6 +427,34 @@ class ClusterScheduler:
         self._wake.set()
         return node
 
+    def mark_node_draining(self, node_hex: str, reason: str,
+                           deadline: float = 0.0) -> Optional[Node]:
+        """Flip a node to PREEMPTING/draining: placement paths skip it
+        from now on; queued work re-plans onto surviving nodes. Returns
+        the node, or None when unknown (already dead/departed)."""
+        with self._lock:
+            node = next(
+                (n for n in self._nodes.values()
+                 if n.node_id.hex() == node_hex), None
+            )
+            if node is None or node.draining:
+                return node
+            node.draining = True
+            node.drain_reason = reason
+            node.drain_deadline = deadline
+        from ..util.events import emit
+        from ..util.metrics import get_or_create_counter
+
+        emit("WARNING", "cluster",
+             f"node {node_hex[:12]} PREEMPTING: new placements stop "
+             f"({reason})", node=node_hex, deadline=deadline)
+        get_or_create_counter(
+            "raytpu_node_preemptions_total",
+            "Nodes that entered the PREEMPTING/draining state.",
+        ).inc()
+        self._wake.set()  # queued tasks must re-plan around it
+        return node
+
     def nodes(self) -> List[Node]:
         with self._lock:
             return list(self._nodes.values())
@@ -601,7 +641,9 @@ class ClusterScheduler:
         raise PlacementGroupUnschedulableError(last_err)
 
     def _plan_placement_locked(self, pg: PlacementGroup) -> Optional[List[Node]]:
-        nodes = [n for n in self._nodes.values() if n.alive]
+        # draining (PREEMPTING) nodes never take new bundles: a gang
+        # reserved there would die with the node inside its own startup
+        nodes = [n for n in self._nodes.values() if n.placeable()]
         if not nodes:
             return None
         strat = pg.strategy
@@ -819,7 +861,7 @@ class ClusterScheduler:
             ]
             if not dead:
                 return None  # healed concurrently
-            alive = [n for n in self._nodes.values() if n.alive]
+            alive = [n for n in self._nodes.values() if n.placeable()]
             held = {
                 b.node.node_id for b in pg.bundles
                 if b.node is not None and b.node.alive
@@ -1096,7 +1138,10 @@ class ClusterScheduler:
         filter here (a busy preferred node must not starve the task
         while an unlabeled node sits idle)."""
         remotable = self._remotable(spec)
-        nodes = [n for n in self.nodes() if n.alive and (remotable or not n.is_remote)]
+        nodes = [
+            n for n in self.nodes()
+            if n.placeable() and (remotable or not n.is_remote)
+        ]
         strategy = spec.scheduling_strategy
         if isinstance(strategy, NodeLabelSchedulingStrategy):
             nodes = [
@@ -1195,7 +1240,7 @@ class ClusterScheduler:
             # current-span context active for the task body: nested
             # submits/gets/transfers parent into this execution span
             with tracing.use_context(exec_span.context):
-                chaos.maybe_inject(spec.name)
+                chaos.maybe_inject(spec.name, node=node)
                 if spec.executor == "process":
                     # Pooled worker process (GIL-free); SHM-tier args ship
                     # as zero-copy arena descriptors (plasma handoff). One

@@ -26,8 +26,12 @@ from .session import (  # noqa: F401
     get_checkpoint,
     get_context,
     get_session,
+    is_preempted,
+    list_checkpoints,
     load_trial_checkpoint,
     report,
+    should_checkpoint,
+    verify_checkpoint,
 )
 from .cluster_gang import ClusterWorkerGroup  # noqa: F401
 from .trainer import LMTrainer, Trainer  # noqa: F401

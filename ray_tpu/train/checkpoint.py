@@ -6,19 +6,120 @@ StorageContext (train/_internal/storage.py:358) + CheckpointManager
 sharded pytrees written per-host by orbax (each host writes only its
 addressable shards — the multi-host pattern), restored directly into the
 target sharding layout without a host-RAM staging copy.
+
+Trust-but-verify commit protocol (this layer, above orbax):
+
+- save() ends by writing a MANIFEST (relative path -> size + sha256 of
+  every file in the step dir, atomic tmp+os.replace) and then an atomic
+  COMMIT marker. A step dir without COMMIT is torn/uncommitted.
+- restore() verifies the chosen step against its manifest first; a
+  corrupt/torn step is QUARANTINED (renamed out of orbax's integer
+  naming, WARNING event, raytpu_train_ckpt_fallback_total) and the
+  restore falls back to the newest step that verifies, instead of
+  raising or feeding bit-rot into the optimizer.
+- __init__ garbage-collects uncommitted step dirs (a crash mid-save
+  strands them) before orbax ever sees them.
 """
 
 from __future__ import annotations
 
+import hashlib
+import json
 import os
-from typing import Any, Optional
+import shutil
+import time
+from typing import Any, Dict, List, Optional
 
 import jax
 import orbax.checkpoint as ocp
 
+MANIFEST_NAME = "_raytpu_manifest.json"
+COMMIT_NAME = "_RAYTPU_COMMIT"
+
+
+def _sha256_file(path: str, chunk: int = 1 << 20) -> str:
+    digest = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            block = f.read(chunk)
+            if not block:
+                break
+            digest.update(block)
+    return digest.hexdigest()
+
+
+def _step_files(step_dir: str) -> List[str]:
+    """Every regular file under a step dir, relative paths, excluding our
+    own manifest/commit sidecars."""
+    out: List[str] = []
+    for root, _dirs, files in os.walk(step_dir):
+        for name in files:
+            rel = os.path.relpath(os.path.join(root, name), step_dir)
+            if rel in (MANIFEST_NAME, COMMIT_NAME):
+                continue
+            out.append(rel)
+    return sorted(out)
+
+
+def write_step_manifest(step_dir: str) -> Dict[str, Any]:
+    """Manifest + COMMIT for a fully-written step dir. Both writes are
+    atomic (tmp + os.replace): a crash leaves the dir uncommitted, never
+    half-committed."""
+    manifest = {
+        "files": {
+            rel: {
+                "size": os.path.getsize(os.path.join(step_dir, rel)),
+                "sha256": _sha256_file(os.path.join(step_dir, rel)),
+            }
+            for rel in _step_files(step_dir)
+        },
+        "committed_at": time.time(),
+    }
+    mpath = os.path.join(step_dir, MANIFEST_NAME)
+    tmp = mpath + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(manifest, f)
+    os.replace(tmp, mpath)
+    cpath = os.path.join(step_dir, COMMIT_NAME)
+    tmp = cpath + ".tmp"
+    with open(tmp, "w") as f:
+        f.write("committed\n")
+    os.replace(tmp, cpath)
+    return manifest
+
+
+def verify_step_dir(step_dir: str) -> Optional[str]:
+    """None when the step dir verifies (COMMIT present, every manifest
+    entry matches on size + sha256, no manifest-unknown payload files),
+    else the failure reason. Dirs with no COMMIT are uncommitted by
+    definition."""
+    if not os.path.isdir(step_dir):
+        return "missing step dir"
+    if not os.path.exists(os.path.join(step_dir, COMMIT_NAME)):
+        return "no COMMIT marker (uncommitted/torn save)"
+    mpath = os.path.join(step_dir, MANIFEST_NAME)
+    try:
+        with open(mpath) as f:
+            manifest = json.load(f)
+        entries = manifest["files"]
+    except (OSError, ValueError, KeyError) as exc:
+        return f"unreadable manifest: {exc!r}"
+    on_disk = set(_step_files(step_dir))
+    missing = set(entries) - on_disk
+    if missing:
+        return f"manifest files missing on disk: {sorted(missing)[:3]}"
+    for rel, expected in entries.items():
+        path = os.path.join(step_dir, rel)
+        size = os.path.getsize(path)
+        if size != expected.get("size"):
+            return f"{rel}: size mismatch ({size} != {expected.get('size')})"
+        if _sha256_file(path) != expected.get("sha256"):
+            return f"{rel}: checksum mismatch"
+    return None
+
 
 class CheckpointManager:
-    """Step-indexed checkpoint directory with retention.
+    """Step-indexed checkpoint directory with retention + verification.
 
     save() accepts any pytree (e.g. TrainState); restore() takes an
     abstract/sharded target so arrays land in the right layout.
@@ -33,26 +134,116 @@ class CheckpointManager:
     ):
         self.directory = os.path.abspath(os.fspath(directory))
         os.makedirs(self.directory, exist_ok=True)
-        options = ocp.CheckpointManagerOptions(
+        # GC BEFORE orbax builds its step view: uncommitted dirs are a
+        # crash's leftovers and must not masquerade as restorable steps
+        self._gc_uncommitted()
+        self._options = ocp.CheckpointManagerOptions(
             max_to_keep=max_to_keep,
             enable_async_checkpointing=async_save,
         )
-        self._mgr = ocp.CheckpointManager(self.directory, options=options)
+        self._mgr = ocp.CheckpointManager(self.directory, options=self._options)
+
+    def _step_dir(self, step: int) -> str:
+        return os.path.join(self.directory, str(step))
+
+    def _gc_uncommitted(self) -> int:
+        """Remove integer-named step dirs without a COMMIT marker — a
+        crash between orbax's write and our commit strands them, and an
+        uncommitted dir must never be offered for restore. Dirs with
+        neither COMMIT nor MANIFEST machinery at all are left alone only
+        when the directory has never seen a committed save (pre-manifest
+        layouts stay loadable)."""
+        any_committed = any(
+            os.path.exists(os.path.join(self.directory, name, COMMIT_NAME))
+            for name in os.listdir(self.directory)
+            if name.isdigit()
+        )
+        if not any_committed:
+            return 0
+        removed = 0
+        for name in sorted(os.listdir(self.directory)):
+            if not name.isdigit():
+                continue
+            step_dir = os.path.join(self.directory, name)
+            if os.path.exists(os.path.join(step_dir, COMMIT_NAME)):
+                continue
+            from ..util.events import emit
+
+            emit("WARNING", "train",
+                 f"GC'd uncommitted checkpoint step dir {name} "
+                 f"(torn save)", directory=self.directory)
+            shutil.rmtree(step_dir, ignore_errors=True)
+            removed += 1
+        return removed
 
     def save(self, step: int, state: Any, *, force: bool = False) -> bool:
-        return self._mgr.save(
+        saved = self._mgr.save(
             step, args=ocp.args.StandardSave(state), force=force
         )
+        if saved:
+            # the manifest covers the COMPLETE step dir, so an async save
+            # must land first; the commit marker is the very last write
+            self._mgr.wait_until_finished()
+            write_step_manifest(self._step_dir(step))
+        return saved
+
+    def _quarantine(self, step: int, reason: str) -> None:
+        from ..util.events import emit
+        from ..util.metrics import get_or_create_counter
+
+        step_dir = self._step_dir(step)
+        target = f"{step_dir}.corrupt-{int(time.time())}"
+        try:
+            os.replace(step_dir, target)
+        except OSError:
+            shutil.rmtree(step_dir, ignore_errors=True)
+            target = "(removed)"
+        emit("WARNING", "train",
+             f"quarantined corrupt checkpoint step {step}: {reason}",
+             directory=self.directory, step=step, quarantined_to=target)
+        get_or_create_counter(
+            "raytpu_train_ckpt_fallback_total",
+            "Checkpoint restores that fell back past a corrupt/torn "
+            "checkpoint (quarantined).",
+            ("store",),
+        ).inc(tags={"store": "orbax"})
+        # orbax caches its step view; rebuild it so the quarantined step
+        # disappears from latest_step()/all_steps()
+        self._mgr.close()
+        self._mgr = ocp.CheckpointManager(self.directory, options=self._options)
 
     def restore(self, state_target: Any, step: Optional[int] = None) -> Any:
         """Restore into the layout of `state_target` (a real or abstract
-        sharded pytree). step=None → latest."""
-        if step is None:
-            step = self.latest_step()
-            if step is None:
-                raise FileNotFoundError(f"no checkpoints under {self.directory}")
+        sharded pytree). step=None → newest VERIFIED step; an explicitly
+        requested step that fails verification is quarantined and the
+        restore falls back to the newest step that verifies."""
         abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, state_target)
-        return self._mgr.restore(step, args=ocp.args.StandardRestore(abstract))
+        candidates = sorted(self._mgr.all_steps(), reverse=True)
+        if step is not None:
+            # requested step first, then newest-first fallback
+            candidates = [step] + [s for s in candidates if s != step]
+        if not candidates:
+            raise FileNotFoundError(f"no checkpoints under {self.directory}")
+        if not any(
+            os.path.exists(os.path.join(self._step_dir(s), COMMIT_NAME))
+            for s in candidates
+        ):
+            # pre-manifest layout (no save here ever committed through
+            # this class): restore as before, nothing to verify against
+            return self._mgr.restore(
+                candidates[0], args=ocp.args.StandardRestore(abstract)
+            )
+        for candidate in candidates:
+            reason = verify_step_dir(self._step_dir(candidate))
+            if reason is None:
+                return self._mgr.restore(
+                    candidate, args=ocp.args.StandardRestore(abstract)
+                )
+            self._quarantine(candidate, reason)
+        raise FileNotFoundError(
+            f"no VALID checkpoints under {self.directory} (all candidates "
+            f"failed verification and were quarantined)"
+        )
 
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()

@@ -44,6 +44,7 @@ class _GangHostActor:
         self._done = False
         self._error: Optional[str] = None
         self._result: Any = None
+        self._session: Any = None  # set once the loop thread builds it
 
     def start(self, train_fn: Callable, config, coordinator: str,
               num_processes: int, process_id: int, run_name: str,
@@ -83,7 +84,9 @@ class _GangHostActor:
                     world_rank=process_id, world_size=num_processes,
                     run_name=run_name,
                 )
-                _set_session(_ListSession(ctx))
+                session = _ListSession(ctx)
+                outer._session = session
+                _set_session(session)
                 try:
                     self._result = (
                         train_fn(config) if config is not None else train_fn()
@@ -107,7 +110,13 @@ class _GangHostActor:
         threading.Thread(target=go, daemon=True, name="gang-train").start()
         return True
 
-    def poll(self, since: int) -> Dict[str, Any]:
+    def poll(self, since: int, should_checkpoint: bool = False,
+             preempted: bool = False,
+             preempt_deadline: float = 0.0) -> Dict[str, Any]:
+        if (should_checkpoint or preempted) and self._session is not None:
+            self._session.set_preemption(
+                should_checkpoint, preempted, preempt_deadline
+            )
         return {
             "reports": self._reports[since:],
             "done": self._done,
@@ -235,9 +244,15 @@ class ClusterWorkerGroup:
         api.get(acks, timeout=120)  # every member launched its loop
         return list(self.workers)
 
-    def poll(self, since: List[int]) -> List[Dict[str, Any]]:
+    def poll(self, since: List[int], should_checkpoint: bool = False,
+             preempted: bool = False,
+             preempt_deadline: float = 0.0) -> List[Dict[str, Any]]:
         return api.get(
-            [w.poll.remote(s) for w, s in zip(self.workers, since)],
+            [
+                w.poll.remote(s, should_checkpoint, preempted,
+                              preempt_deadline)
+                for w, s in zip(self.workers, since)
+            ],
             timeout=60,
         )
 

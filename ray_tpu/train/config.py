@@ -38,9 +38,16 @@ class ScalingConfig:
 
 @dataclasses.dataclass
 class FailureConfig:
-    """Retry budget (reference DefaultFailurePolicy default.py:13)."""
+    """Retry budget (reference DefaultFailurePolicy default.py:13).
+
+    Preemption-triggered restarts are budgeted SEPARATELY: an announced
+    node loss the run rode out cleanly (emergency checkpoint + restart on
+    surviving nodes) is not a failure and must not burn max_failures —
+    on spot-heavy fleets preemptions outnumber real crashes by orders of
+    magnitude."""
 
     max_failures: int = 0  # 0 = fail fast; -1 = unlimited restarts
+    max_preempt_restarts: int = -1  # -1 = unlimited (spot-fleet default)
 
 
 @dataclasses.dataclass
@@ -49,6 +56,10 @@ class CheckpointConfig:
     max_to_keep: int = 3
     checkpoint_every: int = 0  # steps; 0 = only on report(checkpoint=...)
     async_save: bool = False
+    # retention for SESSION (pickle) checkpoints in the trial dir —
+    # report(checkpoint=...) — distinct from the orbax max_to_keep above;
+    # None falls back to the RAY_TPU_TRAIN_CKPT_KEEP flag (default 2)
+    session_keep: Optional[int] = None
 
 
 @dataclasses.dataclass

@@ -1,12 +1,24 @@
 """TrainController: the run state machine (reference parity:
 train/v2/_internal/execution/controller/controller.py:91 — poll workers,
 aggregate reports, apply the failure policy, restart the gang from the last
-checkpoint)."""
+checkpoint).
+
+Preemption pipeline: the controller subscribes to the GCS pubsub's
+PREEMPT_CHANNEL. When a node hosting one of its workers announces
+preemption, the controller (1) flips should_checkpoint/preempted flags
+the workers observe through the poll plane, (2) waits up to the warning
+window for an out-of-band checkpoint at the current step, then (3)
+restarts the gang — the draining node is already out of every placement
+path, so the new gang lands on survivors — WITHOUT burning the
+FailureConfig.max_failures budget (announced losses are the common case
+on spot fleets; real crashes stay budgeted)."""
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import enum
+import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
@@ -33,6 +45,17 @@ class Result:
     status: RunStatus
     error: Optional[str] = None
     num_restarts: int = 0
+    # announced-preemption restarts, budgeted separately from failures
+    num_preempt_restarts: int = 0
+
+
+class _PreemptRestart:
+    """Sentinel outcome of a poll cycle: the gang must restart because a
+    hosting node is being preempted (not a failure)."""
+
+    def __init__(self, notice: Dict[str, Any], checkpointed: bool):
+        self.notice = notice
+        self.checkpointed = checkpointed
 
 
 class FailurePolicy:
@@ -79,7 +102,12 @@ class TrainController:
         self.metrics_history: List[Dict[str, Any]] = []
         self.latest_checkpoint_step: Optional[int] = None
         self.num_restarts = 0
+        self.num_preempt_restarts = 0
         self.world_sizes: List[int] = []  # gang size per (re)start attempt
+        # preemption notices from the GCS pubsub (subscriber thread) →
+        # drained by the poll loop
+        self._preempt_lock = threading.Lock()
+        self._preempt_notices: "collections.deque" = collections.deque()
 
     def decide_num_workers(self) -> int:
         """Elastic sizing (reference v2 ScalingPolicy): fit the gang to
@@ -108,9 +136,59 @@ class TrainController:
         # phases line up with HLO activity.
         from ..util import tracing
 
-        with tracing.span("train.run", run=self.run_config.name) as run_span:
-            result = self._run_traced(run_span)
+        unsubscribe = self._subscribe_preemption()
+        try:
+            with tracing.span("train.run", run=self.run_config.name) as run_span:
+                result = self._run_traced(run_span)
+        finally:
+            unsubscribe()
         return result
+
+    # ------------------------------------------------------------- preemption
+
+    def _subscribe_preemption(self) -> Callable[[], None]:
+        """Listen for announced node preemptions on the local GCS pubsub
+        (cluster members relay peer announcements into it). No-op when no
+        runtime is initialized (e.g. a bare MultihostWorkerGroup run)."""
+        from ..core import runtime as rt
+
+        if not rt.is_initialized():
+            return lambda: None
+        from ..core.gcs import PREEMPT_CHANNEL
+
+        pubsub = rt.get_runtime().gcs.pubsub
+        pubsub.subscribe(PREEMPT_CHANNEL, self._on_preempt_notice)
+        return lambda: pubsub.unsubscribe(
+            PREEMPT_CHANNEL, self._on_preempt_notice
+        )
+
+    def _on_preempt_notice(self, msg: Any) -> None:
+        if isinstance(msg, dict) and msg.get("node_hex"):
+            with self._preempt_lock:
+                self._preempt_notices.append(dict(msg))
+
+    def _next_preempt_notice(self, group) -> Optional[Dict[str, Any]]:
+        """Pop the first pending notice that affects this gang (a node
+        hosting one of its bundles — or any node when the group's
+        placement is opaque)."""
+        while True:
+            with self._preempt_lock:
+                if not self._preempt_notices:
+                    return None
+                notice = self._preempt_notices.popleft()
+            if self._notice_affects(group, notice):
+                return notice
+
+    @staticmethod
+    def _notice_affects(group, notice: Dict[str, Any]) -> bool:
+        pg = getattr(group, "pg", None)
+        bundles = getattr(pg, "bundles", None) if pg is not None else None
+        if not bundles:
+            return True  # opaque placement: assume affected (safe side)
+        hosts = {
+            b.node.node_id.hex() for b in bundles if b.node is not None
+        }
+        return not hosts or notice.get("node_hex") in hosts
 
     def _run_traced(self, run_span) -> Result:
         from ..util import tracing
@@ -118,6 +196,8 @@ class TrainController:
         policy = FailurePolicy(self.run_config.failure)
         error: Optional[str] = None
         while True:
+            error = None
+            preempt: Optional[_PreemptRestart] = None
             num_workers = self.decide_num_workers()
             self.world_sizes.append(num_workers)
             if self.group_factory is not None:
@@ -128,6 +208,10 @@ class TrainController:
                     self.scaling.worker_resources(),
                     run_name=self.run_config.name,
                     trial_dir=self.run_config.storage_path,
+                    checkpoint_keep=self.run_config.checkpoint.session_keep,
+                    # the step this attempt resumes from must survive
+                    # worker-side pruning until a newer one lands
+                    protect_step=self.latest_checkpoint_step,
                 )
             from ..util.events import emit
 
@@ -155,18 +239,39 @@ class TrainController:
                     self.status = RunStatus.FINISHED
                     emit("INFO", "train",
                          f"run {self.run_config.name} finished "
-                         f"({self.num_restarts} restart(s))")
+                         f"({self.num_restarts} restart(s), "
+                         f"{self.num_preempt_restarts} preemption(s))")
                     return self._result(None)
-                error = outcome
+                if isinstance(outcome, _PreemptRestart):
+                    preempt = outcome
+                else:
+                    error = outcome
             except (ActorDiedError, TaskError, RayTpuError, RuntimeError,
                     TimeoutError) as e:
                 error = repr(e)
             finally:
                 attempt_span.end(
                     status="OK" if error is None else "ERROR",
-                    error=error, checkpoint_step=self.latest_checkpoint_step,
+                    error=error, preempted=preempt is not None,
+                    checkpoint_step=self.latest_checkpoint_step,
                 )
                 group.shutdown()
+
+            if preempt is not None:
+                # announced node loss, ridden out: restart on survivors
+                # WITHOUT burning the failure budget
+                if not self._preempt_restart_allowed():
+                    error = (
+                        f"preemption of node "
+                        f"{preempt.notice.get('node_hex', '?')[:12]} "
+                        f"exceeded max_preempt_restarts"
+                    )
+                    self.status = RunStatus.ERRORED
+                    emit("ERROR", "train",
+                         f"run {self.run_config.name}: {error}")
+                    return self._result(error)
+                self._begin_preempt_restart(preempt, run_span)
+                continue
 
             if policy.should_restart():
                 self.status = RunStatus.RESTARTING
@@ -182,8 +287,7 @@ class TrainController:
                                   run=self.run_config.name,
                                   restart=self.num_restarts,
                                   resume_from_step=self.latest_checkpoint_step):
-                    if self.train_config is not None:
-                        self.train_config["resume_from_step"] = self.latest_checkpoint_step
+                    self._set_resume_step()
                     if self.restart_backoff_s > 0:
                         time.sleep(self.restart_backoff_s)
                 continue
@@ -193,14 +297,90 @@ class TrainController:
                  f"{self.num_restarts} restart(s): {error}")
             return self._result(error)
 
-    def _poll_until_done(self, group: WorkerGroup) -> Optional[str]:
-        """Returns None on clean completion, error string on worker failure."""
+    def _set_resume_step(self) -> None:
+        """Record the resume step where the next attempt's train_fn reads
+        it. Defaults train_config to {} — with a None config the resume
+        step used to be dropped on the floor and every restart silently
+        trained from scratch."""
+        if self.train_config is None:
+            self.train_config = {}
+        self.train_config["resume_from_step"] = self.latest_checkpoint_step
+
+    def _preempt_restart_allowed(self) -> bool:
+        budget = getattr(
+            self.run_config.failure, "max_preempt_restarts", -1
+        )
+        return budget < 0 or self.num_preempt_restarts < budget
+
+    def _begin_preempt_restart(self, preempt: "_PreemptRestart",
+                               run_span) -> None:
+        from ..util import tracing
+        from ..util.events import emit
+        from ..util.metrics import get_or_create_counter
+
+        self.status = RunStatus.RESTARTING
+        self.num_preempt_restarts += 1
+        get_or_create_counter(
+            "raytpu_train_preempt_restarts_total",
+            "Gang restarts triggered by announced node preemption "
+            "(budgeted separately from failure restarts).",
+        ).inc()
+        emit("WARNING", "train",
+             f"run {self.run_config.name} restarting after preemption of "
+             f"node {preempt.notice.get('node_hex', '?')[:12]} "
+             f"(emergency checkpoint "
+             f"{'taken' if preempt.checkpointed else 'NOT taken'}, resume "
+             f"step {self.latest_checkpoint_step}; failure budget untouched)",
+             preempt_restarts=self.num_preempt_restarts)
+        with tracing.span("train.restore", parent=run_span.context,
+                          lane=f"train:{self.run_config.name}",
+                          run=self.run_config.name, preempted=True,
+                          resume_from_step=self.latest_checkpoint_step):
+            self._set_resume_step()
+        # no backoff: the draining node is already excluded from
+        # placement, and the warning window is burning — restart NOW
+
+    def _poll_until_done(self, group: WorkerGroup):
+        """Returns None on clean completion, an error string on worker
+        failure, or a _PreemptRestart when a hosting node announced its
+        death (after waiting out the emergency-checkpoint window)."""
         result_refs = group.run_async(self.train_fn, self.train_config)
         cursors = [0] * group.num_workers
+        notice: Optional[Dict[str, Any]] = None
+        baseline_ckpt: Optional[int] = None
+        flags_supported = True
         while True:
+            if notice is None:
+                notice = self._next_preempt_notice(group)
+                if notice is not None:
+                    baseline_ckpt = self.latest_checkpoint_step
+                    from ..util.events import emit
+
+                    emit("WARNING", "train",
+                         f"run {self.run_config.name}: preemption notice "
+                         f"for node {notice.get('node_hex', '?')[:12]} — "
+                         f"requesting emergency checkpoint "
+                         f"(window {notice.get('warning_s', 0):.1f}s)")
             try:
-                polls = group.poll(cursors)
+                if notice is not None and flags_supported:
+                    try:
+                        polls = group.poll(
+                            cursors, should_checkpoint=True, preempted=True,
+                            preempt_deadline=notice.get("deadline", 0.0),
+                        )
+                    except TypeError:
+                        # a custom group without the preemption plane:
+                        # still restart on the window, just without the
+                        # out-of-band checkpoint request
+                        flags_supported = False
+                        polls = group.poll(cursors)
+                else:
+                    polls = group.poll(cursors)
             except (ActorDiedError, TaskError) as e:
+                if notice is not None:
+                    # the preempted node took the workers down before the
+                    # window closed: still a preemption, not a failure
+                    return _PreemptRestart(notice, checkpointed=False)
                 return repr(e)
             for i, p in enumerate(polls):
                 for metrics, ckpt_step, rank, ts in p["reports"]:
@@ -225,7 +405,19 @@ class TrainController:
                                        "step": ckpt_step, "rank": rank},
                             )
                 if p["error"]:
+                    if notice is not None:
+                        return _PreemptRestart(
+                            notice, checkpointed=self._got_emergency_ckpt(
+                                baseline_ckpt
+                            )
+                        )
                     return p["error"]
+            if notice is not None:
+                got = self._got_emergency_ckpt(baseline_ckpt)
+                if got or time.time() >= notice.get("deadline", 0.0):
+                    # emergency checkpoint landed (or the window closed):
+                    # stop waiting and restart on surviving nodes
+                    return _PreemptRestart(notice, checkpointed=got)
             if all(p["done"] for p in polls):
                 # surface any exception held by the run() results
                 # (Exception only: KeyboardInterrupt/SystemExit must abort
@@ -237,6 +429,11 @@ class TrainController:
                 return None
             time.sleep(self.poll_interval)
 
+    def _got_emergency_ckpt(self, baseline: Optional[int]) -> bool:
+        """A checkpoint newer than the pre-notice state has landed."""
+        latest = self.latest_checkpoint_step
+        return latest is not None and (baseline is None or latest > baseline)
+
     def _result(self, error: Optional[str]) -> Result:
         return Result(
             metrics=self.metrics_history[-1] if self.metrics_history else {},
@@ -245,4 +442,5 @@ class TrainController:
             status=self.status,
             error=error,
             num_restarts=self.num_restarts,
+            num_preempt_restarts=self.num_preempt_restarts,
         )

@@ -39,11 +39,24 @@ from .session import Session, TrainContext, _set_session
 
 class _FileSession(Session):
     """Session that also appends each report to a jsonl file the parent
-    tails (out-of-band streaming; the pipe stays request/reply)."""
+    tails (out-of-band streaming; the pipe stays request/reply). The
+    controller's preemption flags arrive the same way, inverted: a flags
+    json file next to the report files, probed by should_checkpoint()/
+    is_preempted()."""
 
-    def __init__(self, context: TrainContext, path: str):
+    def __init__(self, context: TrainContext, path: str,
+                 flags_path: Optional[str] = None):
         super().__init__(context)
         self._path = path
+        if flags_path is not None:
+            def probe() -> Dict[str, Any]:
+                try:
+                    with open(flags_path) as f:
+                        return json.load(f)
+                except (OSError, ValueError):
+                    return {}
+
+            self._flag_probe = probe
 
     def report(self, metrics, checkpoint_step=None, checkpoint=None) -> None:
         super().report(metrics, checkpoint_step, checkpoint)
@@ -66,6 +79,7 @@ def _host_entry(
     process_id: int,
     run_name: str,
     report_path: str,
+    flags_path: Optional[str] = None,
 ):
     """Runs inside the host process (module-level: pickled by reference)."""
     import jax
@@ -79,7 +93,7 @@ def _host_entry(
     ctx = TrainContext(
         world_rank=process_id, world_size=num_processes, run_name=run_name
     )
-    session = _FileSession(ctx, report_path)
+    session = _FileSession(ctx, report_path, flags_path)
     _set_session(session)
     try:
         return train_fn(config) if config is not None else train_fn()
@@ -122,6 +136,10 @@ class MultihostWorkerGroup:
     def _report_path(self, rank: int) -> str:
         return os.path.join(self.report_dir, f"reports_rank{rank}.jsonl")
 
+    def _flags_path(self) -> str:
+        # one shared flags file: a preemption concerns the whole gang
+        return os.path.join(self.report_dir, "preempt_flags.json")
+
     def start(self) -> None:
         os.makedirs(self.report_dir, exist_ok=True)
         for rank in range(self.num_workers):
@@ -146,6 +164,7 @@ class MultihostWorkerGroup:
                     rank,
                     self.run_name,
                     self._report_path(rank),
+                    self._flags_path(),
                 ),
                 {},
             )
@@ -161,9 +180,23 @@ class MultihostWorkerGroup:
             ).start()
         return self._futures
 
-    def poll(self, since: List[int]) -> List[Dict[str, Any]]:
+    def poll(self, since: List[int], should_checkpoint: bool = False,
+             preempted: bool = False,
+             preempt_deadline: float = 0.0) -> List[Dict[str, Any]]:
         """Same shape as WorkerGroup.poll: reports past each cursor, plus
-        done/error state, per worker."""
+        done/error state, per worker. Preemption flags cross the process
+        boundary via an atomically-replaced json file the workers'
+        sessions probe."""
+        if should_checkpoint or preempted:
+            flags = {
+                "should_checkpoint": should_checkpoint,
+                "preempted": preempted,
+                "deadline": preempt_deadline,
+            }
+            tmp = self._flags_path() + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(flags, f)
+            os.replace(tmp, self._flags_path())
         out = []
         for rank, (w, fut) in enumerate(zip(self.workers, self._futures)):
             reports = []

@@ -21,12 +21,16 @@ class TrainWorker:
     def __init__(
         self, rank: int, world_size: int, run_name: str,
         trial_dir: "Optional[str]" = None,
+        checkpoint_keep: "Optional[int]" = None,
+        protect_step: "Optional[int]" = None,
     ):
         self._context = TrainContext(
             world_rank=rank, world_size=world_size, run_name=run_name,
             trial_dir=trial_dir,
         )
-        self._session = Session(self._context)
+        self._session = Session(self._context, checkpoint_keep=checkpoint_keep)
+        # the step the controller will resume from: pruning spares it
+        self._session.protect_step = protect_step
         self._done = False
         self._error: Optional[str] = None
 
@@ -43,7 +47,15 @@ class TrainWorker:
         finally:
             _set_session(None)
 
-    def poll(self, since: int):
+    def poll(self, since: int, should_checkpoint: bool = False,
+             preempted: bool = False, preempt_deadline: float = 0.0):
+        # preemption flags ride the poll RPC (controller -> session); the
+        # train loop observes them between steps via
+        # train.should_checkpoint()/train.is_preempted()
+        if should_checkpoint or preempted:
+            self._session.set_preemption(
+                should_checkpoint, preempted, preempt_deadline
+            )
         reports = self._session.drain(since)
         return {
             "reports": [
@@ -70,10 +82,16 @@ class WorkerGroup:
         run_name: str = "train_run",
         trial_dir: Optional[str] = None,
         pg: Optional[PlacementGroup] = None,
+        checkpoint_keep: Optional[int] = None,
+        protect_step: Optional[int] = None,
     ):
         self.num_workers = num_workers
         self.resources_per_worker = resources_per_worker
         self.run_name = run_name
+        # session checkpoint retention + the pending-restore step pruning
+        # must spare (plumbed into every worker's Session)
+        self.checkpoint_keep = checkpoint_keep
+        self.protect_step = protect_step
         # Shared checkpoint dir for report(checkpoint=...)/get_checkpoint()
         # (all ranks see the same dir, like the reference's shared
         # StorageContext; by convention rank 0 writes).
@@ -116,7 +134,8 @@ class WorkerGroup:
                     placement_group=self.pg, placement_group_bundle_index=i
                 ),
                 name=f"{self.run_name}-worker-{i}",
-            ).remote(i, self.num_workers, self.run_name, self.trial_dir)
+            ).remote(i, self.num_workers, self.run_name, self.trial_dir,
+                     self.checkpoint_keep, self.protect_step)
             for i in range(self.num_workers)
         ]
         api.get([w.ping.remote() for w in self.workers], timeout=30)
@@ -125,9 +144,14 @@ class WorkerGroup:
         """Kick off the loop on every worker; returns the result refs."""
         return [w.run.remote(train_fn, config) for w in self.workers]
 
-    def poll(self, since: List[int]):
+    def poll(self, since: List[int], should_checkpoint: bool = False,
+             preempted: bool = False, preempt_deadline: float = 0.0):
         return api.get(
-            [w.poll.remote(s) for w, s in zip(self.workers, since)], timeout=60
+            [
+                w.poll.remote(s, should_checkpoint, preempted, preempt_deadline)
+                for w, s in zip(self.workers, since)
+            ],
+            timeout=60,
         )
 
     def finish(self, result_refs, timeout=None):

@@ -56,6 +56,16 @@ def list_nodes() -> List[Dict[str, Any]]:
                 "node_id": node.node_id.hex(),
                 "alive": node.alive,
                 "is_head": node.is_head,
+                # ALIVE | PREEMPTING | DEAD: PREEMPTING nodes announced
+                # their death and take no new placements (dashboard shows
+                # this column verbatim)
+                "state": (
+                    "PREEMPTING" if node.alive and node.draining
+                    else ("ALIVE" if node.alive else "DEAD")
+                ),
+                "draining": bool(node.draining),
+                "drain_reason": node.drain_reason,
+                "drain_deadline": node.drain_deadline,
                 "resources_total": dict(total),
                 "resources_available": dict(avail),
             }

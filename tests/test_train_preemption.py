@@ -1,0 +1,508 @@
+"""Preemption-aware training + verified checkpoint restore.
+
+The failure matrix rows this file covers (ISSUE 4): announced node loss
+(PREEMPTING drain → emergency checkpoint → gang restart excluding the
+node, failure budget untouched) and storage corruption (manifest-verified
+restore with quarantine + fallback, torn-dir GC).
+"""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core import chaos
+from ray_tpu.core.scheduler import NodeAffinitySchedulingStrategy
+
+
+@pytest.fixture
+def nodes4():
+    """4 logical nodes x 1 CPU: a 3-worker gang spans 3 nodes, leaving
+    one node free for the post-preemption restart."""
+    rt = ray_tpu.init(num_cpus=1, num_nodes=4, detect_accelerators=False)
+    yield rt
+    chaos.clear_chaos()
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def nodes2():
+    rt = ray_tpu.init(num_cpus=2, num_nodes=2, detect_accelerators=False)
+    yield rt
+    chaos.clear_chaos()
+    ray_tpu.shutdown()
+
+
+# ------------------------------------------------------------ chaos arming
+
+
+def test_chaos_preempt_env_parsing(monkeypatch):
+    monkeypatch.setenv(
+        "RAY_TPU_CHAOS",
+        "preempt_node=1,preempt_warning_s=2.5,name_filter=trig,max_injections=1",
+    )
+    chaos.load_from_env()
+    cfg = chaos._state.config
+    assert cfg.preempt_node is True
+    assert cfg.preempt_warning_s == 2.5
+    assert cfg.name_filter == "trig"
+    assert cfg.max_injections == 1
+    chaos.clear_chaos()
+
+
+def test_preempt_hook_fires_once_with_node(monkeypatch):
+    """preempt_node consumes the injection budget and hands (node,
+    warning, reason) to the registered hook instead of erroring/killing."""
+    calls = []
+    chaos.set_preemption_hook(lambda node, w, r: calls.append((node, w, r)))
+    try:
+        chaos.set_chaos(preempt_node=True, preempt_warning_s=1.5,
+                        name_filter="victim", max_injections=1)
+        chaos.maybe_inject("innocent", node="A")
+        assert calls == []
+        chaos.maybe_inject("victim-task", node="B")
+        assert len(calls) == 1 and calls[0][0] == "B" and calls[0][1] == 1.5
+        chaos.maybe_inject("victim-task", node="B")  # budget exhausted
+        assert len(calls) == 1
+    finally:
+        chaos.clear_chaos()
+        chaos.set_preemption_hook(None)
+
+
+# ------------------------------------------------------- drain semantics
+
+
+def test_drain_stops_new_placements(nodes2):
+    """A PREEMPTING node takes no new tasks, actors, or PG bundles while
+    it is still alive inside its warning window."""
+    rt = nodes2
+    victim = next(n for n in rt.scheduler.nodes() if not n.is_head)
+    rt.preempt_node(victim, warning_s=60.0, reason="drill")
+    assert victim.draining and victim.alive
+
+    @ray_tpu.remote
+    def where():
+        return 1
+
+    ray_tpu.get([where.remote() for _ in range(8)], timeout=30)
+    placed = {e["node"] for e in rt.task_events() if e["name"] == "where"}
+    assert victim.node_id.hex() not in placed
+
+    # PG planning skips it: 2x{CPU:2} cannot fit on the one placeable node
+    from ray_tpu.core.exceptions import PlacementGroupUnschedulableError
+
+    with pytest.raises(PlacementGroupUnschedulableError):
+        ray_tpu.placement_group([{"CPU": 2}, {"CPU": 2}], strategy="SPREAD")
+    pg = ray_tpu.placement_group([{"CPU": 1}])  # fits the survivor
+    assert pg.ready(timeout=10)
+    assert pg.bundles[0].node is not victim
+
+    # actors avoid it too
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return "ok"
+
+    a = A.options(num_cpus=1).remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=10) == "ok"
+    for ar in rt._actors.values():
+        assert ar._node is not victim
+
+    # observability: the state API shows the node PREEMPTING
+    from ray_tpu.util import state
+
+    states = {n["node_id"]: n["state"] for n in state.list_nodes()}
+    assert states[victim.node_id.hex()] == "PREEMPTING"
+
+
+def test_preempted_node_dies_after_window(nodes2):
+    rt = nodes2
+    victim = next(n for n in rt.scheduler.nodes() if not n.is_head)
+    rt.preempt_node(victim, warning_s=0.2, reason="drill")
+    deadline = time.monotonic() + 10
+    while victim.alive and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert not victim.alive
+    assert victim not in rt.scheduler.nodes()
+
+
+def test_sigterm_handler_begins_preemption():
+    """health.install_preemption_signal_handler: SIGTERM = announced
+    preemption, routed into ctx.begin_preemption with fate=shutdown."""
+    import signal
+
+    from ray_tpu.core.health import install_preemption_signal_handler
+
+    calls = []
+
+    class _Ctx:
+        def begin_preemption(self, reason, warning_s=None, fate=None):
+            calls.append((reason, fate))
+
+    prev = install_preemption_signal_handler(_Ctx())
+    try:
+        os.kill(os.getpid(), signal.SIGTERM)
+        deadline = time.monotonic() + 5
+        while not calls and time.monotonic() < deadline:
+            time.sleep(0.01)
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+    assert calls and calls[0][1] == "shutdown"
+    assert "SIGTERM" in calls[0][0]
+
+
+# ------------------------------------------------------- capstone drill
+
+
+def test_preempt_drill_capstone(nodes4, tmp_path):
+    """A 3-worker gang under chaos preempt_node: emergency checkpoint
+    inside the warning window, restart EXCLUDING the preempting node,
+    resume from that checkpoint — with max_failures=0, so any budget
+    consumption would fail the run."""
+    from ray_tpu.train import (
+        FailureConfig, RunConfig, RunStatus, ScalingConfig, TrainController,
+    )
+
+    rt = nodes4
+    starts = []        # first step of each attempt
+    ckpt_steps = []    # every checkpoint step written
+    emergency = []     # steps checkpointed BECAUSE of should_checkpoint()
+
+    def train_fn(config):
+        from ray_tpu import train
+
+        ctx = train.get_context()
+        state = train.get_checkpoint()
+        start = int(state["step"]) + 1 if state is not None else 0
+        if ctx.world_rank == 0:
+            starts.append(start)
+        for step in range(start, 60):
+            time.sleep(0.02)  # one "train step"
+            if ctx.world_rank != 0:
+                if train.is_preempted():
+                    return "preempted"  # yield: the node dies soon
+                continue
+            if train.should_checkpoint():
+                # emergency checkpoint at the CURRENT step
+                train.report({"step": step}, checkpoint={"step": step},
+                             checkpoint_step=step)
+                emergency.append(step)
+                ckpt_steps.append(step)
+            elif train.is_preempted():
+                return "preempted"  # emergency checkpoint already taken
+            elif step % 10 == 9:
+                train.report({"step": step}, checkpoint={"step": step},
+                             checkpoint_step=step)
+                ckpt_steps.append(step)
+            else:
+                train.report({"step": step})
+        return "done"
+
+    controller = TrainController(
+        train_fn,
+        ScalingConfig(num_workers=3),
+        RunConfig(name="preempt-drill", storage_path=str(tmp_path / "trial"),
+                  failure=FailureConfig(max_failures=0)),
+        train_config={},
+        restart_backoff_s=0.0,
+    )
+    box = {}
+
+    def run():
+        box["result"] = controller.run()
+
+    thread = threading.Thread(target=run, daemon=True)
+    thread.start()
+    # wait for the gang to be running (first reports flowing)
+    deadline = time.monotonic() + 60
+    while not controller.metrics_history and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert controller.metrics_history, "gang never started reporting"
+
+    # arm chaos and dispatch the trigger task onto a node hosting a gang
+    # worker (its 1 CPU is held by the worker, so pick any full node)
+    chaos.set_chaos(preempt_node=True, preempt_warning_s=3.0,
+                    name_filter="preempt-trigger", max_injections=1)
+    victim = next(
+        n for n in rt.scheduler.nodes()
+        if n.resources.available().get("CPU", 0.0) < 0.5
+    )
+
+    @ray_tpu.remote(name="preempt-trigger", num_cpus=0)
+    def trigger():
+        return "sent"
+
+    ref = trigger.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(victim.node_id)
+    ).remote()
+    assert ray_tpu.get(ref, timeout=30) == "sent"
+
+    thread.join(timeout=120)
+    assert not thread.is_alive(), "controller never finished"
+    result = box["result"]
+    assert result.status == RunStatus.FINISHED, result.error
+    # announced preemption: separate counter, failure budget untouched
+    assert result.num_preempt_restarts == 1
+    assert result.num_restarts == 0
+    assert victim.draining
+    # the emergency checkpoint landed inside the window...
+    assert emergency, "no emergency checkpoint was taken"
+    # ...and the restart resumed FROM it: within one checkpoint interval
+    assert len(starts) == 2
+    assert starts[1] == max(emergency) + 1
+    assert result.checkpoint_step is not None
+
+
+# --------------------------------------------- controller resume satellite
+
+
+def test_resume_from_step_propagates_with_none_config(runtime):
+    """controller.py satellite: train_config=None must not drop
+    resume_from_step on restart — it defaults to {} and the train_fn
+    receives the step."""
+    from ray_tpu.train import (
+        FailureConfig, RunConfig, RunStatus, ScalingConfig, TrainController,
+    )
+
+    seen = []
+
+    def train_fn(config=None):
+        from ray_tpu import train
+
+        seen.append(None if config is None else config.get("resume_from_step"))
+        if len(seen) == 1:
+            train.report({"loss": 1.0}, checkpoint_step=7)
+            raise RuntimeError("first attempt dies")
+        train.report({"loss": 0.1})
+        return "ok"
+
+    controller = TrainController(
+        train_fn,
+        ScalingConfig(num_workers=1),
+        RunConfig(name="resume-none",
+                  failure=FailureConfig(max_failures=1)),
+        train_config=None,
+        restart_backoff_s=0.0,
+    )
+    result = controller.run()
+    assert result.status == RunStatus.FINISHED
+    assert seen == [None, 7]
+
+
+# -------------------------------------------------- checkpoint retention
+
+
+def test_session_retention_configurable_and_protects_restore_step(tmp_path):
+    from ray_tpu.train.session import Session, TrainContext, list_checkpoints
+
+    session = Session(
+        TrainContext(0, 1, "ret", trial_dir=str(tmp_path)),
+        checkpoint_keep=4,
+    )
+    for step in range(6):
+        session.save_checkpoint({"step": step}, step)
+    assert len(list_checkpoints(str(tmp_path))) == 4
+
+    prot_dir = tmp_path / "prot"
+    session2 = Session(
+        TrainContext(0, 1, "ret2", trial_dir=str(prot_dir)),
+        checkpoint_keep=1,
+    )
+    session2.protect_step = 2  # a restore is pending on step 2
+    for step in range(6):
+        session2.save_checkpoint({"step": step}, step)
+    left = list_checkpoints(str(prot_dir))
+    assert left == ["ckpt_00000002.pkl", "ckpt_00000005.pkl"]
+
+
+def test_session_retention_flag_default(tmp_path, monkeypatch):
+    from ray_tpu.core.config import cfg
+    from ray_tpu.train.session import Session, TrainContext, list_checkpoints
+
+    monkeypatch.setenv("RAY_TPU_TRAIN_CKPT_KEEP", "3")
+    assert cfg.train_ckpt_keep == 3
+    session = Session(TrainContext(0, 1, "flag", trial_dir=str(tmp_path)))
+    for step in range(5):
+        session.save_checkpoint({"step": step}, step)
+    assert len(list_checkpoints(str(tmp_path))) == 3
+
+
+# ------------------------------------------------- verified restore (pkl)
+
+
+def _fallback_count(store: str) -> float:
+    from ray_tpu.util.metrics import registry
+
+    metric = registry().get("raytpu_train_ckpt_fallback_total")
+    if metric is None:
+        return 0.0
+    return sum(v for tags, v in metric.collect() if tags.get("store") == store)
+
+
+def test_corrupt_session_checkpoint_falls_back(tmp_path):
+    """Bit-rot in the newest pickle checkpoint: restore quarantines it
+    and falls back to the previous VALID step instead of raising."""
+    from ray_tpu.train.session import (
+        Session, TrainContext, list_checkpoints, load_trial_checkpoint,
+    )
+
+    trial = str(tmp_path)
+    session = Session(TrainContext(0, 1, "corrupt", trial_dir=trial),
+                      checkpoint_keep=5)
+    for step in (1, 2, 3):
+        session.save_checkpoint({"step": step}, step)
+    # flip bytes in the newest data file; its manifest now disagrees
+    victim = os.path.join(trial, "ckpt_00000003.pkl")
+    with open(victim, "r+b") as f:
+        f.seek(0)
+        f.write(b"\xde\xad\xbe\xef")
+    before = _fallback_count("session")
+    restored = load_trial_checkpoint(trial)
+    assert restored == {"step": 2}  # newest VALID step
+    assert _fallback_count("session") == before + 1
+    # quarantined out of the naming scheme, so it is not retried
+    assert "ckpt_00000003.pkl" not in list_checkpoints(trial)
+    assert os.path.exists(victim + ".corrupt")
+    # events carry the quarantine
+    from ray_tpu.util.events import events
+
+    msgs = [e["message"] for e in events().list(source="train", limit=50)]
+    assert any("quarantined corrupt checkpoint" in m for m in msgs)
+
+
+def test_torn_session_checkpoint_gc(tmp_path):
+    from ray_tpu.train.session import Session, TrainContext, gc_torn_checkpoints
+
+    trial = str(tmp_path)
+    os.makedirs(trial, exist_ok=True)
+    # a crash mid-save strands the staging file and an orphan manifest
+    with open(os.path.join(trial, "ckpt_00000009.pkl.tmp"), "wb") as f:  # atomic-ok: test fixture simulating a torn write
+        f.write(b"torn")
+    with open(os.path.join(trial, "ckpt_00000008.pkl.manifest.json"), "w") as f:  # atomic-ok: test fixture
+        f.write("{}")
+    assert gc_torn_checkpoints(trial) == 2
+    # save_checkpoint GCs implicitly too
+    session = Session(TrainContext(0, 1, "gc", trial_dir=trial))
+    with open(os.path.join(trial, "ckpt_00000010.pkl.tmp"), "wb") as f:  # atomic-ok: test fixture
+        f.write(b"torn")
+    session.save_checkpoint({"ok": True}, 11)
+    assert not os.path.exists(os.path.join(trial, "ckpt_00000010.pkl.tmp"))
+
+
+# ----------------------------------------------- verified restore (orbax)
+
+
+def test_orbax_manifest_commit_fallback_and_gc(tmp_path):
+    import numpy as np
+    import jax.numpy as jnp
+
+    from ray_tpu.train.checkpoint import (
+        COMMIT_NAME, CheckpointManager, MANIFEST_NAME,
+    )
+
+    d = str(tmp_path / "ckpt")
+    mgr = CheckpointManager(d, max_to_keep=5)
+    mgr.save(1, {"w": jnp.arange(8.0) * 1.0})
+    mgr.save(2, {"w": jnp.arange(8.0) * 2.0})
+    step_dir = os.path.join(d, "2")
+    assert os.path.exists(os.path.join(step_dir, COMMIT_NAME))
+    with open(os.path.join(step_dir, MANIFEST_NAME)) as f:
+        manifest = json.load(f)
+    assert manifest["files"], "manifest recorded no files"
+    # corrupt one manifested payload file of step 2
+    rel = sorted(manifest["files"])[0]
+    with open(os.path.join(step_dir, rel), "ab") as f:
+        f.write(b"bitrot")
+    before = _fallback_count("orbax")
+    restored = mgr.restore({"w": jnp.zeros(8)})
+    np.testing.assert_allclose(np.asarray(restored["w"]), np.arange(8.0))
+    assert _fallback_count("orbax") == before + 1
+    assert mgr.latest_step() == 1  # quarantined step left the step view
+    assert any(
+        name.startswith("2.corrupt") for name in os.listdir(d)
+    ), os.listdir(d)
+    mgr.close()
+
+    # torn-dir GC at init: an uncommitted integer step dir disappears
+    torn = os.path.join(d, "7")
+    os.makedirs(torn)
+    with open(os.path.join(torn, "junk"), "wb") as f:  # atomic-ok: test fixture simulating a torn save
+        f.write(b"partial")
+    mgr2 = CheckpointManager(d, max_to_keep=5)
+    assert not os.path.exists(torn)
+    assert mgr2.all_steps() == [1]
+    restored2 = mgr2.restore({"w": jnp.zeros(8)})
+    np.testing.assert_allclose(np.asarray(restored2["w"]), np.arange(8.0))
+    mgr2.close()
+
+
+# ------------------------------------------------------- pubsub satellite
+
+
+def test_pubsub_subscriber_failure_warns_once():
+    from ray_tpu.core.gcs import GlobalControlStore
+    from ray_tpu.util.events import events
+
+    gcs = GlobalControlStore()
+
+    def bad(_msg):
+        raise RuntimeError("dead listener")
+
+    gcs.pubsub.subscribe("preempt-test-chan", bad)
+    gcs.pubsub.publish("preempt-test-chan", {"n": 1})
+    gcs.pubsub.publish("preempt-test-chan", {"n": 2})
+    warnings = [
+        e for e in events().list(source="gcs", limit=200)
+        if "preempt-test-chan" in e["message"]
+    ]
+    assert len(warnings) == 1, warnings
+    # a healthy subscriber still receives everything
+    got = []
+    gcs.pubsub.subscribe("preempt-test-chan", got.append)
+    gcs.pubsub.publish("preempt-test-chan", {"n": 3})
+    assert got == [{"n": 3}]
+
+
+# ------------------------------------------------------------ static check
+
+
+def test_atomic_writes_static_check():
+    """Tier-1 wiring for scripts/check_atomic_writes.py: every
+    state-persisting write in train/ and core/gcs.py stages through
+    tmp + os.replace — and the checker flags a tree that does not."""
+    import pathlib
+    import subprocess
+    import sys as _sys
+
+    repo = pathlib.Path(__file__).resolve().parent.parent
+    script = repo / "scripts" / "check_atomic_writes.py"
+    proc = subprocess.run(
+        [_sys.executable, str(script)], capture_output=True, text=True
+    )
+    assert proc.returncode == 0, proc.stderr
+
+    import importlib.util
+    import tempfile
+
+    spec = importlib.util.spec_from_file_location("caw", script)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    with tempfile.TemporaryDirectory() as tmp:
+        bad_root = pathlib.Path(tmp)
+        (bad_root / "train").mkdir(parents=True)
+        (bad_root / "core").mkdir()
+        (bad_root / "core" / "gcs.py").write_text(
+            'def snap(path, blob):\n'
+            '    with open(path, "wb") as f:\n'
+            '        f.write(blob)\n'
+        )
+        (bad_root / "train" / "ckpt.py").write_text(
+            'import json\n'
+            'def save(path, obj):\n'
+            '    with open(path, "w") as f:\n'
+            '        json.dump(obj, f)\n'
+        )
+        assert mod.main(["caw", str(bad_root)]) == 1
