@@ -79,6 +79,48 @@ def ring_kernel_bench() -> dict:
     }
 
 
+def _collect_telemetry(step, state, batch, n_steps: int = 5) -> dict:
+    """Per-step latency histogram + node stats riding along with the
+    headline number, so BENCH_*.json rounds carry telemetry instead of
+    a single scalar. Separately-synced steps (outside the throughput
+    window — a per-step device sync would skew it)."""
+    from ray_tpu.core.stats import sample_process_rss_bytes, sample_tpu_stats
+    from ray_tpu.util.metrics import get_or_create_histogram, registry
+
+    hist = get_or_create_histogram(
+        "raytpu_bench_step_seconds",
+        "Wall-clock duration of individually synced benchmark steps.",
+        boundaries=(0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0),
+    )
+    durations = []
+    for _ in range(n_steps):
+        t0 = time.perf_counter()
+        state, metrics = step(state, batch)
+        float(metrics["loss"])  # device read = true sync
+        durations.append(time.perf_counter() - t0)
+        hist.observe(durations[-1])
+    ((_, data),) = hist.collect()
+    return {
+        "step_seconds": {
+            "mean": round(sum(durations) / len(durations), 5),
+            "min": round(min(durations), 5),
+            "max": round(max(durations), 5),
+            "count": data["count"],
+            "buckets": [[b, c] for b, c in data["buckets"]],
+        },
+        "node": {
+            "rss_bytes": sample_process_rss_bytes(),
+            "tpu": sample_tpu_stats(),
+        },
+        # the full exposition is greppable from the round artifacts
+        "metrics_names": sorted(
+            {line.split(" ", 3)[2]
+             for line in registry().prometheus_text().splitlines()
+             if line.startswith("# TYPE ")}
+        ),
+    }
+
+
 def main() -> None:
     from ray_tpu.models import count_params, get_config
     from ray_tpu.parallel import MeshSpec, build_mesh
@@ -112,6 +154,10 @@ def main() -> None:
     elapsed = time.perf_counter() - t0
 
     tokens_per_sec = MEASURE_STEPS * BATCH * SEQ / elapsed
+    try:
+        telemetry = _collect_telemetry(step, state, batch)
+    except Exception:  # noqa: BLE001 - the headline number must still print
+        telemetry = {}
     # 6ND fwd+bwd matmul flops + attention term 12*L*H*S^2*Dh ~= small here
     flops_per_token = 6 * n_params
     device_kind = getattr(devices[0], "device_kind", "unknown")
@@ -134,6 +180,7 @@ def main() -> None:
                 "mfu": round(mfu, 4),
                 "batch": BATCH,
                 "seq": SEQ,
+                "telemetry": telemetry,
                 **ring,
             }
         )

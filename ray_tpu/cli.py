@@ -106,14 +106,23 @@ def _cmd_config(args) -> int:
 
 
 def _cmd_status(args) -> int:
+    """Autoscaler-style cluster debug summary (reference `ray status`):
+    per-node resources/usage/telemetry, pending demand, actors, PG
+    states, object-store totals, recent warnings. --address joins an
+    existing cluster as an observer; otherwise an in-process runtime is
+    inspected. --json emits the machine shape instead."""
     import ray_tpu
     from .util import state
 
-    ray_tpu.init(detect_accelerators=not args.no_tpu)
-    print(json.dumps(state.summary(), indent=2, default=str))
-    for n in state.list_nodes():
-        print(f"node {n['node_id'][:12]} head={n['is_head']} "
-              f"avail={n['resources_available']}")
+    if args.address:
+        _observer_init(args)
+        time.sleep(1.0)  # let the cluster view + node table populate
+    else:
+        ray_tpu.init(detect_accelerators=not args.no_tpu)
+    if args.json:
+        print(json.dumps(state.summary(), indent=2, default=str))
+    else:
+        print(state.status_report(verbose=args.verbose))
     ray_tpu.shutdown()
     return 0
 
@@ -217,13 +226,11 @@ def _cmd_timeline(args) -> int:
         print("no live runtime in this process; timeline covers the "
               "current session only", file=sys.stderr)
         ray_tpu.init(detect_accelerators=False)
-    if args.trace:
-        # span-based distributed trace (util/tracing): nested
-        # submit→queue→dispatch→execute→result causality, stitched
-        # across nodes; supersedes the flat completed-task dump
-        state.trace_dump(args.output, trace_id=args.trace_id)
-    else:
-        state.chrome_tracing_dump(args.output)
+    # span-based distributed trace (util/tracing): nested
+    # submit→queue→dispatch→execute→result causality, stitched across
+    # nodes. --trace is the historical opt-in; chrome_tracing_dump is a
+    # deprecated alias of trace_dump now, so both paths export spans.
+    state.trace_dump(args.output, trace_id=args.trace_id)
     print(f"wrote {args.output} (open in chrome://tracing or Perfetto)")
     return 0
 
@@ -252,7 +259,15 @@ def build_parser() -> argparse.ArgumentParser:
 
     sub.add_parser("doctor", help="check the JAX/TPU environment")
     sub.add_parser("config", help="print all config flags")
-    sub.add_parser("status", help="start a runtime and print cluster state")
+    sp = sub.add_parser(
+        "status", help="cluster debug summary: nodes, usage, telemetry"
+    )
+    sp.add_argument("--address", help="head GCS address to join as observer")
+    sp.add_argument("--token", default=None)
+    sp.add_argument("--verbose", "-v", action="store_true",
+                    help="also show per-node log tails")
+    sp.add_argument("--json", action="store_true",
+                    help="emit state.summary() JSON instead of the report")
 
     st = sub.add_parser("start", help="start a cluster head or join one")
     st.add_argument("--head", action="store_true",

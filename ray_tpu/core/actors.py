@@ -367,7 +367,11 @@ class ActorRuntime:
                    "task_id": call.task_id.hex()},
         )
         try:
-            with tracing.use_context(exec_span.context):
+            from ..util import logs as _logs
+
+            with tracing.use_context(exec_span.context), \
+                    _logs.attribution(
+                        f"actor:{self.name}:{call.method_name}"):
                 self._execute_inner(call)
         finally:
             exec_span.end()

@@ -505,6 +505,8 @@ class ClusterContext:
         self.server.register("node_logs", self._node_logs)
         self.server.register("node_events", self._node_events)
         self.server.register("node_spans", self._node_spans)
+        self.server.register("metrics_snapshot", self._metrics_snapshot)
+        self.server.register("node_stats", self._node_stats)
         self.address = self.server.address
 
         self.gcs = GcsClient(gcs_address, token=self.token)
@@ -561,6 +563,10 @@ class ClusterContext:
         # cursor the watch loop reads peer preemptions from
         self._preempting = False
         self._preempt_since = 0.0
+        # this node's table entry (kept current locally so the stats
+        # piggyback can republish without a read-modify-write race)
+        self._info: Dict[str, Any] = {}
+        self._last_stats_ts = 0.0
 
         store.set_cluster_hooks(
             fetch_remote=self._fetch_remote,
@@ -628,6 +634,7 @@ class ClusterContext:
             "hostname": socket.gethostname(),
             "joined_at": time.time(),
         }
+        self._info = info
         self.gcs.kv_put(self.node_id.hex(), info, namespace=NODE_NS)
         logger.info("node %s joined cluster at %s (gcs %s)",
                     self.node_id.hex()[:12], self.address, self.gcs_address)
@@ -636,6 +643,28 @@ class ClusterContext:
         self.gcs.report_resources(
             self.node_id.hex(), dict(self._local_node.resources.available())
         )
+        self._report_stats()
+
+    def _report_stats(self) -> None:
+        """Telemetry piggyback on the heartbeat path: every
+        node_stats_period_s, publish this node's stats snapshot into its
+        GCS node-table entry (reference: the reporter agent streaming
+        node stats the head federates for `ray status`). Rides the same
+        failure envelope as the heartbeat — a GCS blip skips a period."""
+        from .config import cfg
+
+        period = cfg.node_stats_period_s
+        if period <= 0:
+            return
+        now = time.monotonic()
+        if now - self._last_stats_ts < period:
+            return
+        collector = getattr(self.runtime, "node_stats", None)
+        if collector is None or not self._info:
+            return
+        self._last_stats_ts = now
+        self._info["stats"] = collector.snapshot()
+        self.gcs.kv_put(self.node_id.hex(), self._info, namespace=NODE_NS)
 
     def _watch_loop(self) -> None:
         from .config import cfg
@@ -812,6 +841,9 @@ class ClusterContext:
                 "preempt_reason": reason,
                 "preempt_deadline": deadline,
             })
+            # keep the cached entry in sync: the stats piggyback
+            # republishes self._info and must not erase these flags
+            self._info.update(info)
             self.gcs.kv_put(self.node_id.hex(), info, namespace=NODE_NS)
         except (RpcError, OSError):
             pass
@@ -1999,6 +2031,12 @@ class ClusterContext:
             msg["_pool"].release(msg.get("resources") or {})
 
     def _run_agent_task_inner(self, msg: Dict[str, Any]) -> None:
+        from ..util import logs as _logs
+
+        with _logs.attribution(f"task:{msg['task_hex'][:8]}"):
+            self._run_agent_task_attrd(msg)
+
+    def _run_agent_task_attrd(self, msg: Dict[str, Any]) -> None:
         from .config import cfg
         from . import runtime_env as _renv
         from ..util import tracing
@@ -2508,6 +2546,21 @@ class ClusterContext:
         from ..util.tracing import tracer
 
         return tracer().spans(trace_id, int(limit))
+
+    def _metrics_snapshot(self) -> str:
+        """Serve this node's full Prometheus exposition — the head pulls
+        it over this RPC and merges every node's under per-sample
+        node_id labels (/metrics/cluster; reference: the head dashboard
+        federating each reporter agent's OpenCensus export)."""
+        from ..util.metrics import registry
+
+        return registry().prometheus_text()
+
+    def _node_stats(self) -> Dict[str, Any]:
+        """Serve this node's live stats snapshot (core/stats.py) for
+        callers that want structure, not exposition text."""
+        collector = getattr(self.runtime, "node_stats", None)
+        return collector.snapshot() if collector is not None else {}
 
     def _node_info(self) -> Dict[str, Any]:
         return {

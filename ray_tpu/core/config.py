@@ -183,6 +183,32 @@ define_flag("trace_sample_ratio", 1.0,
 define_flag("trace_buffer_spans", 50_000,
             "Per-process ring-buffer capacity for completed trace spans.")
 
+# telemetry plane (node stats collection + watchdogs)
+define_flag("node_stats_period_s", 2.0,
+            "Interval at which a cluster node piggybacks its stats "
+            "snapshot into the GCS node table (0 = disabled).")
+define_flag("train_stall_window_s", 30.0,
+            "Training stall watchdog: no worker report for this long "
+            "flips raytpu_train_stalled and emits a WARNING (0 = off).")
+define_flag("train_stall_factor", 6.0,
+            "Training stall watchdog: a worker whose report gap exceeds "
+            "factor x its EWMA step time is flagged as the straggler.")
+define_flag("train_stall_ewma_alpha", 0.25,
+            "EWMA smoothing for per-worker step-time tracking in the "
+            "stall watchdog (higher = faster adaptation).")
+define_flag("train_stall_min_s", 1.0,
+            "Floor on the EWMA-regression stall threshold so fast steps "
+            "with scheduler jitter do not flap the stalled gauge.")
+define_flag("serve_slo_ttft_p99_s", 0.0,
+            "Serve SLO monitor: p99 TTFT above this burns "
+            "raytpu_serve_slo_burn_total{slo=ttft_p99} (0 = disabled).")
+define_flag("serve_slo_queue_p99_s", 0.0,
+            "Serve SLO monitor: p99 engine queue wait above this burns "
+            "raytpu_serve_slo_burn_total{slo=queue_p99} (0 = disabled).")
+define_flag("serve_slo_check_period_s", 5.0,
+            "Interval between serve SLO monitor evaluations of the PR-2 "
+            "latency histograms.")
+
 # memory monitor / OOM
 define_flag("memory_monitor_interval_s", 0.25,
             "Polling interval of the host memory monitor (0 = disabled).")

@@ -69,6 +69,8 @@ class PubSub:
         # (channel, callback) pairs that already produced one WARNING:
         # a permanently broken subscriber must be visible, not spam
         self._warned: set = set()
+        # telemetry: ships in the node stats snapshot (core/stats.py)
+        self.stats = {"published": 0, "delivered": 0, "subscriber_errors": 0}
 
     def publish(self, channel: str, message: Any) -> None:
         with self._lock:
@@ -77,9 +79,11 @@ class PubSub:
             hist = self._history[channel]
             if len(hist) > 1000:
                 del hist[: len(hist) - 1000]
+            self.stats["published"] += 1
         for cb in subs:
             try:
                 cb(message)
+                self.stats["delivered"] += 1
             except Exception as exc:  # noqa: BLE001 - subscriber bugs must not kill publishers
                 # One WARNING event per (channel, callback) lifetime (the
                 # metrics-sampler pattern): a dead preemption/failover
@@ -88,6 +92,7 @@ class PubSub:
                 with self._lock:
                     first = key not in self._warned
                     self._warned.add(key)
+                    self.stats["subscriber_errors"] += 1
                 if first:
                     from ..util.events import emit
 

@@ -99,7 +99,13 @@ class HealthCheckManager:
             dead.append(target_id)
             self.stats["deaths"] += 1
             from ..util.events import emit
+            from ..util.metrics import get_or_create_counter
 
+            get_or_create_counter(
+                "raytpu_health_deaths_total",
+                "Targets (process actors, nodes) declared dead by the "
+                "health-check manager.",
+            ).inc()
             emit("WARNING", "health", f"{target_id} declared dead")
             logger.warning("health check: %s declared dead", target_id)
             try:

@@ -192,6 +192,16 @@ class Runtime:
         from ..util import logs as _logs
 
         _logs.install()
+        _logs.set_node_id(self.scheduler.head_node().node_id.hex())
+        # telemetry plane: per-node stats sampling + node-local gauges
+        # (core/stats.py); the cluster heartbeat piggybacks snapshots
+        # into the GCS node table and /metrics federates head-side
+        from . import stats as _stats
+        from ..util.metrics import register_runtime_gauges
+
+        self.node_stats = _stats.NodeStatsCollector(self)
+        _stats.register_node_gauges()
+        register_runtime_gauges()
         # multi-process cluster membership (core/cluster.py): the head
         # serves its GCS over RPC; workers join an existing head. Either
         # way this process gains a node server + remote dispatch.

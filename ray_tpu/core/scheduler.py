@@ -1236,10 +1236,13 @@ class ClusterScheduler:
         )
         try:
             from . import chaos, runtime_env as _renv
+            from ..util import logs as _logs
 
             # current-span context active for the task body: nested
-            # submits/gets/transfers parent into this execution span
-            with tracing.use_context(exec_span.context):
+            # submits/gets/transfers parent into this execution span;
+            # log records emitted inside it carry the task attribution
+            with tracing.use_context(exec_span.context), \
+                    _logs.attribution(f"task:{spec.task_id.hex()[:8]}"):
                 chaos.maybe_inject(spec.name, node=node)
                 if spec.executor == "process":
                     # Pooled worker process (GIL-free); SHM-tier args ship

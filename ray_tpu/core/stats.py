@@ -1,0 +1,246 @@
+"""Per-node stats collection: the sampling half of the telemetry plane.
+
+Reference parity: the per-node reporter agent
+(/root/reference/python/ray/dashboard/modules/reporter/reporter_agent.py)
+sampling CPU/memory/GPU and the raylet's resource broadcast that
+`ray status` aggregates head-side. TPU inversion: one process per node
+means one collector per process — it samples process CPU/RSS, the
+object store, worker-pool occupancy, task queue depths, and TPU device
+telemetry (HBM via ``Device.memory_stats()``), and the cluster
+heartbeat piggybacks the snapshot into the GCS node table
+(core/cluster.py) so the head can federate without a second agent.
+
+Everything here is read-only and failure-isolated: a sampler that
+cannot read its source returns a degraded snapshot, never raises into
+the heartbeat or scrape path.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import sys
+import threading
+import time
+from typing import Any, Dict, List
+
+_PAGE_SIZE = os.sysconf("SC_PAGE_SIZE") if hasattr(os, "sysconf") else 4096
+
+
+def sample_process_rss_bytes() -> int:
+    """Resident set size of THIS process, from /proc (no psutil)."""
+    try:
+        with open("/proc/self/statm") as f:
+            return int(f.read().split()[1]) * _PAGE_SIZE
+    except (OSError, ValueError, IndexError):
+        try:
+            import resource
+
+            # ru_maxrss is KiB on Linux: peak, not current — still a
+            # usable degraded signal on platforms without /proc
+            return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+        except Exception:  # noqa: BLE001 - degraded snapshot over a raise
+            return 0
+
+
+def sample_tpu_stats() -> List[Dict[str, Any]]:
+    """Per-device accelerator telemetry: HBM used/limit/peak plus a duty
+    proxy (fraction of HBM in use — on TPU a loaded program keeps its
+    working set resident, so HBM occupancy tracks whether the chip is
+    actually hosting work). Guarded three ways: jax must ALREADY be
+    imported (an observer CLI must not pay the import), devices must be
+    accelerators (CPU "devices" have no memory_stats), and a raising
+    memory_stats() degrades to an empty list, never into the caller."""
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return []
+    out: List[Dict[str, Any]] = []
+    try:
+        devices = jax.local_devices()
+    except Exception:  # noqa: BLE001 - no backend: no device telemetry
+        return []
+    for d in devices:
+        platform = getattr(d, "platform", "cpu")
+        if platform == "cpu":
+            continue
+        rec: Dict[str, Any] = {
+            "id": getattr(d, "id", -1),
+            "kind": getattr(d, "device_kind", platform),
+            "platform": platform,
+        }
+        try:
+            mem = d.memory_stats()
+        except Exception:  # noqa: BLE001 - backend without memory_stats
+            mem = None
+        if mem:
+            used = int(mem.get("bytes_in_use", 0))
+            limit = int(mem.get("bytes_limit", 0))
+            rec["hbm_used_bytes"] = used
+            rec["hbm_limit_bytes"] = limit
+            rec["hbm_peak_bytes"] = int(mem.get("peak_bytes_in_use", used))
+            rec["duty"] = round(used / limit, 4) if limit > 0 else 0.0
+        out.append(rec)
+    return out
+
+
+class NodeStatsCollector:
+    """Samples this node's (process's) runtime internals into one
+    snapshot dict. One collector per Runtime; `snapshot()` is cheap
+    enough for the heartbeat period and the /metrics scrape."""
+
+    def __init__(self, runtime):
+        self._runtime = runtime
+        self._lock = threading.Lock()
+        # CPU%: delta of process CPU time over delta wall time
+        self._last_wall = time.monotonic()
+        self._last_cpu = self._cpu_seconds()
+        self._cpu_percent = 0.0
+
+    @staticmethod
+    def _cpu_seconds() -> float:
+        t = os.times()
+        return t.user + t.system
+
+    def _sample_cpu_percent(self) -> float:
+        now = time.monotonic()
+        cpu = self._cpu_seconds()
+        with self._lock:
+            dw = now - self._last_wall
+            if dw >= 0.1:  # too-close samples would just amplify noise
+                self._cpu_percent = max(
+                    0.0, 100.0 * (cpu - self._last_cpu) / dw
+                )
+                self._last_wall, self._last_cpu = now, cpu
+            return round(self._cpu_percent, 2)
+
+    def _sample_worker_pool(self) -> Dict[str, Any]:
+        """Occupancy of the process worker pool WITHOUT spawning it."""
+        from . import worker_pool as wp
+
+        pool = wp._pool
+        if pool is None:
+            return {"busy": 0, "idle": 0, "started": False}
+        with pool._lock:
+            return {
+                "busy": len(pool._busy),
+                "idle": len(pool._idle),
+                "started": True,
+            }
+
+    def _sample_task_queues(self) -> Dict[str, int]:
+        sched = self._runtime.scheduler
+        cluster = getattr(self._runtime, "cluster", None)
+        with sched._lock:
+            pending = len(sched._pending)
+            blocked = len(sched._blocked)
+        admission = 0
+        if cluster is not None:
+            with cluster._admit_lock:
+                admission = len(cluster._admit_queue)
+        return {"pending": pending, "blocked": blocked,
+                "admission": admission}
+
+    def snapshot(self) -> Dict[str, Any]:
+        """One telemetry snapshot of this node. Keys are stable: the GCS
+        node table, `state.summary()["node_stats"]`, and `ray_tpu
+        status` all render this shape."""
+        rt = self._runtime
+        cluster = getattr(rt, "cluster", None)
+        snap: Dict[str, Any] = {
+            "ts": time.time(),
+            "pid": os.getpid(),
+            "hostname": socket.gethostname(),
+            "cpu_percent": self._sample_cpu_percent(),
+            "rss_bytes": sample_process_rss_bytes(),
+            "object_store": dict(rt.object_store.usage()),
+            "worker_pool": self._sample_worker_pool(),
+            "task_queues": self._sample_task_queues(),
+            "scheduler": dict(rt.scheduler.stats),
+            "health": dict(rt.health.stats),
+            "pubsub": dict(getattr(rt.gcs.pubsub, "stats", {})),
+            "tpu": sample_tpu_stats(),
+        }
+        if cluster is not None:
+            snap["agent"] = dict(cluster.agent_stats)
+        return snap
+
+
+def register_node_gauges() -> None:
+    """Node-local callback gauges over the collector (scrape-time
+    sampling; every callback rides Gauge.collect's sampler-failure
+    guard). Idempotent — safe across runtime re-inits."""
+    from ..util.metrics import get_or_create_gauge
+    from . import runtime as rt
+
+    def collector():
+        if not rt.is_initialized():
+            return None
+        return getattr(rt.get_runtime(), "node_stats", None)
+
+    def cpu_percent():
+        c = collector()
+        return 0.0 if c is None else float(c._sample_cpu_percent())
+
+    get_or_create_gauge(
+        "raytpu_node_cpu_percent",
+        "Process CPU utilization of this node agent, percent.",
+        fn=cpu_percent,
+    )
+    get_or_create_gauge(
+        "raytpu_node_rss_bytes",
+        "Resident set size of this node agent's process.",
+        fn=lambda: float(sample_process_rss_bytes()),
+    )
+
+    def worker_pool():
+        c = collector()
+        if c is None:
+            return []
+        wp = c._sample_worker_pool()
+        return [({"state": "busy"}, float(wp["busy"])),
+                ({"state": "idle"}, float(wp["idle"]))]
+
+    get_or_create_gauge(
+        "raytpu_node_worker_pool",
+        "Process worker pool occupancy (busy/idle workers).",
+        tag_keys=("state",), fn=worker_pool,
+    )
+
+    def task_queues():
+        c = collector()
+        if c is None:
+            return []
+        return [({"queue": k}, float(v))
+                for k, v in c._sample_task_queues().items()]
+
+    get_or_create_gauge(
+        "raytpu_node_task_queue_depth",
+        "Task queue depths: scheduler pending/blocked + agent admission.",
+        tag_keys=("queue",), fn=task_queues,
+    )
+
+    def tpu_metric(key):
+        def sample():
+            return [
+                ({"device": str(dev.get("id", i))}, float(dev[key]))
+                for i, dev in enumerate(sample_tpu_stats())
+                if key in dev
+            ]
+
+        return sample
+
+    get_or_create_gauge(
+        "raytpu_node_tpu_hbm_used_bytes",
+        "Per-device TPU HBM bytes in use.",
+        tag_keys=("device",), fn=tpu_metric("hbm_used_bytes"),
+    )
+    get_or_create_gauge(
+        "raytpu_node_tpu_hbm_limit_bytes",
+        "Per-device TPU HBM capacity.",
+        tag_keys=("device",), fn=tpu_metric("hbm_limit_bytes"),
+    )
+    get_or_create_gauge(
+        "raytpu_node_tpu_duty",
+        "Per-device duty proxy: fraction of HBM in use.",
+        tag_keys=("device",), fn=tpu_metric("duty"),
+    )

@@ -31,6 +31,7 @@ th{background:#eee} code{background:#eee;padding:0 .3em}
 <div id="err"></div>
 <h2>Cluster</h2><table id="summary"></table>
 <h2>Nodes</h2><table id="nodes"></table>
+<h2>Node telemetry</h2><table id="telemetry"></table>
 <h2>Actors</h2><table id="actors"></table>
 <h2>Recent tasks</h2><table id="tasks"></table>
 <h2>Jobs</h2><table id="jobs"></table>
@@ -52,6 +53,17 @@ async function refresh() {
     const s = await (await fetch("/api/summary")).json();
     fill("summary", [s]);
     fill("nodes", await (await fetch("/api/nodes")).json());
+    const ns = await (await fetch("/api/node_stats")).json();
+    fill("telemetry", Object.entries(ns).map(([node, t]) => ({
+      node: node.slice(0, 12),
+      cpu_pct: t.cpu_percent,
+      rss_mb: (t.rss_bytes / 1048576).toFixed(1),
+      store_bytes: (t.object_store || {}).host_bytes,
+      objects: (t.object_store || {}).num_objects,
+      pool: `${(t.worker_pool || {}).busy || 0} busy / ${(t.worker_pool || {}).idle || 0} idle`,
+      queues: t.task_queues,
+      tpu: (t.tpu || []).length,
+    })));
     fill("actors", await (await fetch("/api/actors")).json());
     const tasks = await (await fetch("/api/tasks")).json();
     fill("tasks", tasks.slice(-20).reverse());
@@ -177,6 +189,11 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send(200, json.dumps(self._api(parsed.path[5:], query)),
                            "application/json")
                 return
+            if self.path == "/metrics/cluster":
+                from .util.metrics import cluster_prometheus_text
+
+                self._send(200, cluster_prometheus_text(), "text/plain")
+                return
             if self.path == "/metrics":
                 from .util.metrics import registry
 
@@ -194,6 +211,12 @@ class _Handler(BaseHTTPRequestHandler):
             return state.summary()
         if name == "nodes":
             return state.list_nodes()
+        if name == "node_stats":
+            return state.node_stats()
+        if name == "cluster_metrics":
+            return state.cluster_metrics(raw=True)
+        if name == "status":
+            return {"report": state.status_report()}
         if name == "actors":
             return state.list_actors()
         if name == "tasks":
@@ -201,7 +224,9 @@ class _Handler(BaseHTTPRequestHandler):
         if name == "objects":
             return state.list_objects()
         if name == "timeline":
-            return json.loads(state.chrome_tracing_dump())
+            # trace_dump directly: chrome_tracing_dump is a deprecated
+            # alias of it now (same payload, minus the warning)
+            return json.loads(state.trace_dump())
         if name == "traces":
             return state.list_traces()
         if name == "trace":

@@ -66,6 +66,31 @@ def _retryable(err: BaseException) -> bool:
     return isinstance(unwrap_error(err), _RETRYABLE)
 
 
+# live deployments' replica sets, for the ongoing-requests gauge (weak:
+# a deleted deployment's series disappears instead of pinning the set)
+import weakref  # noqa: E402 - scoped to the telemetry plumbing below
+
+_replica_sets: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def _register_replica_set(rset: "ReplicaSet") -> None:
+    from ..util.metrics import get_or_create_gauge
+    from ..util.watchdog import ensure_serve_slo_monitor
+
+    _replica_sets.add(rset)
+    get_or_create_gauge(
+        "raytpu_serve_ongoing_requests",
+        "In-flight requests per deployment, from the router's ongoing "
+        "counts.",
+        tag_keys=("deployment",),
+        fn=lambda: [
+            ({"deployment": rs.name}, float(rs.total_ongoing()))
+            for rs in list(_replica_sets)
+        ],
+    )
+    ensure_serve_slo_monitor()
+
+
 def _retry_backoff_s(attempt: int) -> float:
     """Jittered exponential backoff before failover attempt N (1-based)."""
     from ..core.config import cfg
@@ -95,6 +120,15 @@ class ReplicaSet:
         # model-multiplex affinity: model_id -> MRU list of replica keys
         # (reference pow_2_scheduler.py is multiplex-aware the same way)
         self._affinity: Dict[str, List[str]] = {}
+        # telemetry: per-deployment ongoing gauge + the SLO monitor
+        # (watchdog) spins up once any serve_slo_* objective is set
+        _register_replica_set(self)
+
+    def total_ongoing(self) -> int:
+        """Requests currently in flight across this deployment's
+        replicas (the router-side queue-depth signal)."""
+        with self._lock:
+            return sum(self._ongoing.values())
 
     _key = staticmethod(_rkey)
 

@@ -108,6 +108,9 @@ class TrainController:
         # drained by the poll loop
         self._preempt_lock = threading.Lock()
         self._preempt_notices: "collections.deque" = collections.deque()
+        # stall/straggler watchdog of the CURRENT attempt (util/watchdog):
+        # fed from the poll loop, inspectable by tests/status tooling
+        self.stall_watchdog = None
 
     def decide_num_workers(self) -> int:
         """Elastic sizing (reference v2 ScalingPolicy): fit the gang to
@@ -344,11 +347,29 @@ class TrainController:
         """Returns None on clean completion, an error string on worker
         failure, or a _PreemptRestart when a hosting node announced its
         death (after waiting out the emergency-checkpoint window)."""
+        from ..util.watchdog import StallWatchdog
+
         result_refs = group.run_async(self.train_fn, self.train_config)
         cursors = [0] * group.num_workers
         notice: Optional[Dict[str, Any]] = None
         baseline_ckpt: Optional[int] = None
         flags_supported = True
+        # stall/straggler watchdog: every drained report feeds it; every
+        # poll cycle evaluates it (raytpu_train_stalled + WARNING events
+        # naming the straggler rank)
+        self.stall_watchdog = StallWatchdog(
+            self.run_config.name, group.num_workers
+        )
+        try:
+            return self._poll_cycle(
+                group, result_refs, cursors, notice, baseline_ckpt,
+                flags_supported,
+            )
+        finally:
+            self.stall_watchdog.close()
+
+    def _poll_cycle(self, group, result_refs, cursors, notice,
+                    baseline_ckpt, flags_supported):
         while True:
             if notice is None:
                 notice = self._next_preempt_notice(group)
@@ -385,6 +406,7 @@ class TrainController:
             for i, p in enumerate(polls):
                 for metrics, ckpt_step, rank, ts in p["reports"]:
                     cursors[i] += 1
+                    self.stall_watchdog.observe_report(rank, ts)
                     if rank == 0:
                         self.metrics_history.append(metrics)
                     if ckpt_step is not None:
@@ -404,6 +426,10 @@ class TrainController:
                                 attrs={"run": self.run_config.name,
                                        "step": ckpt_step, "rank": rank},
                             )
+                if p["done"]:
+                    # finished workers are not stragglers: silence from
+                    # them must not trip the stall watchdog
+                    self.stall_watchdog.mark_done(i)
                 if p["error"]:
                     if notice is not None:
                         return _PreemptRestart(
@@ -427,6 +453,7 @@ class TrainController:
                 except Exception as e:  # noqa: BLE001 - ferried to policy
                     return repr(e)
                 return None
+            self.stall_watchdog.check()
             time.sleep(self.poll_interval)
 
     def _got_emergency_ckpt(self, baseline: Optional[int]) -> bool:

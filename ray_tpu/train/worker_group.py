@@ -105,6 +105,10 @@ class WorkerGroup:
         self.pg: Optional[PlacementGroup] = pg
         self._owns_pg = pg is None
         self.workers: List[Any] = []
+        # telemetry: wall timestamp of each worker's newest report,
+        # updated by poll() — the stall watchdog's straggler ranking and
+        # `ray_tpu status` read gang progress from here
+        self.last_report_ts: List[float] = [0.0] * num_workers
 
     def start(self) -> None:
         if self.pg is None:
@@ -146,13 +150,23 @@ class WorkerGroup:
 
     def poll(self, since: List[int], should_checkpoint: bool = False,
              preempted: bool = False, preempt_deadline: float = 0.0):
-        return api.get(
+        polls = api.get(
             [
                 w.poll.remote(s, should_checkpoint, preempted, preempt_deadline)
                 for w, s in zip(self.workers, since)
             ],
             timeout=60,
         )
+        for i, p in enumerate(polls):
+            for _metrics, _ckpt, _rank, ts in p.get("reports", ()):
+                if i < len(self.last_report_ts):
+                    self.last_report_ts[i] = max(self.last_report_ts[i], ts)
+        return polls
+
+    def step_timestamps(self) -> List[float]:
+        """Per-worker newest report wall timestamps (0.0 = no report
+        yet) — gang progress for straggler ranking."""
+        return list(self.last_report_ts)
 
     def finish(self, result_refs, timeout=None):
         """Block for the run() results, raising any worker exception."""

@@ -4,25 +4,30 @@ from .metrics import (  # noqa: F401
     Counter,
     Gauge,
     Histogram,
+    cluster_prometheus_text,
     get_or_create_counter,
     get_or_create_gauge,
     get_or_create_histogram,
+    merge_cluster_expositions,
     register_runtime_gauges,
     registry,
     start_metrics_server,
 )
 from .state import (  # noqa: F401
     chrome_tracing_dump,
+    cluster_metrics,
     get_trace,
     list_actors,
     list_nodes,
     list_objects,
     list_tasks,
     list_traces,
+    node_stats,
+    status_report,
     summary,
     trace_dump,
 )
-from . import tracing  # noqa: F401
+from . import tracing, watchdog  # noqa: F401
 from .actor_pool import ActorPool  # noqa: F401
 from .profiling import (  # noqa: F401
     annotate,

@@ -11,14 +11,49 @@ logging to do so."""
 
 from __future__ import annotations
 
+import contextlib
+import contextvars
 import logging
 import threading
 from collections import deque
-from typing import Dict, List, Optional
+from typing import Dict, Iterator, List, Optional
+
+# -------------------------------------------------------------- attribution
+#
+# Captured lines carry their ORIGIN: a [node:...] prefix (set once per
+# process) and, when the record was emitted from inside a task/actor
+# execution path, a [task:...]/[actor:...] tag from the context-local
+# attribution — so cluster-aggregated tails (`ray_tpu status --verbose`,
+# the dashboard) can group lines even after nodes' tails are merged.
+
+_node_hex: Optional[str] = None
+_log_ctx: "contextvars.ContextVar[Optional[str]]" = contextvars.ContextVar(
+    "ray_tpu_log_attribution", default=None
+)
+
+
+def set_node_id(node_hex: str) -> None:
+    """Record this process's node id; captured lines get a
+    [node:<prefix>] tag from here on (idempotent, runtime init calls it)."""
+    global _node_hex
+    _node_hex = node_hex
+
+
+@contextlib.contextmanager
+def attribution(tag: str) -> Iterator[None]:
+    """Tag log records emitted inside the block with their originating
+    task/actor (e.g. "task:ab12cd34", "actor:Trainer"). Set by the
+    executing thread, so it composes with the reused task threads."""
+    token = _log_ctx.set(tag)
+    try:
+        yield
+    finally:
+        _log_ctx.reset(token)
 
 
 class RingBufferHandler(logging.Handler):
-    """Keeps the last N formatted log lines in memory."""
+    """Keeps the last N formatted log lines in memory, each prefixed
+    with its origin ([node:...] and the active task/actor attribution)."""
 
     def __init__(self, capacity: int = 5000):
         super().__init__()
@@ -31,6 +66,13 @@ class RingBufferHandler(logging.Handler):
     def emit(self, record: logging.LogRecord) -> None:
         try:
             line = self.format(record)
+            prefix = ""
+            if _node_hex:
+                prefix += f"[node:{_node_hex[:8]}] "
+            ctx = _log_ctx.get()
+            if ctx:
+                prefix += f"[{ctx}] "
+            line = prefix + line
         except Exception:  # noqa: BLE001 - logging must never raise
             return
         with self._lock2:

@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import bisect
 import threading
-from typing import Callable, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 TagDict = Dict[str, str]
 
@@ -262,18 +262,110 @@ def register_runtime_gauges() -> None:
     Gauge("raytpu_tasks_finished_total", "completed task events", fn=tasks_finished)
 
 
+# ------------------------------------------------------ head-side federation
+
+
+def _inject_label(line: str, key: str, value: str) -> str:
+    """Add one label to a Prometheus sample line. Label VALUES may
+    contain spaces/braces inside quotes, but metric NAMES cannot — so
+    the first '{' (when it precedes the first space) marks an existing
+    label set, else the first space splits name from value."""
+    brace = line.find("{")
+    space = line.find(" ")
+    pair = f'{key}="{_escape_label(value)}"'
+    if brace != -1 and (space == -1 or brace < space):
+        return f"{line[:brace + 1]}{pair},{line[brace + 1:]}"
+    if space == -1:
+        return line  # malformed; pass through untouched
+    return f"{line[:space]}{{{pair}}}{line[space:]}"
+
+
+def merge_cluster_expositions(parts: Dict[str, str],
+                              label: str = "node_id") -> str:
+    """Merge per-node Prometheus expositions into ONE parseable payload:
+    every sample line gains a `node_id` label, HELP/TYPE headers are
+    emitted once per metric family, and each family's samples stay
+    grouped under its header (the exposition-format grouping rule).
+
+    `parts` maps node id hex -> that node's /metrics text (the
+    `metrics_snapshot` RPC payload)."""
+    families: List[str] = []          # first-seen order
+    headers: Dict[str, List[str]] = {}  # family -> [# HELP, # TYPE]
+    samples: Dict[str, List[str]] = {}  # family -> labeled sample lines
+    for node_hex, text in parts.items():
+        family = None
+        for line in (text or "").splitlines():
+            if not line.strip():
+                continue
+            if line.startswith("# HELP ") or line.startswith("# TYPE "):
+                name = line.split(" ", 3)[2]
+                if name not in headers:
+                    headers[name] = []
+                    families.append(name)
+                    samples[name] = []
+                # keep the first node's header text (identical by
+                # construction; divergence would mean version skew)
+                if len(headers[name]) < 2 and line not in headers[name]:
+                    headers[name].append(line)
+                family = name
+                continue
+            labeled = _inject_label(line, label, node_hex)
+            if family is not None:
+                samples[family].append(labeled)
+            else:  # headerless line (foreign exporter): own family
+                name = line.split("{", 1)[0].split(" ", 1)[0]
+                if name not in headers:
+                    headers[name] = []
+                    families.append(name)
+                    samples[name] = []
+                samples[name].append(labeled)
+    lines: List[str] = []
+    for fam in families:
+        lines.extend(headers[fam])
+        lines.extend(samples[fam])
+    return "\n".join(lines) + "\n"
+
+
+def cluster_prometheus_text() -> str:
+    """The federated /metrics/cluster payload: this process's registry
+    plus every reachable node agent's (over the `metrics_snapshot` RPC),
+    merged with per-sample node_id labels. Degrades to the local
+    registry (labeled with the local node id) without a cluster."""
+    from ..core import runtime as rt
+
+    local_text = registry().prometheus_text()
+    if not rt.is_initialized():
+        return merge_cluster_expositions({"local": local_text})
+    runtime = rt.get_runtime()
+    ctx = getattr(runtime, "cluster", None)
+    if ctx is None:
+        local_hex = runtime.scheduler.head_node().node_id.hex()
+        return merge_cluster_expositions({local_hex: local_text})
+    parts: Dict[str, str] = {ctx.node_id.hex(): local_text}
+    fanned = ctx.fanout_nodes("metrics_snapshot", placeholder=lambda e: None)
+    for node_hex, text in fanned.items():
+        if text:
+            parts[node_hex] = text
+    return merge_cluster_expositions(parts)
+
+
 def start_metrics_server(host: str = "127.0.0.1", port: int = 0) -> int:
-    """Expose /metrics (Prometheus text); returns the bound port."""
+    """Expose /metrics (this process) and /metrics/cluster (federated,
+    node_id-labeled); returns the bound port."""
     import socketserver
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     class Handler(BaseHTTPRequestHandler):
         def do_GET(self):  # noqa: N802
-            if self.path.rstrip("/") not in ("", "/metrics".rstrip("/"), "/metrics"):
+            path = self.path.rstrip("/") or "/metrics"
+            if path == "/metrics/cluster":
+                body = cluster_prometheus_text().encode()
+            elif path in ("", "/metrics"):
+                body = registry().prometheus_text().encode()
+            else:
                 self.send_response(404)
                 self.end_headers()
                 return
-            body = registry().prometheus_text().encode()
             self.send_response(200)
             self.send_header("Content-Type", "text/plain; version=0.0.4")
             self.send_header("Content-Length", str(len(body)))

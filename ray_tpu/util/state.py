@@ -7,7 +7,8 @@ and GlobalState.chrome_tracing_dump (_private/state.py:442) feeding
 
 from __future__ import annotations
 
-import json
+import time
+import warnings
 from typing import Any, Dict, List, Optional
 
 from ..core import runtime as _rt
@@ -73,6 +74,25 @@ def list_nodes() -> List[Dict[str, Any]]:
     return out
 
 
+def node_stats() -> Dict[str, Dict[str, Any]]:
+    """Per-node telemetry snapshots, keyed by node id hex: this
+    process's collector live, plus every cluster member's latest
+    heartbeat-piggybacked snapshot from the GCS node table."""
+    runtime = _runtime()
+    local_hex = runtime.scheduler.head_node().node_id.hex()
+    out: Dict[str, Dict[str, Any]] = {}
+    collector = getattr(runtime, "node_stats", None)
+    if collector is not None:
+        out[local_hex] = collector.snapshot()
+    ctx = getattr(runtime, "cluster", None)
+    if ctx is not None:
+        for info in ctx.nodes():
+            stats = info.get("stats")
+            if stats and info.get("node_id") not in out:
+                out[info["node_id"]] = stats
+    return out
+
+
 def summary() -> Dict[str, Any]:
     runtime = _runtime()
     events = runtime.task_events()
@@ -83,7 +103,155 @@ def summary() -> Dict[str, Any]:
         "tasks_failed": sum(1 for e in events if not e["ok"]),
         "object_store": runtime.object_store.usage(),
         "scheduler": dict(runtime.scheduler.stats),
+        "pending_tasks": len(runtime.scheduler.pending_demand()),
+        "node_stats": node_stats(),
     }
+
+
+def cluster_metrics(raw: bool = False):
+    """Federated cluster metrics. Default: ONE merged Prometheus
+    exposition where every sample carries a `node_id` label (what
+    /metrics/cluster serves). `raw=True`: the unmerged per-node
+    expositions keyed by node id hex."""
+    from .metrics import cluster_prometheus_text, registry
+
+    if not raw:
+        return cluster_prometheus_text()
+    runtime = _runtime()
+    ctx = getattr(runtime, "cluster", None)
+    local_hex = runtime.scheduler.head_node().node_id.hex()
+    parts = {local_hex: registry().prometheus_text()}
+    if ctx is not None:
+        for node_hex, text in ctx.fanout_nodes(
+            "metrics_snapshot", placeholder=lambda e: None
+        ).items():
+            if text:
+                parts[node_hex] = text
+    return parts
+
+
+def _fmt_bytes(n: float) -> str:
+    n = float(n or 0)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024
+    return f"{n:.1f}TiB"
+
+
+def status_report(verbose: bool = False) -> str:
+    """Autoscaler-style debug summary (reference: the `ray status`
+    output assembled from the GCS resource + autoscaler reports): nodes
+    with usage/state, telemetry snapshots, pending demand, actors, PG
+    states, object-store totals, and recent warnings."""
+    runtime = _runtime()
+    nodes = list_nodes()
+    stats = node_stats()
+    s = summary()
+    lines: List[str] = []
+    lines.append("======== ray_tpu status ========")
+    lines.append(time.strftime("%Y-%m-%d %H:%M:%S"))
+    by_state: Dict[str, int] = {}
+    for n in nodes:
+        by_state[n["state"]] = by_state.get(n["state"], 0) + 1
+    lines.append("")
+    lines.append(
+        f"Nodes: {len(nodes)} ("
+        + ", ".join(f"{v} {k}" for k, v in sorted(by_state.items()))
+        + ")"
+    )
+    for n in nodes:
+        head = " head" if n["is_head"] else ""
+        drain = (
+            f" draining({n['drain_reason']})" if n.get("draining") else ""
+        )
+        lines.append(f"  node {n['node_id'][:12]} {n['state']}{head}{drain}")
+        total = n["resources_total"]
+        avail = n["resources_available"]
+        usage = ", ".join(
+            f"{k}: {total.get(k, 0.0) - avail.get(k, 0.0):g}/{total.get(k, 0.0):g} used"
+            for k in sorted(total)
+        )
+        lines.append(f"    resources: {usage or '(none)'}")
+        snap = stats.get(n["node_id"])
+        if snap:
+            store = snap.get("object_store", {})
+            lines.append(
+                f"    object store: {_fmt_bytes(store.get('host_bytes', 0))}"
+                f" in {store.get('num_objects', 0)} object(s)"
+            )
+            wp = snap.get("worker_pool", {})
+            tq = snap.get("task_queues", {})
+            lines.append(
+                f"    worker pool: {wp.get('busy', 0)} busy / "
+                f"{wp.get('idle', 0)} idle; queues: "
+                + " ".join(f"{k}={v}" for k, v in sorted(tq.items()))
+            )
+            lines.append(
+                f"    cpu: {snap.get('cpu_percent', 0.0):.1f}%  "
+                f"rss: {_fmt_bytes(snap.get('rss_bytes', 0))}"
+            )
+            for dev in snap.get("tpu", ()):
+                if "hbm_used_bytes" in dev:
+                    lines.append(
+                        f"    tpu[{dev.get('id')}] {dev.get('kind')}: HBM "
+                        f"{_fmt_bytes(dev['hbm_used_bytes'])}/"
+                        f"{_fmt_bytes(dev.get('hbm_limit_bytes', 0))} "
+                        f"duty={dev.get('duty', 0.0):.2f}"
+                    )
+    demand = runtime.scheduler.pending_demand()
+    lines.append("")
+    if demand:
+        lines.append(f"Pending tasks: {len(demand)} "
+                     f"(demand: {demand[:8]}{'...' if len(demand) > 8 else ''})")
+    else:
+        lines.append("Pending tasks: 0")
+    actors = runtime.list_actors()
+    actor_states: Dict[str, int] = {}
+    for a in actors:
+        actor_states[a["state"]] = actor_states.get(a["state"], 0) + 1
+    lines.append(
+        f"Actors: {len(actors)}"
+        + (" (" + ", ".join(f"{k}={v}" for k, v in sorted(actor_states.items())) + ")"
+           if actors else "")
+    )
+    pgs = list(getattr(runtime.scheduler, "_placement_groups", {}).values())
+    pg_states: Dict[str, int] = {}
+    for pg in pgs:
+        pg_states[pg.state] = pg_states.get(pg.state, 0) + 1
+    lines.append(
+        f"Placement groups: {len(pgs)}"
+        + (" (" + ", ".join(f"{k}={v}" for k, v in sorted(pg_states.items())) + ")"
+           if pgs else "")
+    )
+    store = s["object_store"]
+    lines.append(
+        f"Object store: {_fmt_bytes(store.get('host_bytes', 0))} host"
+        f" / {store.get('num_objects', 0)} object(s)"
+    )
+    sched = s["scheduler"]
+    lines.append(
+        "Scheduler: " + " ".join(f"{k}={v}" for k, v in sorted(sched.items()))
+    )
+    warn = [
+        e for e in list_events(limit=200)
+        if e["severity"] in ("WARNING", "ERROR")
+    ][-8:]
+    lines.append("")
+    lines.append(f"Recent warnings ({len(warn)}):")
+    for e in warn:
+        ts = time.strftime("%H:%M:%S", time.localtime(e.get("ts", 0)))
+        lines.append(f"  {ts} {e['severity']:7s} [{e['source']}] {e['message']}")
+    if not warn:
+        lines.append("  (none)")
+    if verbose:
+        lines.append("")
+        lines.append("Logs (per node):")
+        for node_hex, tail in cluster_logs(tail=20).items():
+            lines.append(f"  --- node {node_hex[:12]} ---")
+            for line in tail:
+                lines.append(f"  {line}")
+    return "\n".join(lines)
 
 
 def list_traces(limit: int = 100) -> List[Dict[str, Any]]:
@@ -141,36 +309,26 @@ def trace_dump(path: Optional[str] = None,
     return export_chrome_trace(spans, path)
 
 
-def chrome_tracing_dump(path: Optional[str] = None) -> str:
-    """Chrome trace-event JSON of completed tasks (one lane per node).
+# one-shot latch for the chrome_tracing_dump deprecation warning
+# (a list so tests can reset it without reaching into module globals)
+_chrome_dump_warned = [False]
 
-    Returns the JSON string; writes it to `path` when given. Open in
-    chrome://tracing or https://ui.perfetto.dev. Superseded by
-    `trace_dump`, which exports the full span tree (queue/dispatch/
-    execute/result causality) instead of flat completed-task intervals;
-    this stays for the legacy `ray_tpu timeline` shape.
-    """
-    events = []
-    for e in list_tasks(limit=100_000):
-        if not e.get("start_ts"):
-            continue
-        events.append(
-            {
-                "name": e["name"],
-                "cat": "task",
-                "ph": "X",
-                "ts": e["start_ts"] * 1e6,
-                "dur": max(0.0, (e["end_ts"] - e["start_ts"]) * 1e6),
-                "pid": e.get("node", "node")[:8] or "node",
-                "tid": e["task_id"][:8],
-                "args": {"ok": e["ok"], "attempt": e["attempt"]},
-            }
+
+def chrome_tracing_dump(path: Optional[str] = None) -> str:
+    """DEPRECATED: thin wrapper over `trace_dump`. The two exports used
+    to be parallel implementations (flat completed-task intervals here,
+    the span tree there) and could drift; now this delegates so there is
+    exactly one Perfetto/chrome-trace encoder. Emits one
+    DeprecationWarning per process; new code should call `trace_dump`
+    (optionally with `trace_id=`) directly."""
+    if not _chrome_dump_warned[0]:
+        _chrome_dump_warned[0] = True
+        warnings.warn(
+            "chrome_tracing_dump is deprecated; use trace_dump (same "
+            "chrome-trace JSON, full span causality)",
+            DeprecationWarning, stacklevel=2,
         )
-    payload = json.dumps({"traceEvents": events})
-    if path:
-        with open(path, "w") as f:
-            f.write(payload)
-    return payload
+    return trace_dump(path)
 
 
 def list_events(limit: int = 500, severity: Optional[str] = None,

@@ -1,0 +1,336 @@
+"""Watchdogs over the telemetry plane: training stalls, stragglers,
+serve SLO burn.
+
+Two detectors, both fed by signals earlier PRs already emit:
+
+- ``StallWatchdog``: the TrainController streams per-worker step
+  reports (rank, wall timestamp) into it. A gang with NO report inside
+  ``train_stall_window_s``, or a worker whose report gap regresses past
+  ``train_stall_factor`` x its EWMA step time, flips the
+  ``raytpu_train_stalled`` gauge to 1 and emits a WARNING event naming
+  the straggler rank (MegaScale-style per-step straggler detection —
+  silent slowdowns surface before they become outages). Recovery flips
+  the gauge back and emits an INFO event.
+- ``ServeSLOMonitor``: periodically evaluates the PR-2 latency
+  histograms (raytpu_serve_ttft_seconds, raytpu_serve_queue_seconds)
+  over the window since its last check; a window whose p99 exceeds the
+  configured SLO increments ``raytpu_serve_slo_burn_total{slo=...}``
+  and emits a WARNING event.
+
+Both are pure consumers of the metrics/events plane: no RPC, no
+threads of their own unless started.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from .events import emit
+from .metrics import get_or_create_counter, get_or_create_gauge, registry
+
+
+def _stalled_gauge():
+    return get_or_create_gauge(
+        "raytpu_train_stalled",
+        "1 while the training stall watchdog considers the run stalled "
+        "(no progress in the window, or an EWMA step-time regression).",
+        tag_keys=("run",),
+    )
+
+
+class StallWatchdog:
+    """Training stall + straggler detection from gang step timestamps.
+
+    Feed it with ``observe_report(rank, ts)`` for every worker report
+    the controller drains, and call ``check()`` each poll cycle. All
+    thresholds come from config (``train_stall_*`` flags) unless
+    overridden."""
+
+    def __init__(self, run_name: str, num_workers: int, *,
+                 window_s: Optional[float] = None,
+                 factor: Optional[float] = None,
+                 alpha: Optional[float] = None,
+                 min_s: Optional[float] = None):
+        from ..core.config import cfg
+
+        self.run_name = run_name
+        self.num_workers = num_workers
+        self.window_s = cfg.train_stall_window_s if window_s is None else window_s
+        self.factor = cfg.train_stall_factor if factor is None else factor
+        self.alpha = cfg.train_stall_ewma_alpha if alpha is None else alpha
+        self.min_s = cfg.train_stall_min_s if min_s is None else min_s
+        now = time.time()
+        self._started = now
+        self._lock = threading.Lock()
+        self._last_ts: Dict[int, float] = {}   # rank -> last report wall ts
+        self._ewma: Dict[int, float] = {}      # rank -> EWMA step interval
+        self._reports: Dict[int, int] = {}
+        self._done: set = set()  # finished ranks are not stragglers
+        self.stalled = False
+        self.stall_reason = ""
+        self.straggler: Optional[int] = None
+        _stalled_gauge().set(0, tags={"run": run_name})
+
+    # ------------------------------------------------------------- feeding
+
+    def observe_report(self, rank: int, ts: Optional[float] = None) -> None:
+        ts = time.time() if ts is None else float(ts)
+        with self._lock:
+            prev = self._last_ts.get(rank)
+            if prev is not None and ts > prev:
+                interval = ts - prev
+                ewma = self._ewma.get(rank)
+                self._ewma[rank] = (
+                    interval if ewma is None
+                    else self.alpha * interval + (1 - self.alpha) * ewma
+                )
+            self._last_ts[rank] = max(ts, prev or 0.0)
+            self._reports[rank] = self._reports.get(rank, 0) + 1
+
+    def mark_done(self, rank: int) -> None:
+        """A worker finished its loop cleanly: silence from it is
+        completion, not a stall."""
+        with self._lock:
+            self._done.add(rank)
+
+    # ----------------------------------------------------------- evaluation
+
+    def straggler_ranking(self, now: Optional[float] = None
+                          ) -> List[Tuple[int, float]]:
+        """Ranks ordered most-behind first: (rank, seconds since its
+        last report). Workers that never reported rank by time since
+        watchdog start."""
+        now = time.time() if now is None else now
+        with self._lock:
+            lags = [
+                (rank, now - self._last_ts.get(rank, self._started))
+                for rank in range(self.num_workers)
+                if rank not in self._done
+            ]
+        return sorted(lags, key=lambda rl: -rl[1])
+
+    def check(self, now: Optional[float] = None) -> bool:
+        """Evaluate the stall conditions; flip gauge + events on state
+        transitions. Returns the current stalled verdict."""
+        if self.window_s <= 0:
+            return False
+        now = time.time() if now is None else now
+        ranking = self.straggler_ranking(now)
+        if not ranking:  # every rank finished: nothing left to stall
+            self._transition(False, None, "")
+            return False
+        straggler = ranking[0][0]
+        reason = ""
+        # (1) no progress anywhere (among unfinished ranks) in the window
+        with self._lock:
+            newest = max(
+                (ts for r, ts in self._last_ts.items() if r not in self._done),
+                default=self._started,
+            )
+        if now - newest > self.window_s:
+            reason = (
+                f"no worker reported for {now - newest:.1f}s "
+                f"(window {self.window_s:.1f}s); slowest is rank {straggler}"
+            )
+        else:
+            # (2) EWMA regression of one worker against its own history
+            with self._lock:
+                ewmas = dict(self._ewma)
+            for rank, lag in ranking:
+                ewma = ewmas.get(rank)
+                if ewma is None:
+                    continue
+                threshold = max(self.min_s, self.factor * ewma)
+                if lag > threshold:
+                    straggler = rank
+                    reason = (
+                        f"rank {rank} step gap {lag:.2f}s exceeds "
+                        f"{self.factor:.1f}x its EWMA step time "
+                        f"({ewma:.3f}s)"
+                    )
+                    break
+        self._transition(bool(reason), straggler, reason)
+        return self.stalled
+
+    def _transition(self, stalled: bool, straggler: Optional[int],
+                    reason: str) -> None:
+        if stalled == self.stalled:
+            self.straggler = straggler if stalled else None
+            self.stall_reason = reason
+            return
+        self.stalled = stalled
+        self.straggler = straggler if stalled else None
+        self.stall_reason = reason
+        _stalled_gauge().set(1.0 if stalled else 0.0,
+                             tags={"run": self.run_name})
+        if stalled:
+            emit("WARNING", "watchdog",
+                 f"run {self.run_name} STALLED: {reason} "
+                 f"(straggler rank {straggler})",
+                 run=self.run_name, straggler_rank=straggler)
+        else:
+            emit("INFO", "watchdog",
+                 f"run {self.run_name} recovered from stall",
+                 run=self.run_name)
+
+    def close(self) -> None:
+        """Run over: clear the stalled gauge so a finished run never
+        reads as permanently stalled."""
+        self._transition(False, None, "")
+        _stalled_gauge().set(0, tags={"run": self.run_name})
+
+
+# --------------------------------------------------------------- serve SLO
+
+
+def _histogram_quantile(buckets: List[Tuple[float, int]], total: int,
+                        q: float) -> float:
+    """Estimate a quantile from cumulative-ized histogram bucket deltas
+    (Prometheus-style linear interpolation within the landing bucket;
+    +Inf landings return inf — above every finite boundary)."""
+    if total <= 0:
+        return 0.0
+    target = q * total
+    cumulative = 0
+    prev_bound = 0.0
+    for bound, count in buckets:
+        if count:
+            if cumulative + count >= target:
+                frac = (target - cumulative) / count
+                return prev_bound + frac * (bound - prev_bound)
+            cumulative += count
+        prev_bound = bound
+    return math.inf  # landed in the +Inf overflow bucket
+
+
+class ServeSLOMonitor:
+    """p99 burn detection over the span-derived serve histograms.
+
+    Each ``check()`` diffs the histograms against the previous check
+    (so the p99 is of the WINDOW, not all time) and burns the SLO
+    counter when the window's p99 exceeds the configured objective."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # histogram name -> previous cumulative (bucket counts, total)
+        self._prev: Dict[str, Tuple[List[int], int]] = {}
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    def _slos(self) -> List[Tuple[str, str, float]]:
+        from ..core.config import cfg
+
+        return [
+            ("ttft_p99", "raytpu_serve_ttft_seconds",
+             float(cfg.serve_slo_ttft_p99_s)),
+            ("queue_p99", "raytpu_serve_queue_seconds",
+             float(cfg.serve_slo_queue_p99_s)),
+        ]
+
+    def _window_delta(self, name: str, hist) -> Tuple[List[Tuple[float, int]], int]:
+        """Aggregate the histogram across its tag series and diff
+        against the last check's cumulative counts."""
+        bounds = list(hist.boundaries)
+        counts = [0] * (len(bounds) + 1)
+        total = 0
+        for _tags, data in hist.collect():
+            for i, (_b, c) in enumerate(data["buckets"]):
+                counts[i] += c
+            total += data["count"]
+        # overflow bucket = total - finite-bucket sum
+        counts[len(bounds)] = total - sum(counts[: len(bounds)])
+        with self._lock:
+            prev_counts, prev_total = self._prev.get(
+                name, ([0] * len(counts), 0)
+            )
+            self._prev[name] = (list(counts), total)
+        delta = [c - p for c, p in zip(counts, prev_counts)]
+        finite = list(zip(bounds, delta[: len(bounds)]))
+        # the +Inf overflow rides as a trailing (inf, n) entry
+        finite.append((math.inf, max(0, delta[len(bounds)])))
+        return finite, max(0, total - prev_total)
+
+    def check(self) -> Dict[str, float]:
+        """One evaluation round. Returns {slo: window_p99} for every SLO
+        that had samples this window (enabled or not — callers/tests can
+        inspect); burns counters/events only for enabled, violated SLOs."""
+        out: Dict[str, float] = {}
+        for slo, hist_name, objective in self._slos():
+            hist = registry().get(hist_name)
+            if hist is None or getattr(hist, "kind", "") != "histogram":
+                continue
+            buckets, n = self._window_delta(hist_name, hist)
+            if n <= 0:
+                continue
+            p99 = _histogram_quantile(buckets, n, 0.99)
+            out[slo] = p99
+            if objective > 0 and p99 > objective:
+                get_or_create_counter(
+                    "raytpu_serve_slo_burn_total",
+                    "SLO-violating windows observed by the serve SLO "
+                    "monitor (p99 over objective).",
+                    tag_keys=("slo",),
+                ).inc(tags={"slo": slo})
+                emit("WARNING", "watchdog",
+                     f"serve SLO burn: {slo} = "
+                     f"{'inf' if math.isinf(p99) else f'{p99:.3f}s'} over "
+                     f"objective {objective:.3f}s "
+                     f"({n} request(s) this window)",
+                     slo=slo, objective=objective, samples=n)
+        return out
+
+    # -------------------------------------------------------- background run
+
+    def start(self, period_s: Optional[float] = None) -> None:
+        """Start the periodic evaluator (idempotent)."""
+        from ..core.config import cfg
+
+        if self._thread is not None:
+            return
+        period = cfg.serve_slo_check_period_s if period_s is None else period_s
+        if period <= 0:
+            return
+
+        def loop():
+            while not self._stop.wait(period):
+                try:
+                    self.check()
+                except Exception:  # noqa: BLE001 - the monitor must not die
+                    pass
+
+        self._thread = threading.Thread(
+            target=loop, daemon=True, name="serve-slo-monitor"
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread = None
+
+
+_slo_monitor: Optional[ServeSLOMonitor] = None
+_slo_lock = threading.Lock()
+
+
+def serve_slo_monitor() -> ServeSLOMonitor:
+    global _slo_monitor
+    with _slo_lock:
+        if _slo_monitor is None:
+            _slo_monitor = ServeSLOMonitor()
+        return _slo_monitor
+
+
+def ensure_serve_slo_monitor() -> Optional[ServeSLOMonitor]:
+    """Start the singleton monitor when any serve SLO is configured
+    (called from the serve router on first deployment; a no-op without
+    configured objectives keeps idle deployments thread-free)."""
+    from ..core.config import cfg
+
+    if cfg.serve_slo_ttft_p99_s <= 0 and cfg.serve_slo_queue_p99_s <= 0:
+        return None
+    monitor = serve_slo_monitor()
+    monitor.start()
+    return monitor

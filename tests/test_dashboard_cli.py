@@ -84,9 +84,14 @@ def test_cli_config_lists_flags():
 
 
 def test_cli_status():
+    # autoscaler-style debug report: nodes + usage + telemetry sections
     out = _run_cli("--no-tpu", "status").stdout
+    assert "Nodes: 1 (1 ALIVE)" in out
+    assert "object store:" in out and "worker pool:" in out
+    # --json keeps the machine-readable summary shape
+    out = _run_cli("--no-tpu", "status", "--json").stdout
     assert '"nodes": 1' in out
-    assert "head=True" in out
+    assert '"node_stats"' in out
 
 
 def test_cli_job_submit_wait_and_logs():

@@ -96,7 +96,13 @@ def test_state_api_lists():
     assert s["tasks_finished"] >= 5
 
 
-def test_chrome_tracing_dump(tmp_path):
+def test_chrome_tracing_dump_deprecated_delegates(tmp_path):
+    """chrome_tracing_dump is a thin wrapper over trace_dump now: same
+    payload (the span export), one DeprecationWarning per process."""
+    import warnings as _warnings
+
+    from ray_tpu.util import state as _state
+
     @ray_tpu.remote
     def traced():
         import time
@@ -105,15 +111,27 @@ def test_chrome_tracing_dump(tmp_path):
         return 1
 
     ray_tpu.get([traced.remote() for _ in range(3)])
+    _state._chrome_dump_warned[0] = False  # reset the one-shot latch
     path = tmp_path / "trace.json"
-    payload = chrome_tracing_dump(str(path))
+    with pytest.warns(DeprecationWarning, match="trace_dump"):
+        payload = chrome_tracing_dump(str(path))
     trace = json.loads(payload)
-    events = [e for e in trace["traceEvents"] if e["name"] == "traced"]
-    assert len(events) == 3
-    for e in events:
+    execs = [
+        e for e in trace["traceEvents"]
+        if e["name"] == "task.execute" and e["args"].get("task") == "traced"
+    ]
+    assert len(execs) == 3
+    for e in execs:
         assert e["ph"] == "X"
         assert e["dur"] >= 10_000  # ≥10ms in microseconds
     assert path.exists()
+    # delegation means the two exports CANNOT drift
+    assert json.loads(chrome_tracing_dump()) == json.loads(trace_dump())
+    # ...and the warning is one-shot
+    with _warnings.catch_warnings(record=True) as caught:
+        _warnings.simplefilter("always")
+        chrome_tracing_dump()
+    assert not [w for w in caught if w.category is DeprecationWarning]
 
 
 # ---------------------------------------------------------- exposition format
@@ -413,11 +431,359 @@ def test_metric_names_static_check():
         (bad / "m.py").write_text(
             'c = Counter("unprefixed_total", "x")\n'
             'd = Counter("raytpu_dup_total", "x")\n'
+            'h = Histogram("raytpu_nobounds_seconds", "x")\n'
+            'h2 = get_or_create_histogram(\n'
+            '    "raytpu_bounded_seconds", "x",\n'
+            '    boundaries=(0.1, 1.0),\n'
+            ')\n'
+            'value = some_gauge._fn()\n'
         )
-        (bad / "n.py").write_text('e = Counter("raytpu_dup_total", "x")\n')
+        (bad / "n.py").write_text(
+            'e = Counter("raytpu_dup_total", "x")\n'
+            'class MyMetric:\n'
+            '    def collect(self):\n'
+            '        return []\n'
+        )
         errors = mod.check(bad)
         assert any("unprefixed_total" in e for e in errors)
         assert any("raytpu_dup_total" in e and "2 sites" in e for e in errors)
+        # new rules: histograms need explicit boundaries; sampler-guard
+        # bypasses (direct ._fn() calls, collect() overrides) are flagged
+        assert any("raytpu_nobounds_seconds" in e and "boundaries" in e
+                   for e in errors)
+        assert not any("raytpu_bounded_seconds" in e for e in errors)
+        assert any("._fn()" in e for e in errors)
+        assert any("collect() override" in e for e in errors)
+
+
+# ------------------------------------------------------------ telemetry plane
+
+
+def test_node_stats_snapshot_and_gauges(rt):
+    """The per-node collector samples process/store/pool/queue stats and
+    the node-local gauges ride the scrape."""
+    snap = rt.node_stats.snapshot()
+    for key in ("cpu_percent", "rss_bytes", "object_store", "worker_pool",
+                "task_queues", "scheduler", "health", "pubsub", "tpu", "ts"):
+        assert key in snap, key
+    assert snap["rss_bytes"] > 0
+    assert set(snap["task_queues"]) == {"pending", "blocked", "admission"}
+    assert set(snap["worker_pool"]) >= {"busy", "idle"}
+    text = registry().prometheus_text()
+    for name in ("raytpu_node_cpu_percent", "raytpu_node_rss_bytes",
+                 "raytpu_node_worker_pool", "raytpu_node_task_queue_depth"):
+        assert f"# TYPE {name} gauge" in text, name
+    assert re.search(r'raytpu_node_task_queue_depth\{queue="pending"\} ', text)
+
+
+def test_status_report_renders():
+    """Acceptance: `ray_tpu status` against an in-process runtime shows
+    per-node resource usage, object-store bytes and worker-pool
+    occupancy (state.status_report backs the CLI)."""
+    from ray_tpu.util.state import status_report
+
+    @ray_tpu.remote
+    def work(x):
+        return x + 1
+
+    ray_tpu.get([work.remote(i) for i in range(3)])
+    report = status_report()
+    assert "Nodes: 1 (1 ALIVE)" in report
+    assert "resources: CPU:" in report
+    assert "object store:" in report
+    assert "worker pool:" in report and "busy" in report
+    assert "Scheduler: dispatched=" in report
+    assert "Recent warnings" in report
+    # --verbose appends per-node log tails
+    assert "Logs (per node):" in status_report(verbose=True)
+
+
+def test_metrics_cluster_endpoint_node_id_labels():
+    """/metrics/cluster returns a parseable merged exposition where
+    every sample carries a node_id label (single-node degenerate case)."""
+    Counter("raytpu_probe_total", "probe").inc(3)
+    port = start_metrics_server()
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics/cluster", timeout=10
+    ) as r:
+        body = r.read().decode()
+    local_hex = ray_tpu.api._runtime().scheduler.head_node().node_id.hex()
+    samples = [
+        l for l in body.strip().splitlines() if not l.startswith("#")
+    ]
+    assert samples
+    for line in samples:
+        assert _EXPO_LINE.match(line), f"unparseable merged line: {line!r}"
+        assert 'node_id="' in line, f"sample without node_id: {line!r}"
+    assert f'node_id="{local_hex}"' in body
+    assert re.search(
+        rf'raytpu_probe_total\{{node_id="{local_hex}"\}} 3', body
+    )
+
+
+def test_cluster_telemetry_roundtrip_and_federation():
+    """Capstone: stats snapshots round-trip through the GCS node table
+    via the heartbeat piggyback, and the head federates both nodes'
+    expositions with node_id labels over the metrics_snapshot RPC."""
+    import time as _time
+
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.core.cluster import NODE_NS
+    from ray_tpu.core.config import cfg
+    from ray_tpu.util.metrics import cluster_prometheus_text
+    from ray_tpu.util.state import node_stats, status_report, summary
+
+    ray_tpu.shutdown()  # the autouse fixture runtime is not a cluster head
+    c = Cluster(head_node_args={
+        "num_cpus": 2,
+        "_system_config": {"node_stale_s": 5.0, "node_heartbeat_s": 0.2,
+                           "node_stats_period_s": 0.2},
+    })
+    try:
+        c.add_node(num_cpus=2, system_config={"node_heartbeat_s": 0.2,
+                                              "node_stats_period_s": 0.2})
+        c.wait_for_nodes(2)
+        ctx = c.runtime.cluster
+        # (1) snapshot round-trip through the node table
+        deadline = _time.monotonic() + 10
+        table = {}
+        while _time.monotonic() < deadline:
+            table = {
+                key: ctx.gcs.kv_get(key, namespace=NODE_NS)
+                for key in ctx.gcs.kv_keys(namespace=NODE_NS)
+            }
+            if len(table) == 2 and all(
+                (info or {}).get("stats") for info in table.values()
+            ):
+                break
+            _time.sleep(0.1)
+        assert len(table) == 2
+        for info in table.values():
+            stats = info.get("stats")
+            assert stats, f"no stats piggybacked for {info.get('node_id')}"
+            assert "object_store" in stats and "worker_pool" in stats
+            assert "task_queues" in stats and stats["rss_bytes"] > 0
+        # (2) state API carries both snapshots
+        ns = node_stats()
+        assert set(ns) == set(table)
+        assert summary()["node_stats"].keys() == ns.keys()
+        # (3) federated exposition: every sample labeled, both nodes in
+        merged = cluster_prometheus_text()
+        samples = [
+            l for l in merged.strip().splitlines() if not l.startswith("#")
+        ]
+        assert samples
+        for line in samples:
+            assert _EXPO_LINE.match(line), f"unparseable: {line!r}"
+            assert 'node_id="' in line, line
+        for node_hex in table:
+            assert f'node_id="{node_hex}"' in merged, node_hex[:12]
+        # TYPE headers are deduplicated across nodes
+        assert merged.count("# TYPE raytpu_node_rss_bytes gauge") == 1
+        # (4) the status report sees the cluster
+        report = status_report()
+        assert "Nodes: 2" in report
+    finally:
+        c.shutdown()
+        cfg.reset()
+
+
+# ---------------------------------------------------------------- watchdogs
+
+
+def test_stall_watchdog_unit_transitions():
+    """Deterministic stall logic: EWMA regression names the straggler,
+    the no-progress window catches a dead gang, recovery clears."""
+    from ray_tpu.util.events import events
+    from ray_tpu.util.watchdog import StallWatchdog
+
+    wd = StallWatchdog("unit_run", 2, window_s=10.0, factor=3.0,
+                       alpha=0.5, min_s=0.5)
+    t0 = 1000.0
+    # both ranks step every 0.2s for a while
+    for i in range(6):
+        wd.observe_report(0, t0 + 0.2 * i)
+        wd.observe_report(1, t0 + 0.2 * i)
+    now = t0 + 0.2 * 5
+    assert wd.check(now + 0.1) is False
+    # rank 1 goes silent: gap blows past factor x EWMA (and min_s)
+    for i in range(6, 10):
+        wd.observe_report(0, t0 + 0.2 * i)
+    assert wd.check(t0 + 0.2 * 9 + 0.8) is True
+    assert wd.straggler == 1
+    g = registry().get("raytpu_train_stalled")
+    assert dict((tuple(sorted(t.items())), v) for t, v in g.collect())[
+        (("run", "unit_run"),)
+    ] == 1.0
+    warned = [
+        e for e in events().list(severity="WARNING", source="watchdog",
+                                 limit=100)
+        if "unit_run" in e["message"]
+    ]
+    assert warned and "rank 1" in warned[-1]["message"]
+    # rank 1 recovers
+    wd.observe_report(1, t0 + 0.2 * 9 + 0.9)
+    wd.observe_report(0, t0 + 0.2 * 9 + 0.9)
+    assert wd.check(t0 + 0.2 * 9 + 1.0) is False
+    assert dict((tuple(sorted(t.items())), v) for t, v in g.collect())[
+        (("run", "unit_run"),)
+    ] == 0.0
+    # global no-progress window
+    assert wd.check(t0 + 1000.0) is True
+    wd.close()
+    assert dict((tuple(sorted(t.items())), v) for t, v in g.collect())[
+        (("run", "unit_run"),)
+    ] == 0.0
+
+
+def test_stall_watchdog_fires_on_injected_slow_gang_worker():
+    """Acceptance: a chaos-injected slow gang worker flips
+    raytpu_train_stalled to 1 and emits a WARNING naming the straggler
+    rank; the gauge clears when the worker recovers."""
+    import threading as _threading
+    import time as _time
+
+    from ray_tpu import train
+    from ray_tpu.core.config import cfg
+    from ray_tpu.train import (
+        RunConfig,
+        ScalingConfig,
+        TrainController,
+    )
+    from ray_tpu.util.events import events
+
+    cfg.set(train_stall_window_s=60.0,  # global window off the hot path
+            train_stall_factor=4.0, train_stall_min_s=0.25,
+            train_stall_ewma_alpha=0.3)
+    run_name = "stall_drill"
+
+    def train_fn(config):
+        ctx = train.get_context()
+        for step in range(25):
+            train.report({"step": step})
+            if ctx.world_rank == 1 and step == 10:
+                _time.sleep(1.2)  # injected slow step: the straggler
+            else:
+                _time.sleep(0.03)
+
+    controller = TrainController(
+        train_fn,
+        ScalingConfig(num_workers=2,
+                      resources_per_worker={"CPU": 1.0}),
+        RunConfig(name=run_name),
+        train_config={},
+        poll_interval=0.02,
+    )
+    result_box = {}
+
+    def run():
+        result_box["result"] = controller.run()
+
+    t = _threading.Thread(target=run, daemon=True)
+    t.start()
+
+    def stalled_value():
+        g = registry().get("raytpu_train_stalled")
+        if g is None:
+            return None
+        vals = dict(
+            (tuple(sorted(tags.items())), v) for tags, v in g.collect()
+        )
+        return vals.get((("run", run_name),))
+
+    deadline = _time.monotonic() + 30
+    fired = False
+    while _time.monotonic() < deadline:
+        if stalled_value() == 1.0:
+            fired = True
+            break
+        _time.sleep(0.02)
+    assert fired, "stall watchdog never fired on the injected slow worker"
+    warned = [
+        e for e in events().list(severity="WARNING", source="watchdog",
+                                 limit=200)
+        if run_name in e["message"] and "STALLED" in e["message"]
+    ]
+    assert warned, "no WARNING event from the stall watchdog"
+    assert "rank 1" in warned[0]["message"], warned[0]["message"]
+    assert warned[0].get("extra", {}).get("straggler_rank") == 1
+    t.join(timeout=60)
+    assert not t.is_alive()
+    assert result_box["result"].status.value == "FINISHED", (
+        result_box["result"].error
+    )
+    # run over (watchdog closed): the stalled gauge reads 0 again
+    assert stalled_value() == 0.0
+    cfg.reset("train_stall_window_s")
+    cfg.reset("train_stall_factor")
+    cfg.reset("train_stall_min_s")
+    cfg.reset("train_stall_ewma_alpha")
+
+
+def test_serve_slo_monitor_burns_on_p99_violation():
+    """The SLO monitor diffs the PR-2 histograms per window and burns
+    raytpu_serve_slo_burn_total{slo=ttft_p99} + a WARNING event when the
+    window's p99 exceeds the objective."""
+    from ray_tpu.core.config import cfg
+    from ray_tpu.util.events import events
+    from ray_tpu.util.watchdog import ServeSLOMonitor
+
+    from ray_tpu.util.metrics import get_or_create_histogram
+
+    hist = get_or_create_histogram(
+        "raytpu_serve_ttft_seconds", "ttft",
+        boundaries=(0.005, 0.025, 0.1, 0.5, 2.0, 10.0),
+    )
+    cfg.set(serve_slo_ttft_p99_s=0.1)
+    try:
+        monitor = ServeSLOMonitor()
+        monitor.check()  # baseline the window cursor
+        for _ in range(50):
+            hist.observe(1.5)  # way over the 100ms objective
+        verdict = monitor.check()
+        assert verdict["ttft_p99"] > 0.1
+        burn = registry().get("raytpu_serve_slo_burn_total")
+        assert burn is not None
+        burns = dict(
+            (tuple(sorted(t.items())), v) for t, v in burn.collect()
+        )
+        assert burns[(("slo", "ttft_p99"),)] == 1.0
+        warned = events().list(severity="WARNING", source="watchdog",
+                               limit=50)
+        assert any("serve SLO burn" in e["message"] and "ttft_p99"
+                   in e["message"] for e in warned)
+        # a healthy window does NOT burn again
+        for _ in range(200):
+            hist.observe(0.01)
+        monitor.check()
+        burns = dict(
+            (tuple(sorted(t.items())), v) for t, v in burn.collect()
+        )
+        assert burns[(("slo", "ttft_p99"),)] == 1.0
+    finally:
+        cfg.reset("serve_slo_ttft_p99_s")
+
+
+def test_log_lines_carry_node_and_task_attribution():
+    """Captured log tails attribute lines with [node:...] and, inside a
+    task, [task:...] — so aggregated tails keep their origin."""
+    import logging as _logging
+
+    from ray_tpu.util import logs
+
+    _logging.getLogger("ray_tpu.test").warning("outside-any-task")
+
+    @ray_tpu.remote
+    def noisy():
+        _logging.getLogger("ray_tpu.test").warning("inside-the-task")
+        return 1
+
+    assert ray_tpu.get(noisy.remote(), timeout=30) == 1
+    tail = logs.tail(200)
+    outside = next(l for l in tail if "outside-any-task" in l)
+    inside = next(l for l in tail if "inside-the-task" in l)
+    assert "[node:" in outside and "[task:" not in outside
+    assert "[node:" in inside and "[task:" in inside
 
 
 def test_device_trace_captures_xla_profile(tmp_path):
