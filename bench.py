@@ -21,15 +21,6 @@ SEQ = 1024
 WARMUP_STEPS = 3
 MEASURE_STEPS = 20
 
-# peak bf16 FLOPs/s per chip for the platform we land on
-_PEAK_FLOPS = {
-    "TPU v5 lite": 197e12,  # v5e
-    "TPU v5e": 197e12,
-    "TPU v5p": 459e12,
-    "TPU v4": 275e12,
-    "TPU v6 lite": 918e12,  # v6e
-}
-
 
 def ring_kernel_bench() -> dict:
     """Fused-Pallas vs einsum ring-attention LOCAL BLOCK on the real
@@ -154,15 +145,49 @@ def main() -> None:
     elapsed = time.perf_counter() - t0
 
     tokens_per_sec = MEASURE_STEPS * BATCH * SEQ / elapsed
+    step_time_s = elapsed / MEASURE_STEPS
+    device_kind = getattr(devices[0], "device_kind", "unknown")
+    # Cost-analysis accounting (util/profiling): the compiled step's own
+    # FLOPs/bytes over the measured step time, priced against the
+    # detected chip's peaks — no more hand-maintained 6ND/peak constants.
+    # Must run BEFORE _collect_telemetry (which donates `state` away).
+    from ray_tpu.util import profiling as prof
+
+    try:
+        cost = prof.step_cost(step, state, batch)
+        roof = prof.roofline(cost, step_time_s)
+        mfu = roof["mfu"]
+        peak = cost.peak_flops
+        profiling_block = {
+            "source": "cost_analysis",
+            "mfu": round(mfu, 4),
+            "flops_per_step": cost.total_flops,
+            "flops_per_token": round(cost.total_flops / (BATCH * SEQ), 1),
+            "roofline": {
+                "compute": round(mfu, 4),
+                "hbm": round(roof["hbm_fraction"], 4),
+                "bound": roof["bound"],
+                "estimated_peaks": roof["estimated_peaks"],
+            },
+            "top_cost_buckets": [
+                [k, v] for k, v in cost.top_buckets(5)
+            ],
+        }
+    except Exception as exc:  # noqa: BLE001 - the headline must still print
+        # degraded path: the 6ND matmul formula against the peak table
+        flops_per_token = 6 * n_params
+        peaks = prof.device_peaks(devices[0])
+        peak = peaks["peak_flops"]
+        mfu = tokens_per_sec * flops_per_token / peak
+        profiling_block = {
+            "source": "6nd_fallback",
+            "mfu": round(mfu, 4),
+            "error": repr(exc),
+        }
     try:
         telemetry = _collect_telemetry(step, state, batch)
     except Exception:  # noqa: BLE001 - the headline number must still print
         telemetry = {}
-    # 6ND fwd+bwd matmul flops + attention term 12*L*H*S^2*Dh ~= small here
-    flops_per_token = 6 * n_params
-    device_kind = getattr(devices[0], "device_kind", "unknown")
-    peak = _PEAK_FLOPS.get(device_kind, 197e12)
-    mfu = tokens_per_sec * flops_per_token / peak
     try:
         ring = ring_kernel_bench()
     except Exception:  # noqa: BLE001 - the headline number must still print
@@ -180,6 +205,7 @@ def main() -> None:
                 "mfu": round(mfu, 4),
                 "batch": BATCH,
                 "seq": SEQ,
+                "profiling": profiling_block,
                 "telemetry": telemetry,
                 **ring,
             }

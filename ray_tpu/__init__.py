@@ -47,6 +47,7 @@ from .core.exceptions import (  # noqa: F401
     ObjectStoreFullError,
     OutOfResourcesError,
     PlacementGroupUnschedulableError,
+    ProfilingError,
     RayTpuError,
     ReplicaDrainingError,
     RequestTimeoutError,

@@ -218,6 +218,55 @@ def _cmd_job(args) -> int:
     raise SystemExit(f"unknown job command {args.job_cmd!r}")
 
 
+def _cmd_profile(args) -> int:
+    """Coordinated cluster profile capture (reference: per-worker
+    profiling behind `ray timeline`/the dashboard profiler buttons):
+    fan a time-boxed device trace + host sampling profile out to the
+    selected nodes, register the artifacts, optionally write them to
+    --output, and print where everything landed."""
+    import ray_tpu
+    from .util import state
+
+    if args.address:
+        _observer_init(args)
+        time.sleep(1.0)  # let the cluster view populate
+    else:
+        ray_tpu.init(detect_accelerators=not args.no_tpu)
+    nodes = args.nodes.split(",") if args.nodes else None
+    record = state.profile(
+        nodes=nodes, duration_s=args.duration,
+        device=not args.no_device, host=not args.no_host,
+    )
+    print(f"profile {record['profile_id']}: {len(record['nodes'])} node(s), "
+          f"{record['duration_s']:.1f}s, {record['total_bytes']} bytes")
+    for node_hex, meta in sorted(record["nodes"].items()):
+        status = meta.get("error") or (
+            f"device={meta.get('device')} host={meta.get('host')}"
+        )
+        print(f"  node {node_hex[:12]}: {status}")
+        for name in meta.get("artifact_names", ()):
+            print(f"    {name}")
+    if args.output:
+        from .core.runtime import get_runtime
+
+        runtime = get_runtime()
+        written = 0
+        for key, data in runtime.profiles.artifacts_for(
+            record["profile_id"]
+        ).items():
+            dest = os.path.join(args.output, record["profile_id"], key)
+            os.makedirs(os.path.dirname(dest), exist_ok=True)
+            with open(dest, "wb") as f:  # atomic-ok: export copy, not state
+                f.write(data)
+            written += 1
+        print(f"wrote {written} artifact(s) under "
+              f"{os.path.join(args.output, record['profile_id'])}")
+    print("merge into a timeline with: ray_tpu timeline --profile-id "
+          f"{record['profile_id']} (same session)")
+    ray_tpu.shutdown()
+    return 0
+
+
 def _cmd_timeline(args) -> int:
     import ray_tpu
     from .util import state
@@ -230,7 +279,9 @@ def _cmd_timeline(args) -> int:
     # submit→queue→dispatch→execute→result causality, stitched across
     # nodes. --trace is the historical opt-in; chrome_tracing_dump is a
     # deprecated alias of trace_dump now, so both paths export spans.
-    state.trace_dump(args.output, trace_id=args.trace_id)
+    # --profile-id merges a registered capture's device tracks in.
+    state.trace_dump(args.output, trace_id=args.trace_id,
+                     profile_id=args.profile_id)
     print(f"wrote {args.output} (open in chrome://tracing or Perfetto)")
     return 0
 
@@ -328,6 +379,27 @@ def build_parser() -> argparse.ArgumentParser:
     tp.add_argument("--trace-id", default=None,
                     help="with --trace: export only this trace (stitched "
                          "cluster-wide)")
+    tp.add_argument("--profile-id", default=None,
+                    help="merge this registered capture's device-trace "
+                         "events in as per-device tracks")
+
+    pf = sub.add_parser(
+        "profile", help="coordinated device/host profile capture"
+    )
+    pf.add_argument("--nodes", default=None,
+                    help="comma-separated node id hex prefixes (default: "
+                         "every alive node)")
+    pf.add_argument("--duration", type=float, default=None,
+                    help="capture window in seconds "
+                         "(default: profile_default_duration_s)")
+    pf.add_argument("--no-device", action="store_true",
+                    help="skip the jax device trace")
+    pf.add_argument("--no-host", action="store_true",
+                    help="skip the host sampling profile")
+    pf.add_argument("--output", default=None,
+                    help="directory to write the captured artifacts into")
+    pf.add_argument("--address", help="head GCS address to join as observer")
+    pf.add_argument("--token", default=None)
 
     dp = sub.add_parser("dashboard", help="serve the cluster dashboard")
     dp.add_argument("--port", type=int, default=8265)
@@ -347,6 +419,7 @@ def main(argv=None) -> int:
         "logs": _cmd_logs,
         "events": _cmd_events,
         "timeline": _cmd_timeline,
+        "profile": _cmd_profile,
         "dashboard": _cmd_dashboard,
     }[args.command]
     return handler(args)

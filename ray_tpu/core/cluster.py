@@ -507,6 +507,7 @@ class ClusterContext:
         self.server.register("node_spans", self._node_spans)
         self.server.register("metrics_snapshot", self._metrics_snapshot)
         self.server.register("node_stats", self._node_stats)
+        self.server.register("profile_capture", self._profile_capture)
         self.address = self.server.address
 
         self.gcs = GcsClient(gcs_address, token=self.token)
@@ -2561,6 +2562,23 @@ class ClusterContext:
         callers that want structure, not exposition text."""
         collector = getattr(self.runtime, "node_stats", None)
         return collector.snapshot() if collector is not None else {}
+
+    def _profile_capture(self, spec: Dict[str, Any]) -> Dict[str, Any]:
+        """Agent arm of the coordinated capture fan-out: run a time-boxed
+        device trace + host profile HERE and return the bounded artifact
+        bytes to the coordinating driver (the RPC reply IS the transfer
+        — artifacts are capped by profile_max_artifact_bytes, far under
+        the frame bound). The handler blocks for the capture window on
+        its own server thread; capture degradation (no jax, trace busy)
+        comes back in the meta, never as an exception."""
+        from ..util import profiling
+
+        return profiling.capture_local_profile(
+            spec.get("duration_s"),
+            device=bool(spec.get("device", True)),
+            host=bool(spec.get("host", True)),
+            profile_id=spec.get("profile_id", ""),
+        )
 
     def _node_info(self) -> Dict[str, Any]:
         return {

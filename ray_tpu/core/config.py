@@ -209,6 +209,26 @@ define_flag("serve_slo_check_period_s", 5.0,
             "Interval between serve SLO monitor evaluations of the PR-2 "
             "latency histograms.")
 
+# profiling plane (coordinated capture + cost accounting)
+define_flag("profile_default_duration_s", 2.0,
+            "Default capture window for `ray_tpu profile` / "
+            "state.profile() device+host captures.")
+define_flag("profile_max_artifact_bytes", 32 << 20,
+            "Per-node cap on artifact bytes a capture collects back to "
+            "the head (largest trace files dropped first).")
+define_flag("profile_host_sample_s", 0.005,
+            "Sampling interval of the host-side stack profiler that "
+            "rides along with device captures.")
+define_flag("profile_store_capacity", 8,
+            "Captures retained in the driver's profile store before the "
+            "oldest (meta + artifacts) is dropped.")
+define_flag("profile_merge_max_events", 20_000,
+            "Device-trace events merged into one Perfetto export by "
+            "trace_dump(profile_id=...); longest durations win.")
+define_flag("profile_cost_accounting", True,
+            "Compute cost_analysis() MFU/roofline gauges for train steps "
+            "and engine ticks (pays one extra XLA compile per program).")
+
 # memory monitor / OOM
 define_flag("memory_monitor_interval_s", 0.25,
             "Polling interval of the host memory monitor (0 = disabled).")

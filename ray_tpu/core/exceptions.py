@@ -122,6 +122,14 @@ class OutOfResourcesError(RayTpuError):
     """A task requires resources no node in the cluster can ever satisfy."""
 
 
+class ProfilingError(RayTpuError):
+    """A profiling operation failed in a way the caller can act on:
+    stopping a device trace that was never started, double-starting one,
+    or asking for a device capture on a host without an importable jax.
+    Wraps the raw jax.profiler exceptions so callers never dispatch on
+    backend-specific error strings."""
+
+
 class ObjectStoreFullError(RayTpuError):
     pass
 

@@ -25,6 +25,11 @@ logger = logging.getLogger(__name__)
 # checkpoint + restart excluding the node).
 PREEMPT_CHANNEL = "node_preemption"
 
+# GCS KV namespace for registered profile captures: profile_id ->
+# capture record (meta only — artifact bytes stay in the coordinating
+# driver's ProfileStore; the record names them per node).
+PROFILE_NS = "_profiles"
+
 
 class KVStore:
     """Namespaced key-value store (reference: gcs_kv_manager.h)."""

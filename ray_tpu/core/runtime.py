@@ -202,6 +202,11 @@ class Runtime:
         self.node_stats = _stats.NodeStatsCollector(self)
         _stats.register_node_gauges()
         register_runtime_gauges()
+        # profiling plane: driver-side registry of coordinated captures
+        # (util/profiling ProfileStore; filled by profile_capture below)
+        from ..util import profiling as _profiling
+
+        self.profiles = _profiling.ProfileStore()
         # multi-process cluster membership (core/cluster.py): the head
         # serves its GCS over RPC; workers join an existing head. Either
         # way this process gains a node server + remote dispatch.
@@ -749,6 +754,149 @@ class Runtime:
 
     def task_events(self) -> List[Dict[str, Any]]:
         return list(self._task_events)
+
+    # -------------------------------------------------------------- profiling
+
+    def profile_capture(
+        self,
+        nodes: Optional[Sequence[str]] = None,
+        duration_s: Optional[float] = None,
+        device: bool = True,
+        host: bool = True,
+    ) -> Dict[str, Any]:
+        """Coordinated cluster capture: fan a time-boxed device-trace +
+        host-profile request out to the selected nodes (hex prefixes;
+        None = every alive node), run them CONCURRENTLY so the windows
+        overlap, collect the bounded artifacts back here, and register
+        the capture in the profile store + GCS `_profiles` table so
+        `state.list_profiles()`, `ray_tpu profile`, and the dashboard can
+        reach it. On the in-process runtime the logical nodes share one
+        process, so one local capture covers every selected node (the
+        non-head entries reference the head's artifacts)."""
+        import os as _os
+
+        from ..util import profiling as _profiling
+        from .config import cfg
+        from .gcs import PROFILE_NS
+
+        if duration_s is None:
+            duration_s = cfg.profile_default_duration_s
+        profile_id = _os.urandom(6).hex()
+        spec = {
+            "profile_id": profile_id, "duration_s": duration_s,
+            "device": device, "host": host,
+        }
+
+        def selected(node_hex: str) -> bool:
+            if not nodes:
+                return True
+            return any(node_hex.startswith(p) for p in nodes)
+
+        started_at = time.time()
+        node_metas: Dict[str, Dict[str, Any]] = {}
+        blobs: Dict[Tuple[str, str], bytes] = {}
+        ctx = self.cluster
+        if ctx is None:
+            head_hex = self.scheduler.head_node().node_id.hex()
+            chosen = [
+                n.node_id.hex() for n in self.scheduler.nodes()
+                if n.alive and selected(n.node_id.hex())
+            ]
+            if not chosen:
+                raise ValueError(
+                    f"no alive node matches the capture selector {nodes!r}"
+                )
+            local = _profiling.capture_local_profile(
+                duration_s, device=device, host=host, profile_id=profile_id
+            )
+            artifact_hex = head_hex if head_hex in chosen else chosen[0]
+            for name, data in local["artifacts"].items():
+                blobs[(artifact_hex, name)] = data
+            for node_hex in chosen:
+                meta = dict(local["meta"])
+                if node_hex != artifact_hex:
+                    meta["artifacts_at"] = artifact_hex
+                    meta["artifact_names"] = []
+                node_metas[node_hex] = meta
+        else:
+            local_hex = ctx.node_id.hex()
+            results: Dict[str, Dict[str, Any]] = {}
+            workers: List[threading.Thread] = []
+            if selected(local_hex):
+                workers.append(threading.Thread(
+                    target=lambda: results.__setitem__(
+                        local_hex,
+                        _profiling.capture_local_profile(
+                            duration_s, device=device, host=host,
+                            profile_id=profile_id,
+                        ),
+                    ),
+                    daemon=True, name="ray_tpu-profile-local",
+                ))
+
+            def run_remote(node_hex: str, addr: str) -> None:
+                # dedicated client: the capture blocks for the whole
+                # window, which can exceed the shared agent client's
+                # timeout — and must not head-of-line block dispatches
+                from .rpc import RpcClient
+
+                client = RpcClient(
+                    addr, timeout=duration_s + 30.0, retries=0,
+                    token=ctx.token,
+                )
+                try:
+                    results[node_hex] = client.call("profile_capture", spec)
+                except Exception as exc:  # noqa: BLE001 - partial captures are fine
+                    results[node_hex] = {
+                        "meta": {"error": repr(exc)}, "artifacts": {},
+                    }
+                finally:
+                    client.close()
+
+            for info in ctx.nodes():
+                node_hex = info.get("node_id")
+                if (
+                    not node_hex or node_hex == local_hex
+                    or not selected(node_hex) or not info.get("address")
+                ):
+                    continue
+                workers.append(threading.Thread(
+                    target=run_remote, args=(node_hex, info["address"]),
+                    daemon=True,
+                    name=f"ray_tpu-profile-{node_hex[:8]}",
+                ))
+            if not workers:
+                raise ValueError(
+                    f"no cluster node matches the capture selector {nodes!r}"
+                )
+            for w in workers:
+                w.start()
+            for w in workers:
+                w.join(timeout=duration_s + 60.0)
+            for node_hex, res in results.items():
+                node_metas[node_hex] = res.get("meta", {})
+                for name, data in (res.get("artifacts") or {}).items():
+                    blobs[(node_hex, name)] = data
+        record = {
+            "profile_id": profile_id,
+            "started_at": started_at,
+            "duration_s": duration_s,
+            "device": device,
+            "host": host,
+            "nodes": node_metas,
+            "total_bytes": sum(len(b) for b in blobs.values()),
+        }
+        self.profiles.add(record, blobs)
+        # register the record (meta only) in the GCS profile table so
+        # other drivers/status observers see the capture happened
+        try:
+            if ctx is not None:
+                ctx.gcs.kv_put(profile_id, record, namespace=PROFILE_NS)
+            else:
+                self.gcs.kv.put(profile_id, record, namespace=PROFILE_NS)
+        except Exception:  # noqa: BLE001 - registration is observability
+            pass
+        return record
 
     # ------------------------------------------------------------- preemption
 

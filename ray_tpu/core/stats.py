@@ -140,6 +140,15 @@ class NodeStatsCollector:
         return {"pending": pending, "blocked": blocked,
                 "admission": admission}
 
+    @staticmethod
+    def _sample_profiling() -> Dict[str, Any]:
+        from ..util import profiling
+
+        try:
+            return profiling.node_snapshot()
+        except Exception:  # noqa: BLE001 - degraded snapshot over a raise
+            return {}
+
     def snapshot(self) -> Dict[str, Any]:
         """One telemetry snapshot of this node. Keys are stable: the GCS
         node table, `state.summary()["node_stats"]`, and `ray_tpu
@@ -159,6 +168,11 @@ class NodeStatsCollector:
             "health": dict(rt.health.stats),
             "pubsub": dict(getattr(rt.gcs.pubsub, "stats", {})),
             "tpu": sample_tpu_stats(),
+            # profiler-server port + active/recent capture: `ray_tpu
+            # status --verbose` and xprof attach read these off the
+            # heartbeat-piggybacked snapshot (util/profiling keeps jax
+            # imports function-local, so this costs nothing on observers)
+            "profiling": self._sample_profiling(),
         }
         if cluster is not None:
             snap["agent"] = dict(cluster.agent_stats)

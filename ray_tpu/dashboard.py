@@ -36,6 +36,7 @@ th{background:#eee} code{background:#eee;padding:0 .3em}
 <h2>Recent tasks</h2><table id="tasks"></table>
 <h2>Jobs</h2><table id="jobs"></table>
 <h2>Traces</h2><table id="traces"></table>
+<h2>Profiles</h2><table id="profiles"></table>
 <h2>Events</h2><table id="events"></table>
 <h2>Logs (per node, last lines)</h2><pre id="logs" style="font-size:.75em;background:#eee;padding:.6em;max-height:22em;overflow:auto"></pre>
 <script>
@@ -73,6 +74,11 @@ async function refresh() {
       trace: `<a href="/trace?id=${t.trace_id}">${t.trace_id.slice(0,12)}</a>`,
       root: t.root, spans: t.spans, errors: t.errors,
       duration_s: t.duration_s.toFixed(4),
+    })));
+    const pr = await (await fetch("/api/profiles")).json();
+    fill("profiles", pr.slice(-10).reverse().map(p => ({
+      profile: p.profile_id, nodes: Object.keys(p.nodes || {}).length,
+      duration_s: p.duration_s, bytes: p.total_bytes,
     })));
     const ev = await (await fetch("/api/events")).json();
     fill("events", ev.slice(-15).reverse());
@@ -186,6 +192,15 @@ class _Handler(BaseHTTPRequestHandler):
 
                 parsed = urlparse(self.path)
                 query = {k: v[0] for k, v in parse_qs(parsed.query).items()}
+                if parsed.path == "/api/profile_artifact":
+                    # binary download of one captured artifact
+                    from .util import state
+
+                    data = state.profile_artifact(
+                        query["id"], query["node"], query["name"]
+                    )
+                    self._send_bytes(200, data, "application/octet-stream")
+                    return
                 self._send(200, json.dumps(self._api(parsed.path[5:], query)),
                            "application/json")
                 return
@@ -235,7 +250,18 @@ class _Handler(BaseHTTPRequestHandler):
                 raise ValueError("trace endpoint needs ?id=<trace_id>")
             return state.get_trace(query["id"])
         if name == "trace_export":
-            return json.loads(state.trace_dump(trace_id=query.get("id")))
+            return json.loads(state.trace_dump(
+                trace_id=query.get("id"),
+                profile_id=query.get("profile_id"),
+            ))
+        if name == "profiles":
+            # artifact bytes stay behind /api/profile_artifact; the list
+            # is meta only (per-node status + artifact names/sizes)
+            return state.list_profiles()
+        if name == "profile":
+            if "id" not in query:
+                raise ValueError("profile endpoint needs ?id=<profile_id>")
+            return state.get_profile(query["id"])
         if name == "events":
             return state.list_events()
         if name == "cluster_events":
@@ -261,7 +287,9 @@ class _Handler(BaseHTTPRequestHandler):
         raise ValueError(f"unknown endpoint {name!r}")
 
     def _send(self, code: int, body: str, ctype: str) -> None:
-        data = body.encode()
+        self._send_bytes(code, body.encode(), ctype)
+
+    def _send_bytes(self, code: int, data: bytes, ctype: str) -> None:
         self.send_response(code)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(data)))

@@ -20,6 +20,7 @@ import itertools
 import queue
 import threading
 import time
+import weakref
 from typing import Any, Dict, List, Optional
 
 import jax
@@ -117,6 +118,118 @@ def _finish_request_span(request: "_Request", status: str = "OK") -> None:
                 / (request.generated - 1)
             )
     span.end(status=status, **attrs)
+
+
+# ------------------------------------------- batch-occupancy accounting
+#
+# Both engines (dense + paged) keep per-tick occupancy numbers in their
+# `metrics` dict; this registry exposes them as engine-labeled callback
+# gauges so the SLO monitor and a future autoscaler can read batch
+# headroom straight off /metrics. Weak values: a shut-down engine's
+# series disappears instead of freezing at its last value.
+
+_ENGINES: "weakref.WeakValueDictionary[str, Any]" = weakref.WeakValueDictionary()
+_engine_seq = itertools.count()
+_TICK_EWMA = 0.2  # per-tick smoothing for tick_seconds/decode_mfu
+
+
+def _register_engine_metrics(engine: Any, kind: str) -> str:
+    label = f"{kind}-{next(_engine_seq)}"
+    _ENGINES[label] = engine
+    _ensure_engine_gauges()
+    return label
+
+
+def _engine_metric_sampler(key: str):
+    def sample():
+        return [
+            ({"engine": label}, float(e.metrics.get(key, 0.0)))
+            for label, e in list(_ENGINES.items())
+        ]
+
+    return sample
+
+
+def _ensure_engine_gauges() -> None:
+    # no module-level one-shot latch: get_or_create_gauge is idempotent
+    # against the LIVE registry, which tests reset with registry().clear()
+    from ...util.metrics import get_or_create_gauge
+
+    get_or_create_gauge(
+        "raytpu_engine_batch_fill",
+        "Fraction of the engine's decode slots occupied at the last tick "
+        "(batch headroom for the SLO monitor / autoscaler).",
+        tag_keys=("engine",), fn=_engine_metric_sampler("batch_fill"),
+    )
+    get_or_create_gauge(
+        "raytpu_engine_tick_seconds",
+        "EWMA wall time of one engine tick (decode round / paged loop "
+        "iteration that made progress).",
+        tag_keys=("engine",), fn=_engine_metric_sampler("tick_seconds"),
+    )
+    get_or_create_gauge(
+        "raytpu_engine_decode_mfu",
+        "Model-FLOPs utilization of the decode program, from its "
+        "compiled cost_analysis() over the EWMA tick time.",
+        tag_keys=("engine",), fn=_engine_metric_sampler("decode_mfu"),
+    )
+
+    def token_mix():
+        out = []
+        for label, e in list(_ENGINES.items()):
+            out.append((
+                {"engine": label, "phase": "prefill"},
+                float(e.metrics.get("prefill_tokens", 0.0)),
+            ))
+            out.append((
+                {"engine": label, "phase": "decode"},
+                float(e.metrics.get("decode_tokens", 0.0)),
+            ))
+        return out
+
+    get_or_create_gauge(
+        "raytpu_engine_token_mix",
+        "Cumulative tokens processed per phase (prefill-ingested vs "
+        "decode-generated): the batch composition serving capacity "
+        "planning prices against.",
+        tag_keys=("engine", "phase"), fn=token_mix,
+    )
+
+
+def _tick_cost(fn: Any, *args: Any):
+    """cost_analysis() of an engine's compiled tick program at the live
+    argument shapes — called BEFORE the first dispatch (donated buffers
+    are still alive), cached by the caller. Returns None when disabled
+    (profile_cost_accounting — the AOT lower/compile pays one extra XLA
+    compile per program) or the backend can't answer; accounting never
+    fails a tick."""
+    try:
+        from ...core.config import cfg
+        from ...util import profiling
+
+        if not cfg.profile_cost_accounting:
+            return None
+        return profiling.step_cost(fn, *args)
+    except Exception:  # noqa: BLE001 - accounting must not kill the engine
+        return None
+
+
+def _observe_tick(engine: Any, tick_s: float) -> None:
+    """Fold one tick's wall time into the EWMA and refresh the decode
+    MFU against the cached tick cost."""
+    prev = engine.metrics.get("tick_seconds", 0.0)
+    ewma = tick_s if prev <= 0 else (1 - _TICK_EWMA) * prev + _TICK_EWMA * tick_s
+    engine.metrics["tick_seconds"] = ewma
+    cost = getattr(engine, "_tick_cost", None)
+    if cost and ewma > 0:  # False = accounting unavailable on this backend
+        try:
+            from ...util import profiling
+
+            roof = profiling.roofline(cost, ewma)
+            engine.metrics["decode_mfu"] = roof["mfu"]
+            engine.metrics["decode_flops"] = cost.total_flops
+        except Exception:  # noqa: BLE001 - accounting must not kill the engine
+            pass
 
 
 def _queue_bound(config) -> int:
@@ -275,7 +388,14 @@ class LLMEngine:
             "ongoing": 0.0,
             "shed": 0.0,
             "timeouts": 0.0,
+            # batch-occupancy accounting (engine-labeled gauges above)
+            "batch_fill": 0.0,
+            "tick_seconds": 0.0,
+            "prefill_tokens": 0.0,
+            "decode_tokens": 0.0,
         }
+        self._tick_cost = None  # decode program cost, set on first round
+        self.metrics_label = _register_engine_metrics(self, "dense")
         self._thread = threading.Thread(target=self._loop, daemon=True, name="llm-engine")
         self._thread.start()
 
@@ -394,6 +514,7 @@ class LLMEngine:
         first = int(self._sample(last_logits, sub, temps)[0])
         request.first_token_at = time.perf_counter()
         prefill_span.end(bucket=bucket)
+        self.metrics["prefill_tokens"] += float(len(prompt))
         request.generated += 1
         request.out.put(first)
         slot.request = request
@@ -431,6 +552,7 @@ class LLMEngine:
                 self._finish(slot)
 
     def _decode_round(self) -> None:
+        t0 = time.perf_counter()
         tokens = np.zeros(len(self.slots), dtype=np.int32)
         positions = np.zeros(len(self.slots), dtype=np.int32)
         temps = np.zeros(len(self.slots), dtype=np.float32)
@@ -441,12 +563,20 @@ class LLMEngine:
                 positions[i] = slot.position
                 temps[i] = slot.request.temperature
                 active.append(i)
+        dev_tokens, dev_positions = jnp.asarray(tokens), jnp.asarray(positions)
+        if self._tick_cost is None:
+            # before the first dispatch: the donated cache is still live
+            self._tick_cost = _tick_cost(
+                self._decode, self.params, self.cache, dev_tokens, dev_positions
+            ) or False
         logits, self.cache = self._decode(
-            self.params, self.cache, jnp.asarray(tokens), jnp.asarray(positions)
+            self.params, self.cache, dev_tokens, dev_positions
         )
         self._key, sub = jax.random.split(self._key)
         sampled = np.asarray(self._sample(logits, sub, jnp.asarray(temps)))
         self.metrics["decode_steps"] += 1
+        self.metrics["decode_tokens"] += float(len(active))
+        _observe_tick(self, time.perf_counter() - t0)
         for i in active:
             slot = self.slots[i]
             token = int(sampled[i])
@@ -474,6 +604,7 @@ class LLMEngine:
                 self._deadline_sweep()
                 n_active = sum(1 for s in self.slots if not s.free)
                 self.metrics["ongoing"] = float(n_active) + self._queue.qsize()
+                self.metrics["batch_fill"] = n_active / max(len(self.slots), 1)
                 if n_active == 0:
                     self._wake.wait(timeout=0.05)
                     self._wake.clear()

@@ -50,8 +50,11 @@ from .engine import (
     _finish_request_span,
     _hit_stop_sequence,
     _normalize_stop_sequences,
+    _observe_tick,
+    _register_engine_metrics,
     _reject_if_dead,
     _start_request_span,
+    _tick_cost,
     _timeout_request,
 )
 from .paged import (
@@ -370,7 +373,14 @@ class PagedLLMEngine:
             "pages_in_use": 0.0,
             "shed": 0.0,
             "timeouts": 0.0,
+            # batch-occupancy accounting (engine.py gauge registry)
+            "batch_fill": 0.0,
+            "tick_seconds": 0.0,
+            "prefill_tokens": 0.0,
+            "decode_tokens": 0.0,
         }
+        self._tick_cost = None  # decode-block cost, set at first dispatch
+        self.metrics_label = _register_engine_metrics(self, "paged")
         if self.config.precompile:
             self._precompile()
         self._drainer = threading.Thread(
@@ -573,6 +583,7 @@ class PagedLLMEngine:
             slot = self.slots[idx]
             prompt = slot.request.prompt
             n_real = min(ct, len(prompt) - offset)
+            self.metrics["prefill_tokens"] += float(n_real)
             tokens[lane, :n_real] = prompt[offset : offset + n_real]
             page_rows[lane] = self.block_tables[idx]
             chunk_ids[lane] = slot.pages[first_page : first_page + cp]
@@ -706,6 +717,12 @@ class PagedLLMEngine:
                 *common, jnp.asarray(top_ks), jnp.asarray(top_ps)
             )
         else:
+            if self._tick_cost is None:
+                # before the dispatch consumes the donated cache: price
+                # the fused K-step decode block once
+                self._tick_cost = _tick_cost(
+                    self._decode_block_plain, *common
+                ) or False
             toks, final, self.cache = self._decode_block_plain(*common)
         # Per-lane merge: lanes excluded from this dispatch keep their
         # pending token (see _merge_tokens docstring).
@@ -829,6 +846,8 @@ class PagedLLMEngine:
         request.out.put(token)
         slot.emit_remaining -= 1
         self.metrics["generated_tokens"] += 1
+        if not first:  # first tokens are the prefill's output
+            self.metrics["decode_tokens"] += 1.0
         if (
             token == self.config.eos_id
             or token in request.stop_token_ids
@@ -904,6 +923,7 @@ class PagedLLMEngine:
     def _loop_inner(self) -> None:
         pc = self.paged
         while not self._stop.is_set():
+            tick_t0 = time.perf_counter()
             self._admit()
             self._deadline_sweep()
             progressed = self._prefill_tick()
@@ -930,6 +950,9 @@ class PagedLLMEngine:
             self.metrics["pages_in_use"] = float(
                 pc.num_pages - 1 - self.allocator.available
             )
+            self.metrics["batch_fill"] = occupied / max(len(self.slots), 1)
+            if progressed:
+                _observe_tick(self, time.perf_counter() - tick_t0)
             if occupied == 0 and not self._inflight:
                 self._wake.wait(timeout=0.02)
                 self._wake.clear()

@@ -47,6 +47,10 @@ class Result:
     num_restarts: int = 0
     # announced-preemption restarts, budgeted separately from failures
     num_preempt_restarts: int = 0
+    # last cost-analysis accounting the gang reported (util/profiling):
+    # mfu, step_flops, roofline fractions — None when the train_fn never
+    # reported them (custom loops without LMTrainer.profiling_metrics)
+    profiling: Optional[Dict[str, Any]] = None
 
 
 class _PreemptRestart:
@@ -111,6 +115,9 @@ class TrainController:
         # stall/straggler watchdog of the CURRENT attempt (util/watchdog):
         # fed from the poll loop, inspectable by tests/status tooling
         self.stall_watchdog = None
+        # newest cost-analysis accounting drained from rank-0 reports
+        # (published as gauges by the poll loop; lands in Result.profiling)
+        self.last_profiling: Optional[Dict[str, Any]] = None
 
     def decide_num_workers(self) -> int:
         """Elastic sizing (reference v2 ScalingPolicy): fit the gang to
@@ -409,6 +416,8 @@ class TrainController:
                     self.stall_watchdog.observe_report(rank, ts)
                     if rank == 0:
                         self.metrics_history.append(metrics)
+                        if isinstance(metrics, dict) and "mfu" in metrics:
+                            self._publish_profiling(metrics)
                     if ckpt_step is not None:
                         prev = self.latest_checkpoint_step
                         self.latest_checkpoint_step = (
@@ -456,6 +465,49 @@ class TrainController:
             self.stall_watchdog.check()
             time.sleep(self.poll_interval)
 
+    def _publish_profiling(self, metrics: Dict[str, Any]) -> None:
+        """Turn a rank-0 report's cost-analysis accounting (mfu,
+        step_flops, roofline fractions — LMTrainer.profiling_metrics)
+        into run-labeled gauges. The poll loop is the publisher so the
+        numbers exist even when the driver never touches the Result."""
+        from ..util.metrics import get_or_create_gauge
+
+        tags = {"run": self.run_config.name}
+        keep = {
+            k: metrics[k]
+            for k in ("mfu", "step_flops", "step_bytes", "step_time_s",
+                      "roofline_hbm", "roofline_bound")
+            if k in metrics
+        }
+        self.last_profiling = keep
+        get_or_create_gauge(
+            "raytpu_train_mfu",
+            "Model-FLOPs utilization of the train step, from the compiled "
+            "step's cost_analysis() over the measured step time.",
+            tag_keys=("run",),
+        ).set(float(metrics["mfu"]), tags=tags)
+        if "step_flops" in metrics:
+            get_or_create_gauge(
+                "raytpu_train_step_flops",
+                "Whole-program FLOPs of one compiled train step "
+                "(cost_analysis; per-device flops x device count).",
+                tag_keys=("run",),
+            ).set(float(metrics["step_flops"]), tags=tags)
+        if "roofline_hbm" in metrics:
+            get_or_create_gauge(
+                "raytpu_train_roofline_fraction",
+                "Fraction of the chip roofline one train step achieves, "
+                "per resource (compute = MFU, hbm = bandwidth share).",
+                tag_keys=("run", "resource"),
+            ).set(float(metrics["mfu"]), tags={**tags, "resource": "compute"})
+            get_or_create_gauge(
+                "raytpu_train_roofline_fraction",
+                "Fraction of the chip roofline one train step achieves, "
+                "per resource (compute = MFU, hbm = bandwidth share).",
+                tag_keys=("run", "resource"),
+            ).set(float(metrics["roofline_hbm"]),
+                  tags={**tags, "resource": "hbm"})
+
     def _got_emergency_ckpt(self, baseline: Optional[int]) -> bool:
         """A checkpoint newer than the pre-notice state has landed."""
         latest = self.latest_checkpoint_step
@@ -470,4 +522,5 @@ class TrainController:
             error=error,
             num_restarts=self.num_restarts,
             num_preempt_restarts=self.num_preempt_restarts,
+            profiling=self.last_profiling,
         )

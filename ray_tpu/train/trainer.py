@@ -89,6 +89,10 @@ class LMTrainer:
             z_loss_coeff=z_loss_coeff,
             grad_accum=grad_accum,
         )
+        # cost_analysis() of the compiled step (util/profiling), computed
+        # once the first time a report needs it (one extra AOT compile;
+        # disable with profile_cost_accounting=False)
+        self._step_cost = None
         self.ckpt_config = checkpoint_config
         self.ckpt_mgr: Optional[CheckpointManager] = None
         if checkpoint_config and checkpoint_config.checkpoint_dir:
@@ -136,6 +140,7 @@ class LMTrainer:
         tokens_done = 0.0
         last_metrics: Dict[str, Any] = {}
         steps = 0
+        window_t0, window_steps = t0, 0
         for batch in batches:
             if num_steps is not None and steps >= num_steps:
                 break
@@ -144,12 +149,21 @@ class LMTrainer:
                 batch = {"tokens": jax.numpy.asarray(tokens)}
             self.state, metrics = self.step_fn(self.state, batch)
             steps += 1
+            window_steps += 1
             tokens_done += float(tokens.shape[0] * (tokens.shape[1] - 1))
             if steps % report_every == 0 or (num_steps is not None and steps == num_steps):
                 metrics = {k: float(v) for k, v in metrics.items()}
-                elapsed = time.perf_counter() - t0
+                now = time.perf_counter()
+                elapsed = now - t0
                 metrics["tokens_per_sec"] = tokens_done / max(elapsed, 1e-9)
                 metrics["step"] = int(self.state.step)
+                # MFU/roofline from the compiled step's cost_analysis()
+                # over this window's measured step time (the first window
+                # absorbs the compile, so its MFU reads low)
+                metrics.update(self.profiling_metrics(
+                    batch, (now - window_t0) / max(window_steps, 1)
+                ))
+                window_t0, window_steps = now, 0
                 last_metrics = metrics
                 report_fn(metrics)
             if ckpt_every and steps % ckpt_every == 0 and self.ckpt_mgr is not None:
@@ -158,6 +172,40 @@ class LMTrainer:
             self.save_checkpoint()
             self.ckpt_mgr.wait_until_finished()
         return last_metrics
+
+    def step_cost(self, batch: Dict[str, Any]):
+        """cost_analysis() of the compiled train step at this batch's
+        shapes (util/profiling StepCost), cached after the first call."""
+        if self._step_cost is None:
+            from ..util import profiling
+
+            self._step_cost = profiling.step_cost(self.step_fn, self.state, batch)
+        return self._step_cost
+
+    def profiling_metrics(self, batch: Dict[str, Any],
+                          step_time_s: float) -> Dict[str, Any]:
+        """MFU + roofline fractions for one measured step time, from the
+        compiled step's cost_analysis — NOT hand-derived 6ND constants.
+        Empty dict when the backend can't answer (cost accounting must
+        never fail a training run)."""
+        try:
+            from ..core.config import cfg
+            from ..util import profiling
+
+            if not cfg.profile_cost_accounting:
+                return {"step_time_s": step_time_s}
+            cost = self.step_cost(batch)
+            roof = profiling.roofline(cost, max(step_time_s, 1e-9))
+            return {
+                "step_time_s": step_time_s,
+                "mfu": roof["mfu"],
+                "step_flops": cost.total_flops,
+                "step_bytes": cost.total_bytes,
+                "roofline_hbm": roof["hbm_fraction"],
+                "roofline_bound": roof["bound"],
+            }
+        except Exception:  # noqa: BLE001 - accounting must not kill training
+            return {}
 
     def save_checkpoint(self) -> int:
         step = int(jax.device_get(self.state.step))

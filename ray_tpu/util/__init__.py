@@ -16,13 +16,17 @@ from .metrics import (  # noqa: F401
 from .state import (  # noqa: F401
     chrome_tracing_dump,
     cluster_metrics,
+    get_profile,
     get_trace,
     list_actors,
     list_nodes,
     list_objects,
+    list_profiles,
     list_tasks,
     list_traces,
     node_stats,
+    profile,
+    profile_artifact,
     status_report,
     summary,
     trace_dump,
@@ -30,11 +34,18 @@ from .state import (  # noqa: F401
 from . import tracing, watchdog  # noqa: F401
 from .actor_pool import ActorPool  # noqa: F401
 from .profiling import (  # noqa: F401
+    ProfilingError,
+    StepCost,
     annotate,
+    capture_local_profile,
+    device_peaks,
     device_trace,
+    profiler_server_port,
+    roofline,
     start_device_trace,
     start_profiler_server,
     step_annotation,
+    step_cost,
     stop_device_trace,
 )
 from .queue import Empty, Full, Queue  # noqa: F401

@@ -1,41 +1,100 @@
-"""Device-level profiling: the jax.profiler bridge.
+"""Profiling plane: device/host capture + compiled-graph cost accounting.
 
 Reference parity: the tracing/profiling aux subsystem (SURVEY.md §5 —
-the reference wires OpenTelemetry spans through its workers and `ray
-timeline` dumps chrome traces). TPU inversion: the interesting timeline
-is on the DEVICE, and XLA already has a first-class profiler. This
-module is the thin, always-importable bridge:
+the reference ships `ray timeline` and per-worker profiling as a
+first-class subsystem). TPU inversion: the interesting timeline is on
+the DEVICE, and XLA already has a first-class profiler *and* a
+first-class cost model — so this module is three things:
 
-- ``device_trace(logdir)`` captures a TensorBoard-loadable XLA trace
-  (HLO timings, memory, ICI collectives) around any block of work.
-- ``start_profiler_server(port)`` exposes the live profiling endpoint
-  that `tensorboard --logdir` / `xprof` can attach to on demand.
-- ``annotate(name)`` labels host-side regions so device traces line up
-  with runtime phases (engine ticks, train steps).
+1. The **jax.profiler bridge** (`device_trace`, `start_profiler_server`,
+   `annotate`) with typed errors (`ProfilingError`) instead of raw jax
+   exceptions, an idempotent profiler server whose port rides the node
+   stats snapshot, and `capture_local_profile` — a time-boxed device
+   trace plus a host-side sampling profile, collected as bounded
+   artifact bytes the cluster capture RPC ships back to the head.
+2. The **cost-model layer**: `step_cost` reads
+   ``compiled.cost_analysis()`` FLOPs/bytes off any jitted/compiled
+   step, `device_peaks` prices them against the detected chip's peak
+   FLOPs/HBM bandwidth, and `roofline` turns (cost, step time) into
+   MFU + roofline fractions — the currency every TPU perf claim is
+   quoted in. bench.py and the train/serve MFU gauges all go through
+   here instead of hand-maintained constants.
+3. The **ProfileStore**: captured artifacts registered on the driver so
+   `state.list_profiles()/get_profile()`, `ray_tpu profile`, and the
+   dashboard download route can reach them, and `trace_dump` can merge
+   a capture's device events into the Perfetto export.
 
-Host-side task timelines remain in util/state.py (`chrome_tracing_dump`,
-`ray_tpu timeline`); the two views compose — state.py tells you WHAT the
-runtime ran, this module tells you what the CHIP did during it.
+Import discipline: jax imports stay FUNCTION-LOCAL so this module (and
+core/stats.py, which reads `node_snapshot()`) imports on jax-less
+observer hosts — enforced by scripts/check_lazy_jax.py.
 """
 
 from __future__ import annotations
 
 import contextlib
-from typing import Iterator, Optional
+import dataclasses
+import gzip
+import json
+import os
+import sys
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+from ..core.exceptions import ProfilingError
+
+# ----------------------------------------------------- device trace (typed)
+
+# Module-level latch: jax.profiler allows one trace per process, and its
+# double-start/orphan-stop failures are raw RuntimeErrors with
+# backend-specific strings. The latch lets us raise typed errors BEFORE
+# touching jax, and lets captures report "busy" instead of colliding.
+_trace_lock = threading.Lock()
+_trace_logdir: Optional[str] = None
 
 
-def start_device_trace(logdir: str) -> None:
+def start_device_trace(logdir: str, *, perfetto: bool = True) -> None:
     """Begin capturing an XLA device trace into `logdir` (view with
-    TensorBoard's profile plugin)."""
-    import jax
-
-    jax.profiler.start_trace(logdir)
+    TensorBoard's profile plugin or ui.perfetto.dev). Raises
+    `ProfilingError` when a trace is already active or jax is missing."""
+    global _trace_logdir
+    with _trace_lock:
+        if _trace_logdir is not None:
+            raise ProfilingError(
+                f"a device trace into {_trace_logdir!r} is already active; "
+                f"stop it before starting another"
+            )
+        try:
+            import jax
+        except ImportError as exc:
+            raise ProfilingError(f"device tracing requires jax: {exc!r}") from exc
+        try:
+            jax.profiler.start_trace(logdir, create_perfetto_trace=perfetto)
+        except Exception as exc:  # noqa: BLE001 - typed boundary
+            raise ProfilingError(f"start_trace failed: {exc!r}") from exc
+        _trace_logdir = logdir
 
 
 def stop_device_trace() -> None:
-    import jax
+    """Stop the active device trace. Raises `ProfilingError` (not a raw
+    jax RuntimeError) when no trace is active."""
+    global _trace_logdir
+    with _trace_lock:
+        if _trace_logdir is None:
+            raise ProfilingError("no active device trace to stop")
+        import jax
 
-    jax.profiler.stop_trace()
+        try:
+            jax.profiler.stop_trace()
+        except Exception as exc:  # noqa: BLE001 - typed boundary
+            raise ProfilingError(f"stop_trace failed: {exc!r}") from exc
+        finally:
+            _trace_logdir = None
+
+
+def device_trace_active() -> bool:
+    return _trace_logdir is not None
 
 
 @contextlib.contextmanager
@@ -50,13 +109,45 @@ def device_trace(logdir: str) -> Iterator[None]:
         stop_device_trace()
 
 
+# --------------------------------------------------- profiler server (xprof)
+
+_server_lock = threading.Lock()
+_profiler_server: Any = None
+_profiler_server_port: Optional[int] = None
+
+
 def start_profiler_server(port: int = 9999):
-    """Serve the live profiling endpoint (attach with TensorBoard:
-    capture profile -> 'localhost:<port>')."""
-    import jax
+    """Serve the live profiling endpoint (attach with TensorBoard/xprof:
+    capture profile -> 'localhost:<port>'). Idempotent: repeat calls
+    return the existing server (jax allows one per process); the bound
+    port is advertised in the node stats snapshot (`node_snapshot`) so
+    operators can attach on demand."""
+    global _profiler_server, _profiler_server_port
+    with _server_lock:
+        if _profiler_server is not None:
+            return _profiler_server
+        try:
+            import jax
+        except ImportError as exc:
+            raise ProfilingError(
+                f"the profiler server requires jax: {exc!r}"
+            ) from exc
+        try:
+            _profiler_server = jax.profiler.start_server(port)
+        except Exception as exc:  # noqa: BLE001 - typed boundary
+            raise ProfilingError(
+                f"profiler server failed to start on port {port}: {exc!r}"
+            ) from exc
+        _profiler_server_port = port
+        return _profiler_server
 
-    return jax.profiler.start_server(port)
 
+def profiler_server_port() -> Optional[int]:
+    """Port of the live profiler server, or None when not started."""
+    return _profiler_server_port
+
+
+# ----------------------------------------------------------- annotations
 
 def annotate(name: str, **kwargs):
     """Named host-side region that shows up in device traces
@@ -75,3 +166,508 @@ def step_annotation(step: int, name: str = "train") -> Iterator[None]:
 
     with jax.profiler.StepTraceAnnotation(name, step_num=step):
         yield
+
+
+# ------------------------------------------------------ host-side profiling
+
+
+class HostProfiler:
+    """Time-boxed sampling profiler over EVERY thread of this process
+    (``sys._current_frames()`` at a fixed interval). cProfile instruments
+    only the installing thread, which is useless for profiling an agent
+    whose work happens on RPC/worker/engine threads — sampling sees them
+    all, stdlib-only, at bounded overhead."""
+
+    def __init__(self, interval_s: float = 0.005):
+        self.interval_s = interval_s
+        self._counts: Dict[Tuple[str, str], int] = {}
+        self._samples = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="ray_tpu-host-profiler"
+        )
+        self._thread.start()
+
+    def _loop(self) -> None:
+        names = {}
+        while not self._stop.wait(self.interval_s):
+            if not names:
+                names = {t.ident: t.name for t in threading.enumerate()}
+            self._samples += 1
+            for tid, frame in list(sys._current_frames().items()):
+                if frame is None:
+                    continue
+                stack: List[str] = []
+                depth = 0
+                while frame is not None and depth < 24:
+                    code = frame.f_code
+                    stack.append(
+                        f"{os.path.basename(code.co_filename)}:"
+                        f"{frame.f_lineno}:{code.co_name}"
+                    )
+                    frame = frame.f_back
+                    depth += 1
+                key = (names.get(tid, str(tid)), ";".join(reversed(stack)))
+                self._counts[key] = self._counts.get(key, 0) + 1
+
+    def stop(self) -> str:
+        """Stop sampling; returns a text report: per-thread top stacks by
+        sample count (a flamegraph collapses from the same lines)."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+        lines = [
+            f"# host sampling profile: {self._samples} samples @ "
+            f"{self.interval_s * 1e3:.1f}ms"
+        ]
+        ranked = sorted(self._counts.items(), key=lambda kv: -kv[1])[:200]
+        for (tname, stack), count in ranked:
+            lines.append(f"{count}\t{tname}\t{stack}")
+        return "\n".join(lines) + "\n"
+
+
+# --------------------------------------------------------- local capture
+
+# latch naming the capture currently running in this process (None = idle)
+_capture_lock = threading.Lock()
+_active_capture: Optional[str] = None
+# summary of the most recent finished capture: shown by `ray_tpu status
+# --verbose` via the node stats snapshot
+_last_capture: Optional[Dict[str, Any]] = None
+
+
+def capture_local_profile(duration_s: Optional[float] = None, *,
+                          device: bool = True, host: bool = True,
+                          profile_id: str = "",
+                          workload: Optional[Callable[[], Any]] = None,
+                          ) -> Dict[str, Any]:
+    """One time-boxed capture of THIS process: a jax device trace and/or
+    a host sampling profile, returned as bounded artifact bytes. This is
+    the agent side of the cluster `profile_capture` RPC and the whole of
+    the in-process path.
+
+    Returns {"meta": {...}, "artifacts": {name: bytes}}. Never raises
+    for a degraded capture (no jax, trace busy): the meta records what
+    was skipped and why, so a fan-out over mixed nodes still returns."""
+    import shutil
+    import tempfile
+
+    from ..core.config import cfg
+
+    global _active_capture, _last_capture
+    if duration_s is None:
+        duration_s = cfg.profile_default_duration_s
+    duration_s = max(0.05, float(duration_s))
+    meta: Dict[str, Any] = {
+        "profile_id": profile_id,
+        "started_at": time.time(),
+        "duration_s": duration_s,
+        "pid": os.getpid(),
+        "profiler_port": profiler_server_port(),
+        "device": "skipped",
+        "host": "skipped",
+    }
+    artifacts: Dict[str, bytes] = {}
+    with _capture_lock:
+        if _active_capture is not None:
+            meta["device"] = meta["host"] = f"busy: capture {_active_capture}"
+            return {"meta": meta, "artifacts": artifacts}
+        _active_capture = profile_id or "local"
+    logdir = None
+    sampler = None
+    try:
+        if device:
+            if sys.modules.get("jax") is None:
+                # an observer/agent that never imported jax must not pay
+                # the import (nor fail the host half of the capture)
+                meta["device"] = "skipped: jax not imported in this process"
+            else:
+                logdir = tempfile.mkdtemp(prefix="ray_tpu_prof_")
+                try:
+                    start_device_trace(logdir)
+                    meta["device"] = "ok"
+                except ProfilingError as exc:
+                    meta["device"] = f"error: {exc}"
+                    logdir = None
+        if host:
+            sampler = HostProfiler(interval_s=cfg.profile_host_sample_s)
+            sampler.start()
+            meta["host"] = "ok"
+        if workload is not None:
+            deadline = time.time() + duration_s
+            while time.time() < deadline:
+                workload()
+        else:
+            time.sleep(duration_s)
+    finally:
+        if logdir is not None:
+            try:
+                stop_device_trace()
+                artifacts.update(_collect_trace_artifacts(
+                    logdir, cfg.profile_max_artifact_bytes
+                ))
+            except ProfilingError as exc:
+                meta["device"] = f"error: {exc}"
+            shutil.rmtree(logdir, ignore_errors=True)
+        if sampler is not None:
+            artifacts["host_profile.txt"] = sampler.stop().encode()
+        with _capture_lock:
+            _active_capture = None
+    meta["bytes"] = sum(len(b) for b in artifacts.values())
+    meta["artifact_names"] = sorted(artifacts)
+    _last_capture = {
+        "profile_id": profile_id, "ts": meta["started_at"],
+        "duration_s": duration_s, "bytes": meta["bytes"],
+        "device": meta["device"], "host": meta["host"],
+    }
+    return {"meta": meta, "artifacts": artifacts}
+
+
+def _collect_trace_artifacts(logdir: str, max_bytes: int) -> Dict[str, bytes]:
+    """Gather the profiler's output files (xplane, trace.json.gz,
+    perfetto) as {relative_name: bytes}, bounded: the chrome-trace and
+    perfetto files (the mergeable/viewable ones) are collected first,
+    xplane blobs only with remaining budget."""
+    files: List[Tuple[str, str]] = []
+    for root, _dirs, names in os.walk(logdir):
+        for name in names:
+            full = os.path.join(root, name)
+            files.append((os.path.relpath(full, logdir), full))
+    # mergeable JSON traces first, then everything else by size ascending
+    files.sort(key=lambda t: (
+        0 if t[0].endswith(".trace.json.gz") else
+        1 if t[0].endswith("perfetto_trace.json.gz") else 2,
+        os.path.getsize(t[1]),
+    ))
+    out: Dict[str, bytes] = {}
+    budget = max_bytes
+    for rel, full in files:
+        size = os.path.getsize(full)
+        if size > budget:
+            continue
+        with open(full, "rb") as f:
+            out[rel.replace(os.sep, "/")] = f.read()
+        budget -= size
+    return out
+
+
+def node_snapshot() -> Dict[str, Any]:
+    """This process's profiling status for the node stats snapshot
+    (core/stats.py): profiler-server port, whether a capture is running,
+    and the last finished capture's summary."""
+    with _capture_lock:
+        active = _active_capture
+    return {
+        "server_port": _profiler_server_port,
+        "active_capture": active,
+        "last_capture": dict(_last_capture) if _last_capture else None,
+    }
+
+
+# ------------------------------------------------- device trace -> Perfetto
+
+
+def load_device_trace_events(artifacts: Dict[str, bytes], *,
+                             started_at: float, lane_prefix: str = "device",
+                             max_events: Optional[int] = None,
+                             ) -> List[Dict[str, Any]]:
+    """Parse a capture's chrome-trace artifact (`*.trace.json.gz`) into
+    trace events aligned to wall-clock time, ready to merge into the
+    span export: the profiler's timestamps are microseconds relative to
+    trace start, so each event is offset by the capture's `started_at`.
+    Lanes become "<lane_prefix>:<process name>" (e.g. `device:/device:
+    TPU:0`), so runtime spans and chip activity sit side by side in one
+    Perfetto view. Events are capped (largest durations win) to keep the
+    export loadable."""
+    from ..core.config import cfg
+
+    if max_events is None:
+        max_events = cfg.profile_merge_max_events
+    raw = None
+    for name in sorted(artifacts):
+        if name.endswith(".trace.json.gz"):
+            raw = artifacts[name]
+            break
+    if raw is None:
+        return []
+    try:
+        data = json.loads(gzip.decompress(raw))
+    except Exception as exc:  # noqa: BLE001 - corrupt artifact boundary
+        raise ProfilingError(f"undecodable device trace artifact: {exc!r}")
+    events = data.get("traceEvents", []) if isinstance(data, dict) else []
+    proc_names: Dict[Any, str] = {}
+    thread_names: Dict[Tuple[Any, Any], str] = {}
+    for e in events:
+        if e.get("ph") != "M":
+            continue
+        if e.get("name") == "process_name":
+            proc_names[e.get("pid")] = e.get("args", {}).get("name", "")
+        elif e.get("name") == "thread_name":
+            thread_names[(e.get("pid"), e.get("tid"))] = (
+                e.get("args", {}).get("name", "")
+            )
+    xs = [e for e in events if e.get("ph") == "X"]
+    # device tracks are the point; host-python tracks only ride along
+    # when there is budget left after them
+    xs.sort(key=lambda e: (
+        0 if "/device:" in proc_names.get(e.get("pid"), "") else 1,
+        -float(e.get("dur", 0.0)),
+    ))
+    xs = xs[:max_events]
+    offset_us = started_at * 1e6
+    out: List[Dict[str, Any]] = []
+    for e in xs:
+        pid = e.get("pid")
+        proc = proc_names.get(pid) or str(pid)
+        out.append({
+            "name": e.get("name", "?"),
+            "cat": "device",
+            "ph": "X",
+            "ts": offset_us + float(e.get("ts", 0.0)),
+            "dur": float(e.get("dur", 0.0)),
+            "pid": f"{lane_prefix}:{proc}",
+            "tid": thread_names.get((pid, e.get("tid")), str(e.get("tid"))),
+            "args": e.get("args", {}),
+        })
+    out.sort(key=lambda e: e["ts"])
+    return out
+
+
+# ------------------------------------------------------------ profile store
+
+
+class ProfileStore:
+    """Driver-side registry of captures: bounded LRU of records (meta +
+    per-node artifact bytes). The state API (`list_profiles`,
+    `get_profile`, `profile_artifact`), the CLI, and the dashboard
+    download route all read from here; capture metas are additionally
+    mirrored into the GCS `_profiles` table for cluster visibility."""
+
+    def __init__(self, capacity: Optional[int] = None):
+        from ..core.config import cfg
+
+        self._capacity = capacity or cfg.profile_store_capacity
+        self._records: "OrderedDict[str, Dict[str, Any]]" = OrderedDict()
+        self._artifacts: Dict[str, Dict[Tuple[str, str], bytes]] = {}
+        self._lock = threading.Lock()
+
+    def add(self, record: Dict[str, Any],
+            artifacts: Dict[Tuple[str, str], bytes]) -> None:
+        with self._lock:
+            pid = record["profile_id"]
+            self._records[pid] = record
+            self._artifacts[pid] = dict(artifacts)
+            while len(self._records) > self._capacity:
+                old, _ = self._records.popitem(last=False)
+                self._artifacts.pop(old, None)
+
+    def list(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [dict(r) for r in self._records.values()]
+
+    def get(self, profile_id: str) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            rec = self._records.get(profile_id)
+            return dict(rec) if rec is not None else None
+
+    def artifact(self, profile_id: str, node_hex: str,
+                 name: str) -> Optional[bytes]:
+        with self._lock:
+            return self._artifacts.get(profile_id, {}).get((node_hex, name))
+
+    def artifacts_for(self, profile_id: str,
+                      node_hex: Optional[str] = None) -> Dict[str, bytes]:
+        """All of one capture's artifacts (optionally one node's), keyed
+        `node_hex/name` — what the Perfetto merge and `--output` read."""
+        with self._lock:
+            blobs = self._artifacts.get(profile_id, {})
+            return {
+                f"{nh}/{name}": data
+                for (nh, name), data in blobs.items()
+                if node_hex is None or nh == node_hex
+            }
+
+
+# ----------------------------------------------------- cost model / roofline
+
+# Peak dense bf16 FLOPs/s and HBM bandwidth per chip generation. This is
+# the ONE table every MFU/roofline number in the repo prices against
+# (bench.py used to carry its own copy).
+_PEAK_FLOPS: Dict[str, float] = {
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,   # v5e
+    "TPU v5e": 197e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,   # v6e
+    "TPU v6e": 918e12,
+}
+_PEAK_HBM_BPS: Dict[str, float] = {
+    "TPU v4": 1228e9,
+    "TPU v5 lite": 819e9,
+    "TPU v5e": 819e9,
+    "TPU v5p": 2765e9,
+    "TPU v6 lite": 1640e9,
+    "TPU v6e": 1640e9,
+}
+# Unknown chips (and the CPU test backend) get nominal peaks so the
+# fractions stay defined; `estimated` flags them as not a hardware claim.
+_FALLBACK_PEAK_FLOPS = 1e12
+_FALLBACK_HBM_BPS = 100e9
+
+
+def device_peaks(device: Any = None) -> Dict[str, Any]:
+    """Peak FLOPs/s and HBM bandwidth of the attached (or given) device.
+    `estimated=True` marks the fallback used for unknown kinds/CPU."""
+    kind = "unknown"
+    if device is not None:
+        kind = getattr(device, "device_kind", "unknown")
+    else:
+        jax = sys.modules.get("jax")
+        if jax is not None:
+            try:
+                kind = getattr(jax.devices()[0], "device_kind", "unknown")
+            except Exception:  # noqa: BLE001 - no backend: fall back
+                kind = "unknown"
+    known = kind in _PEAK_FLOPS
+    return {
+        "device_kind": kind,
+        "peak_flops": _PEAK_FLOPS.get(kind, _FALLBACK_PEAK_FLOPS),
+        "peak_hbm_bps": _PEAK_HBM_BPS.get(kind, _FALLBACK_HBM_BPS),
+        "estimated": not known,
+    }
+
+
+@dataclasses.dataclass
+class StepCost:
+    """cost_analysis() of one compiled program, normalized. XLA reports
+    PER-DEVICE numbers for a sharded program (verified against an 8-way
+    sharded matmul: per-device flops = total/8), so `flops`/`bytes
+    _accessed` here are per device per invocation and MFU divides by the
+    per-device peak — `total_flops` is the whole-program count."""
+
+    flops: float
+    bytes_accessed: float
+    buckets: Dict[str, float]   # the raw analysis entries (numeric only)
+    device_kind: str
+    n_devices: int
+    peak_flops: float           # per device
+    peak_hbm_bps: float         # per device
+    estimated_peaks: bool
+
+    @property
+    def total_flops(self) -> float:
+        return self.flops * self.n_devices
+
+    @property
+    def total_bytes(self) -> float:
+        return self.bytes_accessed * self.n_devices
+
+    def top_buckets(self, k: int = 5) -> List[Tuple[str, float]]:
+        ranked = sorted(self.buckets.items(), key=lambda kv: -abs(kv[1]))
+        return ranked[:k]
+
+
+def compiled_cost(compiled: Any) -> Tuple[float, float, Dict[str, float]]:
+    """Normalize `compiled.cost_analysis()` (a dict on new jax, a
+    one-element list of dicts on the pinned 0.4.x) into
+    (flops, bytes_accessed, raw_numeric_buckets)."""
+    try:
+        analysis = compiled.cost_analysis()
+    except Exception as exc:  # noqa: BLE001 - typed boundary
+        raise ProfilingError(f"cost_analysis failed: {exc!r}") from exc
+    if isinstance(analysis, (list, tuple)):
+        analysis = analysis[0] if analysis else {}
+    if not isinstance(analysis, dict):
+        raise ProfilingError(
+            f"cost_analysis returned {type(analysis).__name__}, not a dict"
+        )
+    buckets = {
+        k: float(v) for k, v in analysis.items()
+        if isinstance(v, (int, float))
+    }
+    return (
+        float(analysis.get("flops", 0.0)),
+        float(analysis.get("bytes accessed", 0.0)),
+        buckets,
+    )
+
+
+def step_cost(fn: Any, *args: Any, **kwargs: Any) -> StepCost:
+    """FLOPs/bytes of one invocation of a jitted function at the given
+    example arguments, priced against the attached chip. `fn` may be a
+    jitted callable (lowered+compiled here via the AOT path — one extra
+    XLA compile, so callers cache the result) or an already-compiled
+    object exposing `cost_analysis()`."""
+    jax = sys.modules.get("jax")
+    if hasattr(fn, "cost_analysis"):
+        compiled = fn
+    elif hasattr(fn, "lower"):
+        try:
+            compiled = fn.lower(*args, **kwargs).compile()
+        except Exception as exc:  # noqa: BLE001 - typed boundary
+            raise ProfilingError(f"lower/compile failed: {exc!r}") from exc
+    else:
+        raise ProfilingError(
+            f"step_cost needs a jitted or compiled callable, got "
+            f"{type(fn).__name__}"
+        )
+    flops, nbytes, buckets = compiled_cost(compiled)
+    if flops <= 0 and nbytes <= 0:
+        raise ProfilingError(
+            "cost_analysis reported no flops/bytes for this program"
+        )
+    # devices the program actually spans (pjit over a mesh): read the
+    # first input sharding's device set, falling back to single-device
+    device = None
+    n_devices = 1
+    if jax is not None:
+        try:
+            leaves = jax.tree_util.tree_leaves(compiled.input_shardings)
+            device_set = getattr(leaves[0], "device_set", None) if leaves else None
+            if device_set:
+                n_devices = len(device_set)
+                device = next(iter(device_set))
+            else:
+                device = jax.devices()[0]
+        except Exception:  # noqa: BLE001 - peaks fall back below
+            device = None
+            n_devices = 1
+    peaks = device_peaks(device)
+    return StepCost(
+        flops=flops,
+        bytes_accessed=nbytes,
+        buckets=buckets,
+        device_kind=peaks["device_kind"],
+        n_devices=n_devices,
+        peak_flops=peaks["peak_flops"],
+        peak_hbm_bps=peaks["peak_hbm_bps"],
+        estimated_peaks=peaks["estimated"],
+    )
+
+
+def roofline(cost: StepCost, step_time_s: float) -> Dict[str, Any]:
+    """Price one step against the chip roofline. `mfu` is the model-
+    FLOPs-utilization (achieved / peak matmul throughput), `hbm_fraction`
+    the share of peak HBM bandwidth the program's byte traffic implies;
+    whichever fraction is higher names the binding resource. Per-device
+    cost over per-device peak: the step time is wall time, every device
+    runs its shard concurrently."""
+    if step_time_s <= 0:
+        raise ProfilingError(f"step_time_s must be positive, got {step_time_s}")
+    mfu = cost.flops / (step_time_s * cost.peak_flops)
+    hbm = cost.bytes_accessed / (step_time_s * cost.peak_hbm_bps)
+    return {
+        "mfu": mfu,
+        "hbm_fraction": hbm,
+        "bound": "memory" if hbm > mfu else "compute",
+        "flops_per_device": cost.flops,
+        "total_flops": cost.total_flops,
+        "bytes_per_device": cost.bytes_accessed,
+        "step_time_s": step_time_s,
+        "n_devices": cost.n_devices,
+        "device_kind": cost.device_kind,
+        "estimated_peaks": cost.estimated_peaks,
+    }

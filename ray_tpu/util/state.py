@@ -199,6 +199,23 @@ def status_report(verbose: bool = False) -> str:
                         f"{_fmt_bytes(dev.get('hbm_limit_bytes', 0))} "
                         f"duty={dev.get('duty', 0.0):.2f}"
                     )
+            prof = snap.get("profiling") or {}
+            if verbose and prof:
+                port = prof.get("server_port")
+                parts = [
+                    "profiler: "
+                    + (f"server on :{port}" if port else "server not started")
+                ]
+                if prof.get("active_capture"):
+                    parts.append(f"capturing {prof['active_capture']}")
+                last = prof.get("last_capture")
+                if last:
+                    parts.append(
+                        f"last capture {last.get('profile_id') or '(local)'} "
+                        f"{last.get('duration_s', 0.0):.1f}s "
+                        f"{_fmt_bytes(last.get('bytes', 0))}"
+                    )
+                lines.append("    " + "; ".join(parts))
     demand = runtime.scheduler.pending_demand()
     lines.append("")
     if demand:
@@ -254,6 +271,63 @@ def status_report(verbose: bool = False) -> str:
     return "\n".join(lines)
 
 
+def profile(nodes: Optional[List[str]] = None,
+            duration_s: Optional[float] = None,
+            device: bool = True, host: bool = True) -> Dict[str, Any]:
+    """Run a coordinated profile capture (device trace + host sampling
+    profile) over the selected nodes (hex prefixes; None = all) and
+    register it; returns the capture record. The CLI command `ray_tpu
+    profile` is a thin wrapper over this."""
+    return _runtime().profile_capture(
+        nodes=nodes, duration_s=duration_s, device=device, host=host
+    )
+
+
+def list_profiles() -> List[Dict[str, Any]]:
+    """Registered capture records, newest last: this driver's profile
+    store plus any capture other drivers registered in the GCS
+    `_profiles` table (meta only — their artifacts live with them)."""
+    from ..core.gcs import PROFILE_NS
+
+    runtime = _runtime()
+    records = {r["profile_id"]: r for r in runtime.profiles.list()}
+    ctx = getattr(runtime, "cluster", None)
+    try:
+        if ctx is not None:
+            for key in ctx.gcs.kv_keys(namespace=PROFILE_NS):
+                rec = ctx.gcs.kv_get(key, namespace=PROFILE_NS)
+                if rec:
+                    records.setdefault(key, rec)
+        else:
+            for key in runtime.gcs.kv.keys(namespace=PROFILE_NS):
+                rec = runtime.gcs.kv.get(key, namespace=PROFILE_NS)
+                if rec:
+                    records.setdefault(key, rec)
+    except Exception:  # noqa: BLE001 - the local store still answers
+        pass
+    return sorted(records.values(), key=lambda r: r.get("started_at", 0.0))
+
+
+def get_profile(profile_id: str) -> Dict[str, Any]:
+    """One capture's record: per-node status, artifact names, sizes."""
+    for rec in list_profiles():
+        if rec.get("profile_id") == profile_id:
+            return rec
+    raise ValueError(f"no registered profile {profile_id!r}")
+
+
+def profile_artifact(profile_id: str, node_hex: str, name: str) -> bytes:
+    """Raw bytes of one captured artifact (this driver's store only —
+    artifacts are not replicated into the GCS)."""
+    data = _runtime().profiles.artifact(profile_id, node_hex, name)
+    if data is None:
+        raise ValueError(
+            f"no artifact {name!r} for node {node_hex[:12]} in profile "
+            f"{profile_id!r} (captured by another driver?)"
+        )
+    return data
+
+
 def list_traces(limit: int = 100) -> List[Dict[str, Any]]:
     """Trace summaries of THIS process's tracer (newest last): trace_id,
     root span name, span count, wall duration. Works without a live
@@ -284,12 +358,18 @@ def get_trace(trace_id: str) -> List[Dict[str, Any]]:
 
 
 def trace_dump(path: Optional[str] = None,
-               trace_id: Optional[str] = None) -> str:
+               trace_id: Optional[str] = None,
+               profile_id: Optional[str] = None) -> str:
     """Perfetto/chrome-trace JSON of runtime SPANS (util/tracing) — the
     causal, nested view that supersedes and subsumes the completed-task
     `chrome_tracing_dump`: spans nest, one lane per node/actor/engine
     slot, and remote spans are stitched in cluster-wide. Exported by
-    `ray_tpu timeline --trace` and the dashboard's trace endpoints."""
+    `ray_tpu timeline --trace` and the dashboard's trace endpoints.
+
+    `profile_id` names a registered capture (state.profile / `ray_tpu
+    profile`): its device-trace events merge in as per-device tracks,
+    wall-clock aligned with the runtime spans — one file shows what the
+    runtime asked for and what the chip did during it."""
     from .tracing import export_chrome_trace, tracer
 
     if trace_id is not None:
@@ -306,7 +386,37 @@ def trace_dump(path: Optional[str] = None,
                     for s in node_spans or []:
                         spans.setdefault(s["span_id"], s)
         spans = sorted(spans.values(), key=lambda s: s["start_ts"])
-    return export_chrome_trace(spans, path)
+    extra = _device_trace_events(profile_id) if profile_id else None
+    return export_chrome_trace(spans, path, extra_events=extra)
+
+
+def _device_trace_events(profile_id: str):
+    """Load a registered capture's device-trace events for the Perfetto
+    merge: one `device:<name>` lane set per captured node."""
+    from . import profiling
+
+    store = _runtime().profiles
+    record = store.get(profile_id)
+    if record is None:
+        raise ValueError(f"no registered profile {profile_id!r}")
+    events = []
+    for node_hex, meta in record.get("nodes", {}).items():
+        if meta.get("artifacts_at"):
+            continue  # logical-node alias: artifacts live under the head
+        artifacts = {
+            name.split("/", 1)[1]: data
+            for name, data in store.artifacts_for(
+                profile_id, node_hex=node_hex
+            ).items()
+        }
+        if not artifacts:
+            continue
+        events.extend(profiling.load_device_trace_events(
+            artifacts,
+            started_at=meta.get("started_at", record["started_at"]),
+            lane_prefix=f"device:{node_hex[:8]}",
+        ))
+    return events
 
 
 # one-shot latch for the chrome_tracing_dump deprecation warning

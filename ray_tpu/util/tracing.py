@@ -413,12 +413,21 @@ def extract_context(kwargs: Dict[str, Any]) -> Optional[Dict[str, Any]]:
 
 
 def export_chrome_trace(spans: List[Dict[str, Any]],
-                        path: Optional[str] = None) -> str:
+                        path: Optional[str] = None,
+                        extra_events: Optional[List[Dict[str, Any]]] = None,
+                        ) -> str:
     """Chrome trace-event / Perfetto JSON for a span set. Spans nest by
     time on their lane: pid = the span's lane (node/actor/engine slot,
     falling back to the trace id), tid = the span name's subsystem. Load
-    in https://ui.perfetto.dev or chrome://tracing."""
-    events: List[Dict[str, Any]] = []
+    in https://ui.perfetto.dev or chrome://tracing.
+
+    `extra_events` are pre-built trace events appended verbatim — the
+    hook `state.trace_dump(profile_id=...)` uses to merge a captured
+    device trace's per-device tracks (util/profiling
+    load_device_trace_events, already wall-clock aligned) into the same
+    file, so one timeline shows what the runtime asked for AND what the
+    chip did."""
+    events: List[Dict[str, Any]] = list(extra_events or [])
     for s in spans:
         end = s["end_ts"] or s["start_ts"]
         pid = s.get("lane") or s["trace_id"][:8]
