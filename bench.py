@@ -70,6 +70,79 @@ def ring_kernel_bench() -> dict:
     }
 
 
+def attn_kernel_bench() -> dict:
+    """Per-layer flash-attention microbench at the bench model's exact
+    attention shape (B24 H12 S1024 D64 causal bf16) — the kernel the round-5
+    trace showed 5-6x off roofline. Chained-inside-one-jit methodology (per
+    -call timing through the tunnel measures RTT, not compute). Reports the
+    auto-resolved kernel (pipelined when cfg.attn_pipeline is on, on TPU)
+    and its distance to the matmul roofline, tracked every round."""
+    from ray_tpu.ops.attention import _resolve_impl, flash_attention
+    from ray_tpu.util import profiling as prof
+
+    b, h, s, d = BATCH, 12, SEQ, 64
+    n_fwd, n_bwd = 20, 8
+    keys = jax.random.split(jax.random.PRNGKey(2), 3)
+    q, k, v = (jax.random.normal(kk, (b, h, s, d), jnp.bfloat16) for kk in keys)
+    impl = _resolve_impl(None)
+
+    def chain(n):
+        def f(q, k, v):
+            def body(_, qq):
+                return flash_attention(qq, k, v, causal=True).astype(jnp.bfloat16)
+            return jnp.sum(
+                jax.lax.fori_loop(0, n, body, q).astype(jnp.float32)
+            )
+        return f
+
+    fwd = jax.jit(chain(n_fwd))
+    grad = jax.jit(jax.value_and_grad(chain(n_bwd), argnums=(0, 1, 2)))
+
+    def bench(fn, sync):
+        sync(fn(q, k, v))  # compile + device-read sync
+        t0 = time.perf_counter()
+        sync(fn(q, k, v))
+        return time.perf_counter() - t0
+
+    fwd_ms = bench(fwd, float) / n_fwd * 1e3
+    grad_s = bench(grad, lambda r: float(r[0]))
+    bwd_ms = max(grad_s / n_bwd * 1e3 - fwd_ms, 0.0)
+
+    # matmul roofline: causal fwd = 2*B*H*S^2*D flops (QK^T + PV, half the
+    # square), bwd = 2.5x fwd (s recompute + dv/dp/dk/dq)
+    peak = prof.device_peaks(jax.devices()[0])["peak_flops"]
+    fwd_flops = 2.0 * b * h * s * s * d
+    roofline_ms = (fwd_flops + 2.5 * fwd_flops) / peak * 1e3
+    measured_ms = fwd_ms + bwd_ms
+    return {
+        "attn_impl": impl,
+        "attn_fwd_ms": round(fwd_ms, 3),
+        "attn_bwd_ms": round(bwd_ms, 3),
+        "attn_roofline_fraction": round(roofline_ms / max(measured_ms, 1e-9), 4),
+    }
+
+
+def _dp_sync_fields(n_params: int, n_dp: int) -> dict:
+    """The data-parallel sync mode + per-replica wire bytes the current
+    config flags imply, tracked in the BENCH line every round (0 bytes on
+    the single-chip bench; the multichip dryrun exercises the real path)."""
+    from ray_tpu.core.config import cfg
+    from ray_tpu.parallel.collectives import dp_sync_bytes
+
+    explicit = (cfg.dp_shard_update or cfg.dp_allreduce_dtype == "int8") and n_dp > 1
+    mode = (
+        cfg.dp_allreduce_dtype + ("+shard_update" if cfg.dp_shard_update else "")
+        if explicit else "xla_psum"
+    )
+    return {
+        "dp_sync_mode": mode,
+        "dp_sync_bytes": dp_sync_bytes(
+            n_params, n_dp, mode=cfg.dp_allreduce_dtype,
+            shard_update=cfg.dp_shard_update, block=cfg.dp_quant_block,
+        ),
+    }
+
+
 def _collect_telemetry(step, state, batch, n_steps: int = 5) -> dict:
     """Per-step latency histogram + node stats riding along with the
     headline number, so BENCH_*.json rounds carry telemetry instead of
@@ -192,6 +265,14 @@ def main() -> None:
         ring = ring_kernel_bench()
     except Exception:  # noqa: BLE001 - the headline number must still print
         ring = {}
+    try:
+        attn = attn_kernel_bench()
+    except Exception:  # noqa: BLE001 - the headline number must still print
+        attn = {}
+    try:
+        dp_sync = _dp_sync_fields(n_params, mesh.shape.get("dp", 1))
+    except Exception:  # noqa: BLE001 - the headline number must still print
+        dp_sync = {}
     print(
         json.dumps(
             {
@@ -208,6 +289,8 @@ def main() -> None:
                 "profiling": profiling_block,
                 "telemetry": telemetry,
                 **ring,
+                **attn,
+                **dp_sync,
             }
         )
     )

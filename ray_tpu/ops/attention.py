@@ -414,7 +414,613 @@ def _flash_bwd(causal, sm_scale, block_q, block_kv, kv_len, interpret, res, do):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+# ---------------------------------------------------------- pipelined kernels
+#
+# The classic kernels above run, per (q, kv) tile: QK^T (MXU) -> online
+# softmax (VPU) -> PV (MXU) — a serial dependency chain that parks the MXU
+# through the whole softmax (PERF_NOTES.md: 5-6x off roofline at D=64).
+# The pipelined variants break the chain with a one-step software skew over
+# the kv-tile loop: inner step t issues tile t's QK^T while the online
+# softmax/rescale for tile t-1 runs, so the two stages have no data
+# dependency inside one step and Mosaic can overlap the MXU and VPU chains.
+#
+# On TPU the kv tiles stream HBM->VMEM through pltpu.emit_pipeline (explicit
+# double buffering; q and the accumulators stay VMEM-resident across the
+# whole row instead of being re-fetched per (i, j) grid step like the
+# classic 4D grid does). Off-TPU an interpret-mode driver executes the SAME
+# stage functions and slot arithmetic inside a fori_loop — the numerics of
+# both drivers are identical by construction, and bit-identical to the
+# classic kernel: tile math and accumulation order are unchanged, only the
+# schedule moves. tests/test_ops.py pins that equality at f32.
+
+
+def _fwd_stages(sm_scale, causal, block_q, block_kv, kv_len):
+    """Per-tile forward stages. `scores` is the MXU stage (QK^T + mask),
+    `online_update` the VPU-heavy stage (online softmax + PV rescale).
+    Expressions mirror _fwd_kernel exactly — bit-compatibility depends on
+    it."""
+
+    def scores(q, k, i, t):
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        s = s * (sm_scale * _LOG2E)
+        col = t * block_kv + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        mask = col < kv_len
+        if causal:
+            row = i * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            mask = mask & (col <= row)
+        return jnp.where(mask, s, _NEG_INF)
+
+    def online_update(s, v, m_scr, l_scr, acc_scr):
+        m_prev = m_scr[:, :1]
+        l_prev = l_scr[:, :1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp2(s - m_new)
+        alpha = jnp.exp2(m_prev - m_new)
+        l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    return scores, online_update
+
+
+def _bwd_stages(sm_scale, causal, block_q, block_kv, kv_len):
+    """Per-tile backward stages; expressions mirror _dkv_kernel/_dq_kernel."""
+    scores, _ = _fwd_stages(sm_scale, causal, block_q, block_kv, kv_len)
+
+    def dkv_update(s, q, do, v, lse, delta, dk_scr, dv_scr):
+        p = jnp.exp2(s - lse * _LOG2E)
+        dv_scr[...] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta) * sm_scale
+        dk_scr[...] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    def dq_update(s, k, v, do, lse, delta, dq_scr):
+        p = jnp.exp2(s - lse * _LOG2E)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta) * sm_scale
+        dq_scr[...] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    return scores, dkv_update, dq_update
+
+
+def _num_kv_tiles(i, causal, block_q, block_kv, nk):
+    """kv tiles query block i touches (causal block skipping, same set the
+    classic kernel's `needed` predicate admits)."""
+    if not causal:
+        return nk
+    last = (i * block_q + block_q - 1) // block_kv
+    return jnp.minimum(last + 1, nk)
+
+
+def _fwd_finalize(o_ref, lse_ref, m_scr, l_scr, acc_scr):
+    l = l_scr[:, :1]
+    safe_l = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0, 0] = (acc_scr[...] / safe_l).astype(o_ref.dtype)
+    lse_ref[0, 0] = jnp.where(
+        l == 0.0, _NEG_INF,
+        (m_scr[:, :1] + jnp.log2(safe_l)) * (1.0 / _LOG2E),
+    )
+
+
+def _fwd_kernel_pipe_interp(
+    q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, s_scr,
+    *, sm_scale, causal, block_q, block_kv, kv_len, num_kv_blocks,
+):
+    """Interpret-mode driver: the emit_pipeline schedule (skewed stages,
+    double-buffered score slots) replayed in a fori_loop with whole-row k/v
+    resident."""
+    i = pl.program_id(2)
+    scores, online_update = _fwd_stages(sm_scale, causal, block_q, block_kv, kv_len)
+    m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+    l_scr[...] = jnp.zeros_like(l_scr)
+    acc_scr[...] = jnp.zeros_like(acc_scr)
+    q = q_ref[0, 0]
+    tiles = _num_kv_tiles(i, causal, block_q, block_kv, num_kv_blocks)
+
+    def body(t, carry):
+        @pl.when(t < tiles)
+        def _stage_a():  # QK^T for tile t
+            kt = k_ref[0, 0, pl.ds(t * block_kv, block_kv), :]
+            s_scr[t % 2] = scores(q, kt, i, t)
+
+        @pl.when(t > 0)
+        def _stage_b():  # online softmax + PV for tile t-1
+            vt = v_ref[0, 0, pl.ds((t - 1) * block_kv, block_kv), :]
+            online_update(s_scr[(t - 1) % 2], vt, m_scr, l_scr, acc_scr)
+
+        return carry
+
+    jax.lax.fori_loop(0, tiles + 1, body, 0)
+    _fwd_finalize(o_ref, lse_ref, m_scr, l_scr, acc_scr)
+
+
+def _fwd_pipe_interp(q, k, v, causal, sm_scale, block_q, block_kv, kv_len):
+    b, hq, sq, d = q.shape
+    _, hkv, skv, _ = k.shape
+    groups = hq // hkv
+    nq = sq // block_q
+    nk = skv // block_kv
+    kernel = functools.partial(
+        _fwd_kernel_pipe_interp, sm_scale=sm_scale, causal=causal,
+        block_q=block_q, block_kv=block_kv, kv_len=kv_len, num_kv_blocks=nk,
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=(b, hq, nq),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda b_, h, i: (b_, h, i, 0)),
+            pl.BlockSpec((1, 1, skv, d), lambda b_, h, i, g=groups: (b_, h // g, 0, 0)),
+            pl.BlockSpec((1, 1, skv, d), lambda b_, h, i, g=groups: (b_, h // g, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda b_, h, i: (b_, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b_, h, i: (b_, h, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, hq, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b, hq, sq, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((2, block_q, block_kv), jnp.float32),
+        ],
+        interpret=True,
+    )(q, k, v)
+
+
+def _fwd_pipe_tpu(q, k, v, causal, sm_scale, block_q, block_kv, kv_len):
+    """emit_pipeline driver: q/accumulators VMEM-resident per (b, h, i) row;
+    kv tiles stream HBM->VMEM double-buffered, v delivered one step behind k
+    so stage B always has the tile stage A scored on the previous step."""
+    b, hq, sq, d = q.shape
+    _, hkv, skv, _ = k.shape
+    groups = hq // hkv
+    nq = sq // block_q
+    nk = skv // block_kv
+
+    def outer(q_ref, k_hbm, v_hbm, o_ref, lse_ref, m_scr, l_scr, acc_scr, s_scr):
+        bi = pl.program_id(0)
+        hi = pl.program_id(1)
+        i = pl.program_id(2)
+        hk = hi // groups
+        scores, online_update = _fwd_stages(
+            sm_scale, causal, block_q, block_kv, kv_len
+        )
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+        q_blk = q_ref[0, 0]
+        tiles = _num_kv_tiles(i, causal, block_q, block_kv, nk)
+
+        def inner(k_ref, v_ref):
+            t = pl.program_id(0)
+
+            @pl.when(t < tiles)
+            def _stage_a():
+                s_scr[t % 2] = scores(q_blk, k_ref[0, 0], i, t)
+
+            @pl.when(t > 0)
+            def _stage_b():
+                online_update(s_scr[(t - 1) % 2], v_ref[0, 0], m_scr, l_scr, acc_scr)
+
+        pipeline = pltpu.emit_pipeline(
+            inner,
+            grid=(tiles + 1,),
+            in_specs=[
+                pl.BlockSpec(
+                    (1, 1, block_kv, d),
+                    lambda t: (bi, hk, jnp.minimum(t, nk - 1), 0),
+                ),
+                pl.BlockSpec(
+                    (1, 1, block_kv, d),
+                    lambda t: (bi, hk, jnp.maximum(t - 1, 0), 0),
+                ),
+            ],
+            out_specs=[],
+        )
+        pipeline(k_hbm, v_hbm)
+        _fwd_finalize(o_ref, lse_ref, m_scr, l_scr, acc_scr)
+
+    return pl.pallas_call(
+        outer,
+        grid=(b, hq, nq),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda b_, h, i: (b_, h, i, 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda b_, h, i: (b_, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b_, h, i: (b_, h, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, hq, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b, hq, sq, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((2, block_q, block_kv), jnp.float32),
+        ],
+    )(q, k, v)
+
+
+def _fwd_pipe(q, k, v, causal, sm_scale, block_q, block_kv, kv_len, interpret):
+    if interpret:
+        return _fwd_pipe_interp(q, k, v, causal, sm_scale, block_q, block_kv, kv_len)
+    return _fwd_pipe_tpu(q, k, v, causal, sm_scale, block_q, block_kv, kv_len)
+
+
+def _dkv_kernel_pipe_interp(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    dk_scr, dv_scr, s_scr,
+    *, sm_scale, causal, block_q, block_kv, kv_len, num_q_blocks,
+):
+    j = pl.program_id(2)
+    scores, dkv_update, _ = _bwd_stages(sm_scale, causal, block_q, block_kv, kv_len)
+    dk_scr[...] = jnp.zeros_like(dk_scr)
+    dv_scr[...] = jnp.zeros_like(dv_scr)
+    k_blk = k_ref[0, 0]
+    v_blk = v_ref[0, 0]
+    # causal: q blocks strictly above the diagonal band contribute nothing
+    t_start = (j * block_kv) // block_q if causal else 0
+    n_tiles = num_q_blocks - t_start
+
+    def body(u, carry):
+        t = t_start + u
+
+        @pl.when(u < n_tiles)
+        def _stage_a():
+            qt = q_ref[0, 0, pl.ds(t * block_q, block_q), :]
+            s_scr[u % 2] = scores(qt, k_blk, t, j)
+
+        @pl.when(u > 0)
+        def _stage_b():
+            tp = t - 1
+            sl = pl.ds(tp * block_q, block_q)
+            dkv_update(
+                s_scr[(u - 1) % 2], q_ref[0, 0, sl, :], do_ref[0, 0, sl, :],
+                v_blk, lse_ref[0, 0, sl, :], delta_ref[0, 0, sl, :],
+                dk_scr, dv_scr,
+            )
+
+        return carry
+
+    jax.lax.fori_loop(0, n_tiles + 1, body, 0)
+    dk_ref[0, 0] = dk_scr[...].astype(dk_ref.dtype)
+    dv_ref[0, 0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _dq_kernel_pipe_interp(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr, s_scr,
+    *, sm_scale, causal, block_q, block_kv, kv_len, num_kv_blocks,
+):
+    i = pl.program_id(2)
+    scores, _, dq_update = _bwd_stages(sm_scale, causal, block_q, block_kv, kv_len)
+    dq_scr[...] = jnp.zeros_like(dq_scr)
+    q_blk = q_ref[0, 0]
+    do_blk = do_ref[0, 0]
+    lse_blk = lse_ref[0, 0]
+    delta_blk = delta_ref[0, 0]
+    tiles = _num_kv_tiles(i, causal, block_q, block_kv, num_kv_blocks)
+
+    def body(t, carry):
+        @pl.when(t < tiles)
+        def _stage_a():
+            kt = k_ref[0, 0, pl.ds(t * block_kv, block_kv), :]
+            s_scr[t % 2] = scores(q_blk, kt, i, t)
+
+        @pl.when(t > 0)
+        def _stage_b():
+            sl = pl.ds((t - 1) * block_kv, block_kv)
+            dq_update(
+                s_scr[(t - 1) % 2], k_ref[0, 0, sl, :], v_ref[0, 0, sl, :],
+                do_blk, lse_blk, delta_blk, dq_scr,
+            )
+
+        return carry
+
+    jax.lax.fori_loop(0, tiles + 1, body, 0)
+    dq_ref[0, 0] = dq_scr[...].astype(dq_ref.dtype)
+
+
+def _bwd_pipe_interp(q, k, v, out, lse, do, causal, sm_scale, block_q, block_kv, kv_len):
+    b, h, sq, d = q.shape
+    skv = k.shape[2]
+    nq = sq // block_q
+    nk = skv // block_kv
+    delta = jnp.sum(
+        do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1, keepdims=True
+    )
+
+    full_q = pl.BlockSpec((1, 1, sq, d), lambda b_, h_, g: (b_, h_, 0, 0))
+    full_row = pl.BlockSpec((1, 1, sq, 1), lambda b_, h_, g: (b_, h_, 0, 0))
+    kv_blk = pl.BlockSpec((1, 1, block_kv, d), lambda b_, h_, j: (b_, h_, j, 0))
+
+    dkv_kernel = functools.partial(
+        _dkv_kernel_pipe_interp, sm_scale=sm_scale, causal=causal,
+        block_q=block_q, block_kv=block_kv, kv_len=kv_len, num_q_blocks=nq,
+    )
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(b, h, nk),
+        in_specs=[full_q, kv_blk, kv_blk, full_q, full_row, full_row],
+        out_specs=[kv_blk, kv_blk],
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_kv, d), jnp.float32),
+            pltpu.VMEM((block_kv, d), jnp.float32),
+            pltpu.VMEM((2, block_q, block_kv), jnp.float32),
+        ],
+        interpret=True,
+    )(q, k, v, do, lse, delta)
+
+    q_blk = pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, i: (b_, h_, i, 0))
+    row_blk = pl.BlockSpec((1, 1, block_q, 1), lambda b_, h_, i: (b_, h_, i, 0))
+    full_kv = pl.BlockSpec((1, 1, skv, d), lambda b_, h_, i: (b_, h_, 0, 0))
+
+    dq_kernel = functools.partial(
+        _dq_kernel_pipe_interp, sm_scale=sm_scale, causal=causal,
+        block_q=block_q, block_kv=block_kv, kv_len=kv_len, num_kv_blocks=nk,
+    )
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(b, h, nq),
+        in_specs=[q_blk, full_kv, full_kv, q_blk, row_blk, row_blk],
+        out_specs=q_blk,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((2, block_q, block_kv), jnp.float32),
+        ],
+        interpret=True,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+def _bwd_pipe_tpu(q, k, v, out, lse, do, causal, sm_scale, block_q, block_kv, kv_len):
+    b, h, sq, d = q.shape
+    skv = k.shape[2]
+    nq = sq // block_q
+    nk = skv // block_kv
+    delta = jnp.sum(
+        do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1, keepdims=True
+    )
+
+    def dkv_outer(q_hbm, k_ref, v_ref, do_hbm, lse_hbm, delta_hbm,
+                  dk_ref, dv_ref, dk_scr, dv_scr, s_scr):
+        bi = pl.program_id(0)
+        hi = pl.program_id(1)
+        j = pl.program_id(2)
+        scores, dkv_update, _ = _bwd_stages(sm_scale, causal, block_q, block_kv, kv_len)
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+        k_blk = k_ref[0, 0]
+        v_blk = v_ref[0, 0]
+        t_start = (j * block_kv) // block_q if causal else 0
+        n_tiles = nq - t_start
+
+        def inner(qa_ref, qb_ref, do_ref, lse_ref, delta_ref):
+            u = pl.program_id(0)
+            t = t_start + u
+
+            @pl.when(u < n_tiles)
+            def _stage_a():
+                s_scr[u % 2] = scores(qa_ref[0, 0], k_blk, t, j)
+
+            @pl.when(u > 0)
+            def _stage_b():
+                dkv_update(
+                    s_scr[(u - 1) % 2], qb_ref[0, 0], do_ref[0, 0], v_blk,
+                    lse_ref[0, 0], delta_ref[0, 0], dk_scr, dv_scr,
+                )
+
+        # q streams twice at different offsets: once for the t-tile QK^T,
+        # once (a step behind) for the t-1 dk accumulation
+        idx_a = lambda u: (bi, hi, jnp.minimum(t_start + u, nq - 1), 0)
+        idx_b = lambda u: (bi, hi, jnp.minimum(t_start + jnp.maximum(u - 1, 0), nq - 1), 0)
+        pipeline = pltpu.emit_pipeline(
+            inner,
+            grid=(n_tiles + 1,),
+            in_specs=[
+                pl.BlockSpec((1, 1, block_q, d), idx_a),
+                pl.BlockSpec((1, 1, block_q, d), idx_b),
+                pl.BlockSpec((1, 1, block_q, d), idx_b),
+                pl.BlockSpec((1, 1, block_q, 1), idx_b),
+                pl.BlockSpec((1, 1, block_q, 1), idx_b),
+            ],
+            out_specs=[],
+        )
+        pipeline(q_hbm, q_hbm, do_hbm, lse_hbm, delta_hbm)
+        dk_ref[0, 0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[...].astype(dv_ref.dtype)
+
+    kv_blk = pl.BlockSpec((1, 1, block_kv, d), lambda b_, h_, j: (b_, h_, j, 0))
+    dk, dv = pl.pallas_call(
+        dkv_outer,
+        grid=(b, h, nk),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.ANY), kv_blk, kv_blk,
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=[kv_blk, kv_blk],
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_kv, d), jnp.float32),
+            pltpu.VMEM((block_kv, d), jnp.float32),
+            pltpu.VMEM((2, block_q, block_kv), jnp.float32),
+        ],
+    )(q, k, v, do, lse, delta)
+
+    def dq_outer(q_ref, k_hbm, v_hbm, do_ref, lse_ref, delta_ref,
+                 dq_ref, dq_scr, s_scr):
+        bi = pl.program_id(0)
+        hi = pl.program_id(1)
+        i = pl.program_id(2)
+        scores, _, dq_update = _bwd_stages(sm_scale, causal, block_q, block_kv, kv_len)
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+        q_blk = q_ref[0, 0]
+        do_blk = do_ref[0, 0]
+        lse_blk = lse_ref[0, 0]
+        delta_blk = delta_ref[0, 0]
+        tiles = _num_kv_tiles(i, causal, block_q, block_kv, nk)
+
+        def inner(ka_ref, kb_ref, vb_ref):
+            t = pl.program_id(0)
+
+            @pl.when(t < tiles)
+            def _stage_a():
+                s_scr[t % 2] = scores(q_blk, ka_ref[0, 0], i, t)
+
+            @pl.when(t > 0)
+            def _stage_b():
+                dq_update(
+                    s_scr[(t - 1) % 2], kb_ref[0, 0], vb_ref[0, 0],
+                    do_blk, lse_blk, delta_blk, dq_scr,
+                )
+
+        idx_a = lambda t: (bi, hi, jnp.minimum(t, nk - 1), 0)
+        idx_b = lambda t: (bi, hi, jnp.maximum(t - 1, 0), 0)
+        pipeline = pltpu.emit_pipeline(
+            inner,
+            grid=(tiles + 1,),
+            in_specs=[
+                pl.BlockSpec((1, 1, block_kv, d), idx_a),
+                pl.BlockSpec((1, 1, block_kv, d), idx_b),
+                pl.BlockSpec((1, 1, block_kv, d), idx_b),
+            ],
+            out_specs=[],
+        )
+        pipeline(k_hbm, k_hbm, v_hbm)
+        dq_ref[0, 0] = dq_scr[...].astype(dq_ref.dtype)
+
+    q_blk2 = pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, i: (b_, h_, i, 0))
+    row_blk2 = pl.BlockSpec((1, 1, block_q, 1), lambda b_, h_, i: (b_, h_, i, 0))
+    dq = pl.pallas_call(
+        dq_outer,
+        grid=(b, h, nq),
+        in_specs=[
+            q_blk2,
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            q_blk2, row_blk2, row_blk2,
+        ],
+        out_specs=q_blk2,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((2, block_q, block_kv), jnp.float32),
+        ],
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+def _bwd_pipe(q, k, v, out, lse, do, causal, sm_scale, block_q, block_kv, kv_len, interpret):
+    if interpret:
+        return _bwd_pipe_interp(
+            q, k, v, out, lse, do, causal, sm_scale, block_q, block_kv, kv_len
+        )
+    return _bwd_pipe_tpu(
+        q, k, v, out, lse, do, causal, sm_scale, block_q, block_kv, kv_len
+    )
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash_pipelined(q, k, v, causal, sm_scale, block_q, block_kv, kv_len, interpret):
+    out, _ = _fwd_pipe(q, k, v, causal, sm_scale, block_q, block_kv, kv_len, interpret)
+    return out
+
+
+def _flash_pipelined_fwd(q, k, v, causal, sm_scale, block_q, block_kv, kv_len, interpret):
+    out, lse = _fwd_pipe(q, k, v, causal, sm_scale, block_q, block_kv, kv_len, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_pipelined_bwd(causal, sm_scale, block_q, block_kv, kv_len, interpret, res, do):
+    q, k, v, out, lse = res
+    hq, hkv = q.shape[1], k.shape[1]
+    if hq != hkv:
+        groups = hq // hkv
+        k_full = jnp.repeat(k, groups, axis=1)
+        v_full = jnp.repeat(v, groups, axis=1)
+    else:
+        groups = 1
+        k_full, v_full = k, v
+    dq, dk, dv = _bwd_pipe(
+        q, k_full, v_full, out, lse, do, causal, sm_scale, block_q, block_kv,
+        kv_len, interpret,
+    )
+    if groups > 1:
+        b, _, skv, d = dk.shape
+        dk = dk.reshape(b, hkv, groups, skv, d).sum(axis=2)
+        dv = dv.reshape(b, hkv, groups, skv, d).sum(axis=2)
+    return dq, dk, dv
+
+
+_flash_pipelined.defvjp(_flash_pipelined_fwd, _flash_pipelined_bwd)
+
+
 # ------------------------------------------------------------------ public API
+
+
+_PIPE_BLOCK_KV = 256  # stream tile: >=2 tiles in flight is what buys overlap
+
+
+def _pipeline_enabled() -> bool:
+    from ..core.config import cfg
+
+    return bool(cfg.attn_pipeline)
+
+
+def _resolve_impl(implementation: Optional[str]) -> str:
+    if implementation is not None:
+        return implementation
+    if jax.default_backend() != "tpu":
+        return "xla"
+    return "pallas_pipelined" if _pipeline_enabled() else "pallas"
+
+
+def _pipe_blocks(sq: int, skv: int, block_q: Optional[int], block_kv: Optional[int]):
+    """Pipelined defaults: whole-row q tiles (q stays VMEM-resident), small
+    streaming kv tiles. Returns None if the shape leaves <2 kv tiles —
+    nothing to overlap, the classic single-block kernel is the right tool."""
+    bq = min(block_q or 1024, max(sq, 1))
+    bkv = min(block_kv or _PIPE_BLOCK_KV, max(skv, 1))
+    padded_skv = skv + ((-skv) % bkv)
+    if padded_skv // bkv < 2:
+        return None
+    return bq, bkv
 
 
 def _pad_seq(x: jax.Array, axis: int, multiple: int) -> jax.Array:
@@ -434,27 +1040,31 @@ def flash_attention(
     *,
     causal: bool = False,
     sm_scale: Optional[float] = None,
-    block_q: int = 1024,
-    block_kv: int = 1024,
+    block_q: Optional[int] = None,
+    block_kv: Optional[int] = None,
     implementation: Optional[str] = None,
 ) -> jax.Array:
     """Blockwise flash attention. q (B,Hq,Sq,D); k,v (B,Hkv,Skv,D).
 
-    implementation: "pallas" (TPU kernel; interpreted off-TPU), "xla"
-    (reference), or None = pallas on TPU backends, xla otherwise.
+    implementation: "pallas_pipelined" (double-buffered emit_pipeline
+    kernel; skewed-schedule interpret driver off-TPU), "pallas" (classic
+    kernel; interpreted off-TPU), "xla" (reference), or None = auto: on TPU
+    backends the pipelined kernel when `cfg.attn_pipeline` is set and the
+    shape gives >=2 kv tiles, else the classic kernel; xla otherwise.
 
-    Default blocks are 1024 (clamped to the sequence): at head_dim 64-128
-    the kernel is grid-overhead-bound, and big tiles measured 3.1x faster
-    than 128x128 on v5e (2.37 vs 7.45 ms/layer fwd+bwd at B8 H12 S1024 D64)
-    while the f32 score tile (1024*1024*4 = 4 MB) still fits VMEM.
+    Block defaults: classic kernel 1024x1024 (clamped to the sequence) —
+    at head_dim 64-128 it is grid-overhead-bound and big tiles measured
+    3.1x faster than 128x128 on v5e while the f32 score tile (4 MB) still
+    fits VMEM. Pipelined kernel 1024x256: q stays VMEM-resident so small
+    kv tiles cost no revisit overhead, and >=4 tiles in flight is what
+    lets the next tile's QK^T overlap the current tile's softmax.
     """
-    if implementation is None:
-        implementation = "pallas" if jax.default_backend() == "tpu" else "xla"
+    implementation = _resolve_impl(implementation)
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
     if implementation == "xla":
         return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale)
-    if implementation != "pallas":
+    if implementation not in ("pallas", "pallas_pipelined"):
         raise ValueError(f"unknown attention implementation: {implementation!r}")
     if not _HAS_PLTPU:  # pragma: no cover
         return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale)
@@ -462,12 +1072,28 @@ def flash_attention(
     sq, skv = q.shape[2], k.shape[2]
     if causal and sq != skv:
         raise NotImplementedError("causal flash kernel requires Sq == Skv")
-    block_q = min(block_q, max(sq, 1))
-    block_kv = min(block_kv, max(skv, 1))
+    interpret = jax.default_backend() != "tpu"
+
+    if implementation == "pallas_pipelined":
+        blocks = _pipe_blocks(sq, skv, block_q, block_kv)
+        if blocks is not None:
+            bq, bkv = blocks
+            qp = _pad_seq(q, 2, bq)
+            kp = _pad_seq(k, 2, bkv)
+            vp = _pad_seq(v, 2, bkv)
+            out = _flash_pipelined(
+                qp, kp, vp, causal, sm_scale, bq, bkv, skv, interpret
+            )
+            if out.shape[2] != sq:
+                out = out[:, :, :sq]
+            return out
+        # single kv tile: fall through to the classic kernel
+
+    block_q = min(block_q or 1024, max(sq, 1))
+    block_kv = min(block_kv or 1024, max(skv, 1))
     qp = _pad_seq(q, 2, block_q)
     kp = _pad_seq(k, 2, block_kv)
     vp = _pad_seq(v, 2, block_kv)
-    interpret = jax.default_backend() != "tpu"
     out = _flash(qp, kp, vp, causal, sm_scale, block_q, block_kv, skv, interpret)
     if out.shape[2] != sq:
         out = out[:, :, :sq]
@@ -481,8 +1107,8 @@ def flash_attention_with_lse(
     *,
     causal: bool = False,
     sm_scale: Optional[float] = None,
-    block_q: int = 1024,
-    block_kv: int = 1024,
+    block_q: Optional[int] = None,
+    block_kv: Optional[int] = None,
     implementation: Optional[str] = None,
 ) -> "tuple[jax.Array, jax.Array]":
     """Like flash_attention but also returns the per-row logsumexp of the
@@ -492,8 +1118,7 @@ def flash_attention_with_lse(
     FORWARD ONLY: no VJP is registered through the lse output; callers
     that need gradients wrap their own (ring_attention's custom_vjp
     recomputes through the einsum reference)."""
-    if implementation is None:
-        implementation = "pallas" if jax.default_backend() == "tpu" else "xla"
+    implementation = _resolve_impl(implementation)
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
     if implementation == "xla" or not _HAS_PLTPU:
@@ -520,12 +1145,27 @@ def flash_attention_with_lse(
     sq, skv = q.shape[2], k.shape[2]
     if causal and sq != skv:
         raise NotImplementedError("causal flash kernel requires Sq == Skv")
-    block_q = min(block_q, max(sq, 1))
-    block_kv = min(block_kv, max(skv, 1))
+    interpret = jax.default_backend() != "tpu"
+    if implementation == "pallas_pipelined":
+        blocks = _pipe_blocks(sq, skv, block_q, block_kv)
+        if blocks is not None:
+            bq, bkv = blocks
+            qp = _pad_seq(q, 2, bq)
+            kp = _pad_seq(k, 2, bkv)
+            vp = _pad_seq(v, 2, bkv)
+            out, lse = _fwd_pipe(
+                qp, kp, vp, causal, sm_scale, bq, bkv, skv, interpret
+            )
+            if out.shape[2] != sq:
+                out = out[:, :, :sq]
+                lse = lse[:, :, :sq]
+            return out, lse
+        # single kv tile: fall through to the classic kernel
+    block_q = min(block_q or 1024, max(sq, 1))
+    block_kv = min(block_kv or 1024, max(skv, 1))
     qp = _pad_seq(q, 2, block_q)
     kp = _pad_seq(k, 2, block_kv)
     vp = _pad_seq(v, 2, block_kv)
-    interpret = jax.default_backend() != "tpu"
     out, lse = _fwd_pallas(
         qp, kp, vp, causal, sm_scale, block_q, block_kv, skv, interpret
     )

@@ -52,6 +52,55 @@ def cross_entropy_loss(
     return loss, num
 
 
+# bytes the dense loss path keeps live per logit element: the bf16 logits
+# from the head matmul, their f32 upcast, and the f32 probs tensor the
+# backward softmax materializes (PERF_NOTES.md: the b24->b32 regression)
+_DENSE_LOSS_BYTES_PER_LOGIT = 2 + 4 + 4
+_AUTO_CHUNK_HBM_FRACTION = 0.8  # leave headroom for params/opt/activations
+_CHUNK_CANDIDATES = (512, 256, 128)
+
+
+def auto_loss_chunk(
+    batch_per_device: int,
+    seq: int,
+    vocab: int,
+    hbm_bytes: Optional[int] = None,
+) -> int:
+    """Pick the fused-linear-CE chunk size (0 = dense) from the logits HBM
+    working-set estimate vs the device limit.
+
+    The dense path is ~8% faster when it fits (PERF_NOTES.md: its extra
+    recomputed head matmul + scan overhead), so dense wins until the
+    (B_local, S, V) logits working set crowds the HBM — measured on v5e
+    16G: batch 24 dense 118.5k tok/s, batch 32 REGRESSES to 111k while
+    fused holds 110.3k flat. Crossover: estimate > 80% of HBM -> chunk.
+
+    hbm_bytes None = probe the local device (memory_stats().bytes_limit);
+    unknown (CPU backends) means no HBM cliff to dodge -> dense."""
+    if hbm_bytes is None:
+        hbm_bytes = _device_hbm_bytes()
+    if not hbm_bytes:
+        return 0
+    est = batch_per_device * seq * vocab * _DENSE_LOSS_BYTES_PER_LOGIT
+    if est <= _AUTO_CHUNK_HBM_FRACTION * hbm_bytes:
+        return 0
+    for chunk in _CHUNK_CANDIDATES:
+        if seq % chunk == 0:
+            return chunk
+    return 0
+
+
+def _device_hbm_bytes() -> int:
+    try:
+        device = jax.local_devices()[0]
+        if getattr(device, "platform", "cpu") == "cpu":
+            return 0
+        stats = device.memory_stats() or {}
+        return int(stats.get("bytes_limit", 0))
+    except Exception:  # noqa: BLE001 - heuristic must never fail a trace
+        return 0
+
+
 def fused_linear_cross_entropy(
     x: jax.Array,
     head: jax.Array,

@@ -124,6 +124,7 @@ def _ring_fused_local(
     axis_name: str,
     causal: bool,
     sm_scale: float,
+    block_impl: Optional[str] = None,
 ):
     """Fused per-shard body: each ring block runs through the Pallas flash
     kernel (ops/attention.py — online softmax INSIDE the block stays in
@@ -131,7 +132,12 @@ def _ring_fused_local(
     across ring steps by logsumexp reweighting, which is algebraically
     the same online-softmax recurrence the einsum body carries as
     (m, l, acc). The diagonal block is the causal kernel; past blocks the
-    full kernel; future blocks skip (Liu et al. causal skipping)."""
+    full kernel; future blocks skip (Liu et al. causal skipping).
+
+    The kernel choice rides flash_attention_with_lse's auto-resolution:
+    with cfg.attn_pipeline set (default) each ring block runs the
+    double-buffered emit_pipeline kernel on TPU, so `ring_fused_speedup`
+    inherits the pipelined inner block without a separate code path."""
     from .attention import flash_attention_with_lse
 
     n = lax.psum(1, axis_name)
@@ -147,13 +153,15 @@ def _ring_fused_local(
 
     def diag(o_acc, lse_acc, k_cur, v_cur):
         o, lse = flash_attention_with_lse(
-            q, k_cur, v_cur, causal=True, sm_scale=sm_scale
+            q, k_cur, v_cur, causal=True, sm_scale=sm_scale,
+            implementation=block_impl,
         )
         return merge(o_acc, lse_acc, o, lse)
 
     def full(o_acc, lse_acc, k_cur, v_cur):
         o, lse = flash_attention_with_lse(
-            q, k_cur, v_cur, causal=False, sm_scale=sm_scale
+            q, k_cur, v_cur, causal=False, sm_scale=sm_scale,
+            implementation=block_impl,
         )
         return merge(o_acc, lse_acc, o, lse)
 
@@ -183,7 +191,8 @@ def _ring_fused_local(
     return o_acc.astype(q.dtype)
 
 
-def _make_fused_body(axis_name: str, causal: bool, sm_scale: float):
+def _make_fused_body(axis_name: str, causal: bool, sm_scale: float,
+                     block_impl: Optional[str] = None):
     """Fused forward + einsum-reference backward. The flash kernel's VJP
     does not thread through the cross-step lse merge, so the backward
     recomputes the whole ring via the differentiable einsum body — same
@@ -192,7 +201,8 @@ def _make_fused_body(axis_name: str, causal: bool, sm_scale: float):
     @jax.custom_vjp
     def body(q, k, v):
         return _ring_fused_local(
-            q, k, v, axis_name=axis_name, causal=causal, sm_scale=sm_scale
+            q, k, v, axis_name=axis_name, causal=causal, sm_scale=sm_scale,
+            block_impl=block_impl,
         )
 
     def fwd(q, k, v):
@@ -223,13 +233,16 @@ def ring_attention(
     causal: bool = False,
     sm_scale: Optional[float] = None,
     impl: str = "fused",
+    block_impl: Optional[str] = None,
 ) -> jax.Array:
     """Sequence-parallel exact attention. q (B,Hq,S,D), k/v (B,Hkv,S,D);
     S must divide by mesh.shape[axis]. Returns (B,Hq,S,D) sharded like q.
 
     impl: "fused" (default — per-block Pallas flash kernel on TPU, fused
     XLA reference elsewhere) or "einsum" (the original blockwise einsum
-    body; also the backward path of "fused")."""
+    body; also the backward path of "fused"). block_impl picks the flash
+    kernel inside each fused ring block (None = flash_attention's auto
+    resolution, i.e. the pipelined kernel when cfg.attn_pipeline is on)."""
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
     hq, hkv = q.shape[1], k.shape[1]
@@ -243,7 +256,7 @@ def ring_attention(
 
     spec = P(None, None, axis, None)
     if impl == "fused":
-        body = _make_fused_body(axis, causal, sm_scale)
+        body = _make_fused_body(axis, causal, sm_scale, block_impl)
     elif impl == "einsum":
         body = functools.partial(
             _ring_attention_local, axis_name=axis, causal=causal,

@@ -41,6 +41,49 @@ import threading
 from typing import Any, Dict, Optional
 
 REPLICA_NS_PREFIX = "_dcn_replica/"
+_Q8_MARKER = "__dcn_int8__"
+_Q8_BLOCK = 512
+_Q8_MIN_ELEMS = 4096  # below this the scales overhead beats the savings
+
+
+def _quantize_leaf(x) -> Any:
+    """Host-side blockwise int8 quantization of one float numpy leaf (the
+    same EQuARX block layout parallel/collectives uses on-device, but for
+    the DCN wire: a replica mirror tolerates ~1e-2 relative error and the
+    payload shrinks ~3.9x). Non-float / small leaves pass through."""
+    import numpy as np
+
+    if not isinstance(x, np.ndarray) or x.dtype.kind != "f" or x.size < _Q8_MIN_ELEMS:
+        return x
+    flat = x.astype(np.float32).reshape(-1)
+    pad = (-flat.size) % _Q8_BLOCK
+    if pad:
+        flat = np.pad(flat, (0, pad))
+    blocks = flat.reshape(-1, _Q8_BLOCK)
+    amax = np.abs(blocks).max(axis=1)
+    scales = np.where(amax == 0.0, 1.0, amax / 127.0).astype(np.float32)
+    values = np.clip(np.rint(blocks / scales[:, None]), -127, 127).astype(np.int8)
+    return {
+        _Q8_MARKER: True,
+        "values": values,
+        "scales": scales,
+        "shape": tuple(x.shape),
+        "dtype": x.dtype.str,
+    }
+
+
+def _dequantize_leaf(leaf: Any) -> Any:
+    import numpy as np
+
+    if not (isinstance(leaf, dict) and leaf.get(_Q8_MARKER)):
+        return leaf
+    flat = (leaf["values"].astype(np.float32) * leaf["scales"][:, None]).reshape(-1)
+    size = int(np.prod(leaf["shape"])) if leaf["shape"] else 1
+    return flat[:size].reshape(leaf["shape"]).astype(np.dtype(leaf["dtype"]))
+
+
+def _is_q8(leaf: Any) -> bool:
+    return isinstance(leaf, dict) and bool(leaf.get(_Q8_MARKER))
 
 
 class CrossSliceReplicator:
@@ -49,7 +92,19 @@ class CrossSliceReplicator:
     a newer snapshot supersedes a queued-but-unstarted one (the mirror
     wants the LATEST state, not every state)."""
 
-    def __init__(self, peer_addr: str, *, token: Optional[str] = None):
+    def __init__(
+        self,
+        peer_addr: str,
+        *,
+        token: Optional[str] = None,
+        quantize: Optional[str] = None,
+    ):
+        """quantize="int8" block-quantizes float leaves host-side before the
+        push (the DCN wire carries ~1/4 the bytes; fetch_replica dequantizes
+        transparently). None ships exact bytes."""
+        if quantize not in (None, "int8"):
+            raise ValueError(f"unknown quantize mode {quantize!r}")
+        self.quantize = quantize
         self.peer_addr = peer_addr
         self._token = token
         # ONE condition guards _next/_stop and carries the wakeups —
@@ -61,7 +116,8 @@ class CrossSliceReplicator:
         self._idle.set()
         self._stop = False
         self._error: Optional[BaseException] = None
-        self.stats = {"replicated": 0, "superseded": 0, "bytes": 0}
+        self.stats = {"replicated": 0, "superseded": 0, "bytes": 0,
+                      "raw_bytes": 0}
         self._thread = threading.Thread(
             target=self._loop, daemon=True, name="ray_tpu-dcn-replicator"
         )
@@ -141,6 +197,12 @@ class CrossSliceReplicator:
                     if hasattr(x, "device") or hasattr(x, "devices") else x,
                     pytree,
                 )
+                raw_bytes = sum(
+                    getattr(leaf, "nbytes", 0)
+                    for leaf in jax.tree.leaves(host_tree)
+                )
+                if self.quantize == "int8":
+                    host_tree = jax.tree.map(_quantize_leaf, host_tree)
                 nbytes = sum(
                     getattr(leaf, "nbytes", 0)
                     for leaf in jax.tree.leaves(host_tree)
@@ -159,6 +221,7 @@ class CrossSliceReplicator:
                 )
                 self.stats["replicated"] += 1
                 self.stats["bytes"] += int(nbytes)
+                self.stats["raw_bytes"] += int(raw_bytes)
             except BaseException as exc:  # noqa: BLE001 - surfaced on next call
                 self._error = exc
                 if client is not None:
@@ -174,7 +237,10 @@ class CrossSliceReplicator:
 
 def fetch_replica(key: str, runtime=None) -> Any:
     """Peer side: the latest replicated pytree under `key`, from THIS
-    node's store (raises KeyError if nothing arrived yet)."""
+    node's store (raises KeyError if nothing arrived yet). int8-quantized
+    leaves (quantize="int8" replicators) dequantize transparently."""
+    import jax
+
     from ..core import runtime as _rt
 
     rt = runtime or _rt.get_runtime()
@@ -182,7 +248,8 @@ def fetch_replica(key: str, runtime=None) -> Any:
     entry = rt.object_store.entry(oid)
     if entry is None or not entry.event.is_set():
         raise KeyError(f"no replica {key!r} has arrived on this node")
-    return rt.object_store.get(oid)
+    tree = rt.object_store.get(oid)
+    return jax.tree.map(_dequantize_leaf, tree, is_leaf=_is_q8)
 
 
 def _replica_oid(key: str):

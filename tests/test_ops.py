@@ -155,6 +155,184 @@ def test_cross_entropy_z_loss_increases_loss():
     assert float(with_z) > float(base)
 
 
+# ----------------------------------------------- pipelined kernel numerics
+#
+# The emit_pipeline kernel's interpret driver executes the same stage
+# functions and slot arithmetic as the TPU driver, so these tests pin the
+# pipelined dataflow (skewed stages, double-buffered score slots, causal
+# trip counts) against the classic kernel BIT-FOR-BIT at f32 — the
+# acceptance bar for swapping the default kernel.
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("gqa", [False, True])
+def test_pipelined_forward_bitwise_vs_classic(causal, gqa):
+    key = jax.random.PRNGKey(20)
+    kq, kk, kv = jax.random.split(key, 3)
+    b, hq, s, d = 2, 4, 256, 64
+    hkv = 2 if gqa else hq
+    q = _rand(kq, (b, hq, s, d))
+    k = _rand(kk, (b, hkv, s, d))
+    v = _rand(kv, (b, hkv, s, d))
+    classic = flash_attention(q, k, v, causal=causal, implementation="pallas",
+                              block_q=128, block_kv=64)
+    pipe = flash_attention(q, k, v, causal=causal,
+                           implementation="pallas_pipelined",
+                           block_q=128, block_kv=64)
+    np.testing.assert_array_equal(np.asarray(classic), np.asarray(pipe))
+    ref = mha_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(pipe), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_pipelined_backward_bitwise_vs_classic(causal):
+    key = jax.random.PRNGKey(21)
+    kq, kk, kv = jax.random.split(key, 3)
+    b, h, s, d = 1, 2, 256, 64
+    q = _rand(kq, (b, h, s, d))
+    k = _rand(kk, (b, h, s, d))
+    v = _rand(kv, (b, h, s, d))
+
+    def loss(impl):
+        def f(q, k, v):
+            o = flash_attention(q, k, v, causal=causal, implementation=impl,
+                                block_q=64, block_kv=64)
+            return jnp.sum(o * o)
+        return f
+
+    gp = jax.grad(loss("pallas_pipelined"), argnums=(0, 1, 2))(q, k, v)
+    gc = jax.grad(loss("pallas"), argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss("xla"), argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(gp, gc):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b_))
+    for a, b_ in zip(gp, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   atol=1e-3, rtol=1e-3)
+
+
+def test_pipelined_backward_gqa_matches_reference():
+    key = jax.random.PRNGKey(22)
+    kq, kk, kv = jax.random.split(key, 3)
+    b, hq, hkv, s, d = 1, 4, 2, 128, 32
+    q = _rand(kq, (b, hq, s, d))
+    k = _rand(kk, (b, hkv, s, d))
+    v = _rand(kv, (b, hkv, s, d))
+
+    def loss_pipe(q, k, v):
+        return jnp.sum(flash_attention(
+            q, k, v, causal=True, implementation="pallas_pipelined",
+            block_q=64, block_kv=32) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(mha_reference(q, k, v, causal=True) ** 2)
+
+    gp = jax.grad(loss_pipe, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(gp, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   atol=1e-3, rtol=1e-3)
+
+
+def test_pipelined_odd_sequence_tail():
+    """Seq not a multiple of either block: wrapper pads, kernel masks; same
+    tiles -> bitwise equal to the classic kernel, close to XLA."""
+    key = jax.random.PRNGKey(23)
+    kq, kk, kv = jax.random.split(key, 3)
+    b, h, s, d = 1, 2, 192, 64
+    q = _rand(kq, (b, h, s, d))
+    k = _rand(kk, (b, h, s, d))
+    v = _rand(kv, (b, h, s, d))
+    classic = flash_attention(q, k, v, implementation="pallas",
+                              block_q=128, block_kv=64)
+    pipe = flash_attention(q, k, v, implementation="pallas_pipelined",
+                           block_q=128, block_kv=64)
+    np.testing.assert_array_equal(np.asarray(classic), np.asarray(pipe))
+    ref = mha_reference(q, k, v)
+    np.testing.assert_allclose(np.asarray(pipe), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_pipelined_lse_matches_classic_and_boundary():
+    """flash_attention_with_lse parity incl. the fully-masked boundary
+    (kv_len=0): both kernels share the finalize contract bit-for-bit."""
+    from ray_tpu.ops.attention import (
+        _fwd_pallas, _fwd_pipe, flash_attention_with_lse,
+    )
+
+    key = jax.random.PRNGKey(24)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = _rand(kq, (1, 2, 256, 64))
+    k = _rand(kk, (1, 2, 256, 64))
+    v = _rand(kv, (1, 2, 256, 64))
+    o1, l1 = flash_attention_with_lse(q, k, v, causal=True,
+                                      implementation="pallas",
+                                      block_q=128, block_kv=64)
+    o2, l2 = flash_attention_with_lse(q, k, v, causal=True,
+                                      implementation="pallas_pipelined",
+                                      block_q=128, block_kv=64)
+    np.testing.assert_array_equal(np.asarray(o1), np.asarray(o2))
+    np.testing.assert_array_equal(np.asarray(l1), np.asarray(l2))
+    # lse agrees with the dense logsumexp of the scaled causal scores
+    s = np.einsum("bhqd,bhkd->bhqk", np.asarray(q, np.float32),
+                  np.asarray(k, np.float32)) / np.sqrt(64.0)
+    mask = np.tril(np.ones((256, 256), bool))
+    s = np.where(mask[None, None], s, -np.inf)
+    dense_lse = np.log(np.exp(s - s.max(-1, keepdims=True)).sum(-1)) + s.max(-1)
+    np.testing.assert_allclose(np.asarray(l2)[..., 0], dense_lse,
+                               atol=1e-4, rtol=1e-4)
+    # boundary: kv_len=0 masks everything; pipelined == classic on the
+    # degenerate rows too (shared finalize semantics)
+    ob1, lb1 = _fwd_pallas(q, k, v, False, 0.125, 64, 64, 0, True)
+    ob2, lb2 = _fwd_pipe(q, k, v, False, 0.125, 64, 64, 0, True)
+    np.testing.assert_array_equal(np.asarray(ob1), np.asarray(ob2))
+    np.testing.assert_array_equal(np.asarray(lb1), np.asarray(lb2))
+
+
+def test_pipelined_auto_fallback_single_tile():
+    """Shapes with <2 kv tiles fall back to the classic kernel instead of
+    degenerate pipelining."""
+    key = jax.random.PRNGKey(25)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = _rand(kq, (1, 2, 64, 32))
+    k = _rand(kk, (1, 2, 64, 32))
+    v = _rand(kv, (1, 2, 64, 32))
+    out = flash_attention(q, k, v, implementation="pallas_pipelined")
+    ref = mha_reference(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_auto_loss_chunk_crossover():
+    """Pins the dense->fused crossover at the measured v5e numbers: batch
+    24 stays dense on a 16G chip, batch 32 (the measured regression) flips
+    to the fused chunked path; unknown HBM (CPU) always dense."""
+    from ray_tpu.ops.losses import auto_loss_chunk
+
+    v5e = 16 * 1024**3
+    assert auto_loss_chunk(24, 1024, 50257, v5e) == 0
+    assert auto_loss_chunk(32, 1024, 50257, v5e) == 512
+    # seq indivisible by the preferred chunks falls back down the ladder
+    assert auto_loss_chunk(32, 1280, 50257, v5e) in (256, 128, 0)
+    assert auto_loss_chunk(1024, 1024, 50257, None) == 0  # no HBM info
+    assert auto_loss_chunk(24, 1024, 50257, 0) == 0
+
+
+def test_check_kernel_fallbacks_wired():
+    """Tier-1 wiring for scripts/check_kernel_fallbacks.py: pltpu-gated
+    kernels keep non-TPU fallbacks and cfg knob reads stay registered."""
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    repo = Path(__file__).resolve().parent.parent
+    script = repo / "scripts" / "check_kernel_fallbacks.py"
+    proc = subprocess.run(
+        [sys.executable, str(script)], capture_output=True, text=True
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
 def test_fused_linear_cross_entropy_matches_dense():
     """The chunked fused head+CE (PERF_NOTES.md) must agree with the
     dense path — values AND gradients — including mask and z-loss."""
