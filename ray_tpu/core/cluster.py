@@ -516,7 +516,7 @@ class ClusterContext:
         self._local_node = local
 
         # dispatch bookkeeping: task hex -> _PendingTask
-        self._pending: Dict[str, _PendingTask] = {}
+        self._pending: Dict[str, _PendingTask] = {}  # guarded-by: _lock
         # --- agent-side admission (reference: the raylet grants leases
         # against its OWN resource ledger, raylet/node_manager.cc:2000;
         # here the ledger IS the local node's ResourceSet, shared with the
@@ -550,7 +550,7 @@ class ClusterContext:
         # actors THIS node hosts for remote owners: actor hex -> handle
         self._hosted_actors: Dict[str, Any] = {}
         self._lock = threading.Lock()
-        self._remote_nodes: Dict[str, RemoteNode] = {}
+        self._remote_nodes: Dict[str, RemoteNode] = {}  # guarded-by: _lock
         self._reply_clients: Dict[str, RpcClient] = {}
         self._free_queue: "queue.Queue[Tuple[str, str, str]]" = queue.Queue()
         self._borrow_queue: "queue.Queue[Tuple[str, str, str]]" = queue.Queue()
@@ -566,7 +566,7 @@ class ClusterContext:
         self._preempt_since = 0.0
         # this node's table entry (kept current locally so the stats
         # piggyback can republish without a read-modify-write race)
-        self._info: Dict[str, Any] = {}
+        self._info: Dict[str, Any] = {}  # guarded-by: _lock
         self._last_stats_ts = 0.0
 
         store.set_cluster_hooks(
@@ -635,7 +635,8 @@ class ClusterContext:
             "hostname": socket.gethostname(),
             "joined_at": time.time(),
         }
-        self._info = info
+        with self._lock:
+            self._info = info
         self.gcs.kv_put(self.node_id.hex(), info, namespace=NODE_NS)
         logger.info("node %s joined cluster at %s (gcs %s)",
                     self.node_id.hex()[:12], self.address, self.gcs_address)
@@ -661,11 +662,20 @@ class ClusterContext:
         if now - self._last_stats_ts < period:
             return
         collector = getattr(self.runtime, "node_stats", None)
-        if collector is None or not self._info:
+        if collector is None:
             return
+        with self._lock:
+            if not self._info:
+                return
         self._last_stats_ts = now
-        self._info["stats"] = collector.snapshot()
-        self.gcs.kv_put(self.node_id.hex(), self._info, namespace=NODE_NS)
+        snap = collector.snapshot()  # sampling /proc+jax stays unlocked
+        # raylint lock-discipline: this mutation raced begin_preemption's
+        # _info.update() from the signal/pubsub thread; publish a copy so
+        # the GCS never sees a dict another thread is mid-mutating
+        with self._lock:
+            self._info["stats"] = snap
+            info = dict(self._info)
+        self.gcs.kv_put(self.node_id.hex(), info, namespace=NODE_NS)
 
     def _watch_loop(self) -> None:
         from .config import cfg
@@ -728,7 +738,9 @@ class ClusterContext:
                         node_hex[:12], info["address"])
         # deaths: a known node absent from the live view aged out of
         # heartbeats (reference: GcsHealthCheckManager marking raylets dead)
-        for node_hex in list(self._remote_nodes):
+        with self._lock:
+            known_nodes = list(self._remote_nodes)
+        for node_hex in known_nodes:
             if node_hex not in live:
                 self._on_node_dead(node_hex, "missed heartbeats")
 
@@ -844,7 +856,8 @@ class ClusterContext:
             })
             # keep the cached entry in sync: the stats piggyback
             # republishes self._info and must not erase these flags
-            self._info.update(info)
+            with self._lock:
+                self._info.update(info)
             self.gcs.kv_put(self.node_id.hex(), info, namespace=NODE_NS)
         except (RpcError, OSError):
             pass

@@ -35,7 +35,7 @@ class KVStore:
     """Namespaced key-value store (reference: gcs_kv_manager.h)."""
 
     def __init__(self):
-        self._data: Dict[Tuple[str, str], Any] = {}
+        self._data: Dict[Tuple[str, str], Any] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
 
     def put(self, key: str, value: Any, namespace: str = "default", overwrite: bool = True) -> bool:
@@ -68,14 +68,14 @@ class PubSub:
     """
 
     def __init__(self):
-        self._subs: Dict[str, List[Callable[[Any], None]]] = {}
-        self._history: Dict[str, List[Tuple[float, Any]]] = {}
+        self._subs: Dict[str, List[Callable[[Any], None]]] = {}  # guarded-by: _lock
+        self._history: Dict[str, List[Tuple[float, Any]]] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
         # (channel, callback) pairs that already produced one WARNING:
         # a permanently broken subscriber must be visible, not spam
-        self._warned: set = set()
+        self._warned: set = set()  # guarded-by: _lock
         # telemetry: ships in the node stats snapshot (core/stats.py)
-        self.stats = {"published": 0, "delivered": 0, "subscriber_errors": 0}
+        self.stats = {"published": 0, "delivered": 0, "subscriber_errors": 0}  # guarded-by: _lock
 
     def publish(self, channel: str, message: Any) -> None:
         with self._lock:
@@ -88,7 +88,10 @@ class PubSub:
         for cb in subs:
             try:
                 cb(message)
-                self.stats["delivered"] += 1
+                # raylint lock-discipline caught this increment racing
+                # concurrent publishers outside the critical section
+                with self._lock:
+                    self.stats["delivered"] += 1
             except Exception as exc:  # noqa: BLE001 - subscriber bugs must not kill publishers
                 # One WARNING event per (channel, callback) lifetime (the
                 # metrics-sampler pattern): a dead preemption/failover
@@ -128,7 +131,7 @@ class GlobalControlStore:
     def __init__(self):
         self.kv = KVStore()
         self.pubsub = PubSub()
-        self._named_actors: Dict[Tuple[str, str], Any] = {}
+        self._named_actors: Dict[Tuple[str, str], Any] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
 
     # Named actors (reference: gcs_actor_manager.h GetActorByName path).
@@ -167,15 +170,19 @@ class GlobalControlStore:
     def snapshot(self, path: str, extra: Optional[Dict[str, Any]] = None) -> None:
         import cloudpickle
 
+        # Copy the table under the lock, serialize OUTSIDE it: kv_put
+        # rides every cluster heartbeat, and pickling the whole store
+        # under kv._lock stalled all of them for the snapshot duration.
         with self.kv._lock:
-            kv_items = []
-            for k, v in self.kv._data.items():
-                try:
-                    blob = cloudpickle.dumps(v)
-                except Exception:
-                    logger.warning("gcs snapshot: skipping unpicklable key %r", k)
-                    continue
-                kv_items.append((k, blob))
+            items = list(self.kv._data.items())
+        kv_items = []
+        for k, v in items:
+            try:
+                blob = cloudpickle.dumps(v)
+            except Exception:
+                logger.warning("gcs snapshot: skipping unpicklable key %r", k)
+                continue
+            kv_items.append((k, blob))
         with self._lock:
             actor_names = list(self._named_actors.keys())
         payload = {
@@ -199,12 +206,17 @@ class GlobalControlStore:
 
         with open(path, "rb") as f:
             payload = cloudpickle.load(f)
+        # decode outside kv._lock (same contention shape as snapshot):
+        # only the dict inserts need the critical section
+        decoded = []
+        for k, blob in payload["kv"]:
+            try:
+                decoded.append((k, cloudpickle.loads(blob)))
+            except Exception:
+                logger.warning("gcs restore: skipping undecodable key %r", k)
         with self.kv._lock:
-            for k, blob in payload["kv"]:
-                try:
-                    self.kv._data[k] = cloudpickle.loads(blob)
-                except Exception:
-                    logger.warning("gcs restore: skipping undecodable key %r", k)
+            for k, value in decoded:
+                self.kv._data[k] = value
         with self._lock:
             for key in payload["named_actors"]:
                 self._named_actors.setdefault(key, None)

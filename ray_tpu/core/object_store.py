@@ -184,7 +184,7 @@ class ObjectStore:
     def __init__(self, capacity_bytes: int = 8 << 30, spill_dir: Optional[str] = None):
         from .config import cfg
 
-        self._entries: "OrderedDict[ObjectID, ObjectEntry]" = OrderedDict()
+        self._entries: "OrderedDict[ObjectID, ObjectEntry]" = OrderedDict()  # guarded-by: _lock
         self._lock = threading.RLock()
         self._capacity = capacity_bytes
         self._inline_max = cfg.inline_max_bytes
@@ -224,7 +224,7 @@ class ObjectStore:
                     self._arena = NativeArena(capacity_bytes, path=path)
             except Exception:
                 self._arena = None
-        self._shm_entries: Dict[int, ObjectID] = {}  # arena id -> object id
+        self._shm_entries: Dict[int, ObjectID] = {}  # arena id -> object id  # guarded-by: _lock
         # Lineage resubmission hook (Runtime wires scheduler.submit here):
         # get() of a LOST entry with a recorded owner_task re-executes it
         # (reference: ObjectRecoveryManager, object_recovery_manager.h:43).
@@ -241,7 +241,7 @@ class ObjectStore:
         self._free_remote: Optional[Callable[[ObjectID, str], None]] = None
         self._unborrow: Optional[Callable[[ObjectID, str], None]] = None
         # owner-side borrow registry: object id -> borrower addresses
-        self._borrowers: Dict[ObjectID, set] = {}
+        self._borrowers: Dict[ObjectID, set] = {}  # guarded-by: _lock
 
     def set_resubmit(self, fn: Callable[[Any], None]) -> None:
         self._resubmit = fn

@@ -321,7 +321,7 @@ class _ReusableThreadPool:
 
     def __init__(self, idle_timeout_s: float = 30.0, max_idle: int = 32,
                  name: str = "ray_tpu-worker"):
-        self._idle: List["queue.Queue"] = []
+        self._idle: List["queue.Queue"] = []  # guarded-by: _lock
         self._lock = threading.Lock()
         self._idle_timeout = idle_timeout_s
         self._max_idle = max_idle
@@ -378,14 +378,14 @@ class ClusterScheduler:
 
     def __init__(self, object_store, on_task_done: Callable[[TaskSpec, Optional[BaseException]], None]):
         self._store = object_store
-        self._nodes: Dict[NodeID, Node] = {}
-        self._pending: deque[TaskSpec] = deque()
-        self._blocked: Dict[TaskID, Tuple[TaskSpec, set]] = {}
+        self._nodes: Dict[NodeID, Node] = {}  # guarded-by: _lock
+        self._pending: deque[TaskSpec] = deque()  # guarded-by: _lock
+        self._blocked: Dict[TaskID, Tuple[TaskSpec, set]] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
         self._wake = threading.Event()
         self._shutdown = False
         self._on_task_done = on_task_done
-        self._placement_groups: Dict[PlacementGroupID, PlacementGroup] = {}
+        self._placement_groups: Dict[PlacementGroupID, PlacementGroup] = {}  # guarded-by: _lock
         self._dispatch_thread = threading.Thread(
             target=self._dispatch_loop, name="ray_tpu-scheduler", daemon=True
         )
@@ -640,7 +640,7 @@ class ClusterScheduler:
             return pg
         raise PlacementGroupUnschedulableError(last_err)
 
-    def _plan_placement_locked(self, pg: PlacementGroup) -> Optional[List[Node]]:
+    def _plan_placement_locked(self, pg: PlacementGroup) -> Optional[List[Node]]:  # holds-lock: _lock
         # draining (PREEMPTING) nodes never take new bundles: a gang
         # reserved there would die with the node inside its own startup
         nodes = [n for n in self._nodes.values() if n.placeable()]

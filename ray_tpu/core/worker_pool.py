@@ -275,10 +275,10 @@ class ProcessWorkerPool:
             cfg.max_process_workers or max(2, os.cpu_count() or 4)
         )
         self._idle_reap_s = cfg.worker_idle_timeout_s
-        self._idle: List[WorkerProcess] = []
-        self._busy: List[WorkerProcess] = []
-        self._spawning = 0  # slots reserved for in-flight spawns
-        self._closed = False
+        self._idle: List[WorkerProcess] = []  # guarded-by: _lock|_free
+        self._busy: List[WorkerProcess] = []  # guarded-by: _lock|_free
+        self._spawning = 0  # in-flight spawn slots  # guarded-by: _lock|_free
+        self._closed = False  # guarded-by: _lock|_free
         self._lock = threading.Lock()
         self._free = threading.Condition(self._lock)
         self.stats = {"spawned": 0, "reused": 0, "reaped": 0, "crashed": 0}
@@ -345,7 +345,7 @@ class ProcessWorkerPool:
                 self._idle.append(worker)
             self._free.notify_all()
 
-    def _reap_locked(self) -> None:
+    def _reap_locked(self) -> None:  # holds-lock: _free
         now = time.monotonic()
         keep = []
         for w in self._idle:

@@ -130,9 +130,9 @@ class ServeController:
     """In-process controller; reconcile loop runs on a daemon thread."""
 
     def __init__(self, reconcile_interval_s: float = 0.2):
-        self._states: Dict[str, _DeploymentState] = {}
+        self._states: Dict[str, _DeploymentState] = {}  # guarded-by: _lock
         # deleted/redeployed deployments whose replicas are still draining
-        self._condemned: List[_DeploymentState] = []
+        self._condemned: List[_DeploymentState] = []  # guarded-by: _lock
         self._lock = threading.Lock()
         self._interval = reconcile_interval_s
         self._stop = threading.Event()

@@ -112,14 +112,14 @@ class ReplicaSet:
                  max_queued: int = -1):
         self.name = name
         self._lock = threading.Lock()
-        self._replicas: List[Any] = []  # ActorHandles
-        self._ongoing: Dict[str, int] = {}  # actor-id hex -> count
-        self._draining: Set[str] = set()
+        self._replicas: List[Any] = []  # ActorHandles  # guarded-by: _lock
+        self._ongoing: Dict[str, int] = {}  # actor-id hex -> count  # guarded-by: _lock
+        self._draining: Set[str] = set()  # guarded-by: _lock
         self.max_ongoing = max_ongoing
         self.max_queued = max_queued  # -1 = unlimited
         # model-multiplex affinity: model_id -> MRU list of replica keys
         # (reference pow_2_scheduler.py is multiplex-aware the same way)
-        self._affinity: Dict[str, List[str]] = {}
+        self._affinity: Dict[str, List[str]] = {}  # guarded-by: _lock
         # telemetry: per-deployment ongoing gauge + the SLO monitor
         # (watchdog) spins up once any serve_slo_* objective is set
         _register_replica_set(self)
@@ -578,14 +578,14 @@ class _Reaper:
     unboundedly (overflow releases + fails the oldest entry and bumps
     raytpu_serve_reaper_overflow_total)."""
 
-    _inst: Optional["_Reaper"] = None
+    _inst: Optional["_Reaper"] = None  # guarded-by: _inst_lock
     _inst_lock = threading.Lock()
 
     def __init__(self):
         from ..util.metrics import get_or_create_gauge
 
         self._lock = threading.Lock()
-        self._tracked: List[_TrackedCall] = []
+        self._tracked: List[_TrackedCall] = []  # guarded-by: _lock
         self._event = threading.Event()
         self._overflow_warned = False
         get_or_create_gauge(

@@ -52,7 +52,7 @@ class Counter(_Metric):
 
     def __init__(self, name, description="", tag_keys=()):
         super().__init__(name, description, tag_keys)
-        self._values: Dict[tuple, float] = {}
+        self._values: Dict[tuple, float] = {}  # guarded-by: _lock
 
     def inc(self, value: float = 1.0, tags: Optional[TagDict] = None) -> None:
         key = _tags_key(tags)

@@ -1,0 +1,2 @@
+"""Repo tooling package: static checks live in scripts/raylint; the
+top-level check_*.py files are thin compatibility shims over it."""

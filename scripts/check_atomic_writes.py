@@ -1,66 +1,31 @@
 #!/usr/bin/env python
-"""Static check: state-persisting writes must be atomic.
+"""Thin compatibility shim over scripts/raylint (rule: atomic-writes).
 
-Any ``open(path, "w")`` / ``open(path, "wb")`` under ``ray_tpu/train/``
-or in ``ray_tpu/core/gcs.py`` persists state another process (or a
-post-crash restart) will read back — checkpoints, manifests, preemption
-flag files, GCS snapshots. A direct write can be torn by a crash or a
-preemption mid-write, which is exactly the corruption the verified
-checkpoint layer exists to catch; writers must never CREATE that state.
-
-Rule: every such open must go through the tmp-file + ``os.replace``
-commit pattern. Heuristics accepted as compliant:
-
-- the path expression mentions ``tmp`` (``tmp = path + ".tmp"`` staging), or
-- an ``os.replace(`` appears within a few lines after the open, or
-- the line carries an explicit ``# atomic-ok: <why>`` waiver.
-
-Exits non-zero listing violations; run by tier-1 via
-tests/test_train_preemption.py (next to check_typed_errors.py and
-check_metrics_names.py).
+The logic lives in scripts/raylint/rules_legacy.py; this entry point
+keeps the historical CLI (`python scripts/check_atomic_writes.py
+[root]`) and module API (check_file) for existing tier-1 wiring.
+Repo-wide enforcement runs through `python -m scripts.raylint`
+(tests/test_raylint.py).
 """
 
 from __future__ import annotations
 
-import re
 import sys
 from pathlib import Path
 
-_OPEN_WRITE = re.compile(r"""open\(\s*([^,)]+),\s*(?:mode\s*=\s*)?["']wb?["']""")
-_WAIVER = re.compile(r"#\s*atomic-ok:")
-_REPLACE_WINDOW = 8  # lines after the open() in which os.replace must appear
+_REPO = Path(__file__).resolve().parent.parent
+if str(_REPO) not in sys.path:
+    sys.path.insert(0, str(_REPO))
 
-
-def check_file(path: Path):
-    errors = []
-    lines = path.read_text().splitlines()
-    for lineno, line in enumerate(lines, 1):
-        m = _OPEN_WRITE.search(line)
-        if m is None:
-            continue
-        if _WAIVER.search(line):
-            continue
-        path_expr = m.group(1)
-        if "tmp" in path_expr.lower():
-            continue  # staged write: the os.replace commit is the contract
-        tail = "\n".join(lines[lineno - 1: lineno - 1 + _REPLACE_WINDOW])
-        if "os.replace(" in tail:
-            continue
-        errors.append(
-            f"{path}:{lineno}: non-atomic state write "
-            f"(open({path_expr.strip()}, 'w'/'wb') without tmp + os.replace); "
-            f"stage to a .tmp sibling and os.replace, or waive with "
-            f"'# atomic-ok: <why>'"
-        )
-    return errors
+from scripts.raylint.rules_legacy import check_file  # noqa: E402,F401 - compat API
 
 
 def main(argv) -> int:
-    root = Path(argv[1]) if len(argv) > 1 else (
-        Path(__file__).resolve().parent.parent / "ray_tpu"
-    )
+    root = Path(argv[1]) if len(argv) > 1 else _REPO / "ray_tpu"
     targets = sorted((root / "train").rglob("*.py"))
-    targets.append(root / "core" / "gcs.py")
+    gcs = root / "core" / "gcs.py"
+    if gcs.exists():
+        targets.append(gcs)
     errors = []
     for path in targets:
         errors.extend(check_file(path))

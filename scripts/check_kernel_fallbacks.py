@@ -1,181 +1,41 @@
 #!/usr/bin/env python
-"""Static check: TPU-gated kernels keep non-TPU fallbacks, config knobs
-stay registered.
+"""Thin compatibility shim over scripts/raylint (rule: kernel-fallbacks).
 
-Two invariants the kernel/collectives work of round 6 depends on:
-
-1. **Kernel fallbacks.** Any module under ``ray_tpu/`` that uses
-   ``pltpu`` (the Mosaic TPU pallas extension) must stay importable and
-   runnable on CPU-only hosts: the ``pltpu`` import has to be guarded by
-   try/except ImportError, and the module must carry a non-TPU execution
-   path — either a ``*reference*`` XLA implementation or an
-   ``interpret=``-driven pallas call. Tier-1 runs on CPU; an unguarded
-   TPU-only kernel would pass review and break every non-TPU user.
-
-2. **Config knobs.** Every ``cfg.<name>`` attribute read anywhere in the
-   tree must correspond to a ``define_flag(...)`` registration in
-   ``core/config.py`` (the one place flags are documented and
-   env-overridable). A typo'd or unregistered knob raises only at
-   runtime on the path that reads it; this catches it statically. The
-   round-6 knobs (attn_pipeline, dp_allreduce_dtype, dp_shard_update,
-   dp_quant_block) are additionally pinned by name.
-
-Exits non-zero listing violations; wired into tier-1 via
-tests/test_ops.py (next to check_lazy_jax.py et al.).
+The logic lives in scripts/raylint/rules_legacy.py; this entry point
+keeps the historical CLI (`python scripts/check_kernel_fallbacks.py`)
+for existing tier-1 wiring. Repo-wide enforcement runs through
+`python -m scripts.raylint` (tests/test_raylint.py).
 """
 
 from __future__ import annotations
 
-import ast
 import sys
 from pathlib import Path
 
-REQUIRED_FLAGS = (
-    "attn_pipeline",
-    "dp_allreduce_dtype",
-    "dp_shard_update",
-    "dp_quant_block",
+_REPO = Path(__file__).resolve().parent.parent
+if str(_REPO) not in sys.path:
+    sys.path.insert(0, str(_REPO))
+
+from scripts.raylint import Project, run  # noqa: E402
+from scripts.raylint.rules_legacy import (  # noqa: E402,F401 - compat API
+    REQUIRED_FLAGS,
+    cfg_reads,
+    defined_flags,
 )
-
-# RayTpuConfig API that is not a flag read
-_CFG_METHODS = {"set", "reset", "describe", "as_dict"}
-
-
-def _uses_pltpu(tree: ast.AST) -> bool:
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Name) and node.id == "pltpu":
-            return True
-    return False
-
-
-def _pltpu_import_guarded(tree: ast.AST) -> bool:
-    """The `from jax.experimental.pallas import tpu as pltpu` import must
-    sit inside a try/except ImportError (or be function-local)."""
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Try):
-            handled = any(
-                isinstance(h.type, ast.Name)
-                and h.type.id in ("ImportError", "Exception")
-                or isinstance(h.type, ast.Tuple)
-                for h in node.handlers
-            )
-            if not handled:
-                continue
-            for child in ast.walk(node):
-                if isinstance(child, ast.ImportFrom):
-                    mod = child.module or ""
-                    if mod.startswith("jax.experimental.pallas") and any(
-                        a.asname == "pltpu" or a.name == "tpu"
-                        for a in child.names
-                    ):
-                        return True
-    return False
-
-
-def _has_fallback_path(tree: ast.AST) -> bool:
-    """A `*reference*` function (pure-XLA ground truth) or an
-    `interpret=` kwarg on some call (interpret-mode driver)."""
-    for node in ast.walk(tree):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            if "reference" in node.name:
-                return True
-        if isinstance(node, ast.Call):
-            for kw in node.keywords:
-                if kw.arg == "interpret":
-                    return True
-        if isinstance(node, ast.arg) and node.arg == "interpret":
-            return True
-    return False
-
-
-def _defined_flags(config_path: Path) -> set:
-    tree = ast.parse(config_path.read_text())
-    flags = set()
-    for node in ast.walk(tree):
-        if (
-            isinstance(node, ast.Call)
-            and isinstance(node.func, ast.Name)
-            and node.func.id == "define_flag"
-            and node.args
-            and isinstance(node.args[0], ast.Constant)
-        ):
-            flags.add(node.args[0].value)
-    return flags
-
-
-def _cfg_reads(path: Path):
-    """(lineno, attr) for attribute reads on `cfg` — only in modules that
-    import cfg from the config registry and never rebind the name."""
-    tree = ast.parse(path.read_text())
-    imports_cfg = any(
-        isinstance(node, ast.ImportFrom)
-        and (node.module or "").endswith("config")
-        and any(a.name == "cfg" for a in node.names)
-        for node in ast.walk(tree)
-    )
-    if not imports_cfg:
-        return []
-    for node in ast.walk(tree):  # local rebinding shadows the registry
-        if isinstance(node, (ast.Assign, ast.AnnAssign)):
-            targets = node.targets if isinstance(node, ast.Assign) else [node.target]
-            for t in targets:
-                if isinstance(t, ast.Name) and t.id == "cfg":
-                    return []
-    return [
-        (node.lineno, node.attr)
-        for node in ast.walk(tree)
-        if isinstance(node, ast.Attribute)
-        and isinstance(node.value, ast.Name)
-        and node.value.id == "cfg"
-    ]
 
 
 def main() -> int:
-    repo = Path(__file__).resolve().parent.parent
-    errors = []
-
-    config_path = repo / "ray_tpu" / "core" / "config.py"
-    flags = _defined_flags(config_path)
-    for name in REQUIRED_FLAGS:
-        if name not in flags:
-            errors.append(
-                f"{config_path}: required flag {name!r} is not registered "
-                "via define_flag"
-            )
-
-    py_files = sorted(
-        list((repo / "ray_tpu").rglob("*.py"))
-        + [repo / "bench.py", repo / "bench_serve.py"]
-    )
-    kernel_modules = []
-    for path in py_files:
-        tree = ast.parse(path.read_text())
-        if _uses_pltpu(tree):
-            kernel_modules.append(path)
-            if not _pltpu_import_guarded(tree):
-                errors.append(
-                    f"{path}: pltpu import is not guarded by try/except "
-                    "ImportError — non-TPU builds must still import this"
-                )
-            if not _has_fallback_path(tree):
-                errors.append(
-                    f"{path}: pltpu-gated kernels but no registered non-TPU "
-                    "fallback (need a *reference* function or an "
-                    "interpret= driver)"
-                )
-        for lineno, attr in _cfg_reads(path):
-            if attr not in flags and attr not in _CFG_METHODS:
-                errors.append(
-                    f"{path}:{lineno}: cfg.{attr} reads a flag that is not "
-                    "registered in core/config.py defaults"
-                )
-
-    if errors:
-        print("\n".join(errors))
+    project = Project(_REPO)
+    result = run(project, rules=["kernel-fallbacks"])
+    for f in result.findings:
+        print(f"{f.location}: {f.message}")
+    if result.findings:
         return 1
+    config = project.file("ray_tpu/core/config.py")
+    flags = defined_flags(config.tree) if config else set()
     print(
-        f"check_kernel_fallbacks: {len(kernel_modules)} kernel modules with "
-        f"fallbacks, {len(flags)} registered flags, all cfg reads resolve"
+        f"check_kernel_fallbacks: ok ({len(flags)} registered flags, "
+        f"all cfg reads resolve, pltpu kernels keep fallbacks)"
     )
     return 0
 

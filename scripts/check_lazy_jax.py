@@ -1,89 +1,35 @@
 #!/usr/bin/env python
-"""Static check: jax imports must stay FUNCTION-LOCAL in the modules
-that observer/agent processes import without an accelerator stack.
+"""Thin compatibility shim over scripts/raylint (rule: lazy-jax).
 
-``util/profiling.py``, ``core/stats.py``, and ``util/tracing.py`` are
-imported by every runtime init, by the node stats heartbeat, and by the
-CLI observer paths (`ray_tpu status --address ...` on a laptop). A
-module-level ``import jax`` there would (a) make jax-less hosts unable
-to import the package's observability surface at all and (b) force the
-multi-second jax import onto processes that only want to LIST profiles,
-not take them. The contract: these modules import jax lazily inside the
-functions that actually touch the device (or probe ``sys.modules`` to
-skip the work when jax was never imported).
-
-Rule: no ``import jax`` / ``from jax ... import`` outside a function
-body in the checked modules (class bodies and module scope both count
-as violations; ``if TYPE_CHECKING:`` blocks are exempt).
-
-Exits non-zero listing violations; run by tier-1 via
-tests/test_profiling.py (next to check_metrics_names.py et al.).
+The logic lives in scripts/raylint/rules_legacy.py; this entry point
+keeps the historical CLI (`python scripts/check_lazy_jax.py`) for
+existing tier-1 wiring. Repo-wide enforcement runs through
+`python -m scripts.raylint` (tests/test_raylint.py).
 """
 
 from __future__ import annotations
 
-import ast
 import sys
 from pathlib import Path
 
-CHECKED = (
-    Path("ray_tpu") / "util" / "profiling.py",
-    Path("ray_tpu") / "core" / "stats.py",
-    Path("ray_tpu") / "util" / "tracing.py",
+_REPO = Path(__file__).resolve().parent.parent
+if str(_REPO) not in sys.path:
+    sys.path.insert(0, str(_REPO))
+
+from scripts.raylint import Project, run  # noqa: E402
+from scripts.raylint.rules_legacy import (  # noqa: E402,F401 - compat API
+    LAZY_JAX_MODULES,
+    module_level_jax_imports,
 )
 
 
-def _is_jax_import(node: ast.AST) -> bool:
-    if isinstance(node, ast.Import):
-        return any(
-            alias.name == "jax" or alias.name.startswith("jax.")
-            for alias in node.names
-        )
-    if isinstance(node, ast.ImportFrom):
-        mod = node.module or ""
-        return mod == "jax" or mod.startswith("jax.")
-    return False
-
-
-def _walk(node: ast.AST, in_function: bool, in_type_checking: bool, out):
-    for child in ast.iter_child_nodes(node):
-        child_in_fn = in_function or isinstance(
-            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
-        )
-        child_tc = in_type_checking or (
-            isinstance(node, ast.If)
-            and isinstance(node.test, (ast.Name, ast.Attribute))
-            and "TYPE_CHECKING" in ast.dump(node.test)
-        )
-        if _is_jax_import(child) and not child_in_fn and not child_tc:
-            out.append(child.lineno)
-        _walk(child, child_in_fn, child_tc, out)
-
-
-def check_file(path: Path):
-    tree = ast.parse(path.read_text())
-    offenders: list = []
-    _walk(tree, in_function=False, in_type_checking=False, out=offenders)
-    return [
-        f"{path}:{lineno}: module-level jax import — move it inside the "
-        f"function that needs it (this module must import on jax-less hosts)"
-        for lineno in offenders
-    ]
-
-
 def main() -> int:
-    repo = Path(__file__).resolve().parent.parent
-    errors = []
-    for rel in CHECKED:
-        path = repo / rel
-        if not path.exists():
-            errors.append(f"{path}: checked module is missing")
-            continue
-        errors.extend(check_file(path))
-    if errors:
-        print("\n".join(errors))
+    result = run(Project(_REPO), rules=["lazy-jax"])
+    for f in result.findings:
+        print(f"{f.location}: {f.message}")
+    if result.findings:
         return 1
-    print(f"check_lazy_jax: {len(CHECKED)} modules clean")
+    print(f"check_lazy_jax: {len(LAZY_JAX_MODULES)} modules clean")
     return 0
 
 

@@ -1,129 +1,27 @@
 #!/usr/bin/env python
-"""Static check: metric naming + registration discipline in ray_tpu/.
+"""Thin compatibility shim over scripts/raylint (rule: metrics-names).
 
-Four rules, enforced over every literal-name Counter(/Gauge(/Histogram(
-instantiation (including the get_or_create_* accessors) in the package:
-
-1. Every metric name carries the ``raytpu_`` prefix — the scrape
-   namespace stays collision-free against other exporters.
-2. A literal name may be DIRECTLY constructed (bare ``Counter("x"``,
-   not ``get_or_create_counter("x"``) at most once across the package:
-   a second direct construction would shadow the registered series with
-   a fresh zeroed one (MetricsRegistry.register overwrites). Re-runnable
-   emitters must go through get_or_create_*.
-3. Every histogram registration passes explicit ``boundaries=``: the
-   constructor's fallback buckets silently misfit most latency
-   distributions, and two call sites disagreeing about the default
-   would fork the series shape.
-4. Gauge sampler callbacks run ONLY through Gauge.collect's
-   sampler-failure guard: calling a metric's ``._fn(`` directly, or
-   overriding ``collect()`` outside util/metrics.py, bypasses the guard
-   and lets one broken sampler kill the whole scrape.
-
-Exits non-zero listing violations; run by tier-1 via
-tests/test_observability.py.
+The logic lives in scripts/raylint/rules_legacy.py; this entry point
+keeps the historical CLI (`python scripts/check_metrics_names.py
+[package_root]`) and module API (check) for existing tier-1 wiring.
+Repo-wide enforcement runs through `python -m scripts.raylint`
+(tests/test_raylint.py).
 """
 
 from __future__ import annotations
 
-import re
 import sys
-from collections import defaultdict
 from pathlib import Path
 
-# literal-first-arg metric instantiations; group 1 = constructor,
-# group 2 = metric name
-_PATTERN = re.compile(
-    r"""(?<![\w.])(Counter|Gauge|Histogram|
-        get_or_create_counter|get_or_create_gauge|get_or_create_histogram)
-        \(\s*["']([^"']+)["']""",
-    re.VERBOSE,
-)
-_DIRECT = {"Counter", "Gauge", "Histogram"}
-_HISTOGRAMS = {"Histogram", "get_or_create_histogram"}
-# the one module allowed to touch sampler internals (it IS the guard)
-_GUARD_MODULE = "metrics.py"
+_REPO = Path(__file__).resolve().parent.parent
+if str(_REPO) not in sys.path:
+    sys.path.insert(0, str(_REPO))
 
-
-def _call_text(text: str, start: int, limit: int = 4000) -> str:
-    """The full call expression from the opening paren at/after `start`
-    to its balanced close (string-naive: metric registrations never
-    embed unbalanced parens in literals)."""
-    i = text.index("(", start)
-    depth = 0
-    for j in range(i, min(len(text), i + limit)):
-        if text[j] == "(":
-            depth += 1
-        elif text[j] == ")":
-            depth -= 1
-            if depth == 0:
-                return text[i:j + 1]
-    return text[i:i + limit]
-
-
-def check(package_root: Path):
-    errors = []
-    direct_sites = defaultdict(list)  # metric name -> [file:line]
-    for path in sorted(package_root.rglob("*.py")):
-        text = path.read_text()
-        lines = text.splitlines()
-        rel = path.relative_to(package_root.parent)
-        for match in _PATTERN.finditer(text):
-            lineno = text.count("\n", 0, match.start()) + 1
-            line = lines[lineno - 1].strip()
-            if line.startswith(("class ", "def ", "#")):
-                continue
-            ctor, name = match.group(1), match.group(2)
-            site = f"{rel}:{lineno}"
-            if not name.startswith("raytpu_"):
-                errors.append(
-                    f"{site}: metric {name!r} missing the raytpu_ prefix"
-                )
-            if ctor in _DIRECT:
-                direct_sites[name].append(site)
-            if ctor in _HISTOGRAMS:
-                call = _call_text(text, match.start())
-                if "boundaries" not in call:
-                    errors.append(
-                        f"{site}: histogram {name!r} registered without "
-                        f"explicit boundaries= — the default buckets misfit "
-                        f"most latency distributions"
-                    )
-        # rule 4: sampler-guard bypasses (outside the guard module)
-        if path.name == _GUARD_MODULE and path.parent.name == "util":
-            continue
-        for lineno, line in enumerate(lines, 1):
-            stripped = line.strip()
-            if stripped.startswith("#"):
-                continue
-            if re.search(r"\._fn\(\s*\)", line):
-                # samplers are zero-arg callables; `obj._fn(args)` is
-                # some other attribute, not a gauge callback
-                errors.append(
-                    f"{rel}:{lineno}: direct sampler call `._fn()` bypasses "
-                    f"the Gauge.collect sampler-failure guard — sample "
-                    f"through collect()/prometheus_text()"
-                )
-            if re.match(r"\s*def collect\(", line):
-                errors.append(
-                    f"{rel}:{lineno}: collect() override outside "
-                    f"util/metrics.py — callback gauges must go through the "
-                    f"guarded Gauge.collect, not reimplement it"
-                )
-    for name, sites in sorted(direct_sites.items()):
-        if len(sites) > 1:
-            errors.append(
-                f"metric {name!r} directly constructed at {len(sites)} sites "
-                f"({', '.join(sites)}): all but the first silently shadow the "
-                f"registered series — use get_or_create_*"
-            )
-    return errors
+from scripts.raylint.rules_legacy import check  # noqa: E402,F401 - compat API
 
 
 def main(argv) -> int:
-    root = Path(argv[1]) if len(argv) > 1 else (
-        Path(__file__).resolve().parent.parent / "ray_tpu"
-    )
+    root = Path(argv[1]) if len(argv) > 1 else _REPO / "ray_tpu"
     errors = check(root)
     for err in errors:
         print(f"check_metrics_names: {err}", file=sys.stderr)

@@ -1,61 +1,30 @@
 #!/usr/bin/env python
-"""Static check: typed-error discipline in the serve path.
+"""Thin compatibility shim over scripts/raylint (rule: typed-errors).
 
-Two rules:
-
-1. No bare ``except:`` anywhere under ``ray_tpu/serve/`` — a bare
-   except swallows the typed resilience errors (BackPressureError,
-   RequestTimeoutError, ...) the router and HTTP layers dispatch on,
-   silently converting a failover/shed/deadline signal into a hang or a
-   generic 500. Catch a named exception class instead (``except
-   Exception`` at an explicitly-marked boundary is fine).
-2. Every exception class defined in ``ray_tpu/core/exceptions.py`` is
-   exported from the top-level ``ray_tpu`` package, so callers can
-   always catch framework errors without reaching into core internals.
-
-Exits non-zero listing violations; run by tier-1 via
-tests/test_serve_resilience.py (next to check_metrics_names.py).
+The logic lives in scripts/raylint/rules_legacy.py; this entry point
+keeps the historical CLI (`python scripts/check_typed_errors.py [root]`)
+and module API (check_bare_except/check_exports) for existing tier-1
+wiring. Repo-wide enforcement runs through `python -m scripts.raylint`
+(tests/test_raylint.py).
 """
 
 from __future__ import annotations
 
-import re
 import sys
 from pathlib import Path
 
-_BARE_EXCEPT = re.compile(r"^\s*except\s*:")
-_EXC_CLASS = re.compile(r"^class\s+(\w+)\s*\(", re.MULTILINE)
+_REPO = Path(__file__).resolve().parent.parent
+if str(_REPO) not in sys.path:
+    sys.path.insert(0, str(_REPO))
 
-
-def check_bare_except(serve_root: Path):
-    errors = []
-    for path in sorted(serve_root.rglob("*.py")):
-        for lineno, line in enumerate(path.read_text().splitlines(), 1):
-            if _BARE_EXCEPT.match(line):
-                errors.append(
-                    f"{path}:{lineno}: bare 'except:' in the serve path — "
-                    "catch a named exception class"
-                )
-    return errors
-
-
-def check_exports(package_root: Path):
-    errors = []
-    exc_src = (package_root / "core" / "exceptions.py").read_text()
-    init_src = (package_root / "__init__.py").read_text()
-    for name in _EXC_CLASS.findall(exc_src):
-        if not re.search(rf"\b{re.escape(name)}\b", init_src):
-            errors.append(
-                f"core/exceptions.py defines {name} but ray_tpu/__init__.py "
-                "does not export it"
-            )
-    return errors
+from scripts.raylint.rules_legacy import (  # noqa: E402,F401 - compat API
+    check_bare_except,
+    check_exports,
+)
 
 
 def main(argv) -> int:
-    root = Path(argv[1]) if len(argv) > 1 else (
-        Path(__file__).resolve().parent.parent / "ray_tpu"
-    )
+    root = Path(argv[1]) if len(argv) > 1 else _REPO / "ray_tpu"
     errors = check_bare_except(root / "serve") + check_exports(root)
     for err in errors:
         print(f"check_typed_errors: {err}", file=sys.stderr)

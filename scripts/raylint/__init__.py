@@ -1,0 +1,36 @@
+"""raylint: unified AST static analysis for ray_tpu.
+
+One engine (parsed-file cache, rule registry, `# raylint:` suppression
+comments, committed baseline, text/JSON reporters) carrying:
+
+- the five legacy checks as rules: typed-errors, metrics-names,
+  atomic-writes, lazy-jax, kernel-fallbacks (the old scripts/check_*.py
+  entry points are thin shims over these);
+- lock-discipline: `# guarded-by:` annotated attributes only accessed
+  under their lock; lock-order: no acquisition-order cycles;
+- blocking-under-lock: no sleeps/joins/waits/RPCs inside a critical
+  section;
+- jax-hot-path: no host syncs or recompilation traps in functions
+  reachable from jit/shard_map step definitions.
+
+Run ``python -m scripts.raylint`` from the repo root; see README
+"Static analysis".
+"""
+
+from .engine import (  # noqa: F401
+    REGISTRY,
+    Finding,
+    Project,
+    Rule,
+    RunResult,
+    SourceFile,
+    register,
+    run,
+)
+
+# importing the rule modules populates REGISTRY
+from . import rules_legacy  # noqa: F401,E402
+from . import rules_locks  # noqa: F401,E402
+from . import rules_jax  # noqa: F401,E402
+
+DEFAULT_BASELINE = "scripts/raylint/baseline.json"

@@ -1,0 +1,95 @@
+"""CLI: ``python -m scripts.raylint [options]`` from the repo root.
+
+Exit status is 0 when every finding is fixed, suppressed, or baselined;
+1 otherwise. ``--write-baseline`` records the current findings as the
+new baseline (preserving existing justifications) and exits 0.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+from . import DEFAULT_BASELINE, REGISTRY, Project, run
+from .baseline import Baseline
+from .reporters import render_json, render_text
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m scripts.raylint",
+        description="unified static analysis over ray_tpu/",
+    )
+    parser.add_argument(
+        "--root", default=None,
+        help="repo root (default: the checkout containing this package)",
+    )
+    parser.add_argument(
+        "--rules", default=None,
+        help="comma-separated rule subset (default: all registered)",
+    )
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="emit the machine-readable JSON report")
+    parser.add_argument(
+        "--baseline", default=None, metavar="PATH",
+        help=f"baseline file (default: {DEFAULT_BASELINE})",
+    )
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="report every finding, ignoring the baseline")
+    parser.add_argument(
+        "--write-baseline", action="store_true",
+        help="record current findings as the new baseline and exit 0",
+    )
+    parser.add_argument("--show-baselined", action="store_true",
+                        help="also list baselined findings in text output")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="list registered rules and exit")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for name in sorted(REGISTRY):
+            print(f"{name}: {REGISTRY[name].doc}")
+        return 0
+
+    root = Path(args.root) if args.root else Path(__file__).resolve().parents[2]
+    baseline_path = Path(args.baseline) if args.baseline else root / DEFAULT_BASELINE
+    rules = (
+        [r.strip() for r in args.rules.split(",") if r.strip()]
+        if args.rules else None
+    )
+    project = Project(root)
+
+    t0 = time.monotonic()
+    if args.write_baseline:
+        result = run(project, rules=rules, baseline=None)
+        old = Baseline.load(baseline_path)
+        payload = old.write(baseline_path, result.findings, project)
+        print(
+            f"raylint: baseline written to {baseline_path} "
+            f"({len(payload['entries'])} entries; justify any "
+            f"TODO entries before committing)"
+        )
+        return 0
+
+    baseline = None if args.no_baseline else Baseline.load(baseline_path)
+    try:
+        result = run(project, rules=rules, baseline=baseline)
+    except ValueError as exc:  # e.g. an unknown --rules name
+        print(f"raylint: {exc}", file=sys.stderr)
+        return 2
+    elapsed = time.monotonic() - t0
+    if args.as_json:
+        payload = render_json(result)
+        payload["elapsed_s"] = round(elapsed, 3)
+        print(json.dumps(payload, indent=2))
+    else:
+        print(render_text(result, show_baselined=args.show_baselined))
+        print(f"raylint: {len(project.files)} files in {elapsed:.2f}s")
+    return 0 if result.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

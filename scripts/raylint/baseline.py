@@ -1,0 +1,121 @@
+"""Committed baseline of pre-existing findings.
+
+Each entry pins one finding by a line-number-insensitive fingerprint
+(rule + path + the offending source line's stripped text + its occurrence
+index among identical lines), so unrelated edits above a finding don't
+invalidate the baseline. Entries carry a human `justification` — a
+baselined finding is an explicit engineering decision, not a mute button.
+
+Regenerate with ``python -m scripts.raylint --write-baseline``; existing
+justifications are preserved for entries that persist, new entries get a
+TODO placeholder that should be replaced before committing.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+from .engine import Finding, Project
+
+VERSION = 1
+TODO_JUSTIFICATION = "TODO: justify or fix this finding"
+
+
+def _fingerprint(rule: str, path: str, text: str, occurrence: int) -> str:
+    blob = f"{rule}|{path}|{text}|{occurrence}".encode()
+    return hashlib.sha1(blob).hexdigest()[:16]
+
+
+def _line_text(project: Project, finding: Finding) -> str:
+    sf = project.file(finding.path)
+    if sf is not None and 1 <= finding.line <= len(sf.lines):
+        return sf.lines[finding.line - 1].strip()
+    return finding.message  # project-scope findings without a source line
+
+
+def fingerprints(findings: List[Finding],
+                 project: Project) -> List[Tuple[Finding, str]]:
+    """Pair each finding with its fingerprint, disambiguating identical
+    (rule, path, line-text) triples by order of appearance."""
+    seen: Dict[Tuple[str, str, str], int] = {}
+    out = []
+    for f in findings:
+        key = (f.rule, f.path, _line_text(project, f))
+        occurrence = seen.get(key, 0)
+        seen[key] = occurrence + 1
+        out.append((f, _fingerprint(*key, occurrence)))
+    return out
+
+
+class Baseline:
+    """Load/apply/write the committed findings baseline."""
+
+    def __init__(self, entries: List[dict], path: Optional[Path] = None):
+        self.entries = entries
+        self.path = path
+
+    @classmethod
+    def empty(cls) -> "Baseline":
+        return cls([])
+
+    @classmethod
+    def load(cls, path) -> "Baseline":
+        path = Path(path)
+        if not path.exists():
+            return cls([], path)
+        data = json.loads(path.read_text())
+        return cls(list(data.get("entries", [])), path)
+
+    def apply(self, findings: List[Finding], project: Project):
+        """Split findings into (actionable, baselined); also return the
+        stale baseline entries that matched nothing (fixed or moved —
+        prune them with --write-baseline)."""
+        budget: Dict[str, int] = {}
+        for entry in self.entries:
+            fp = entry.get("fingerprint", "")
+            budget[fp] = budget.get(fp, 0) + 1
+        actionable, baselined = [], []
+        for finding, fp in fingerprints(findings, project):
+            if budget.get(fp, 0) > 0:
+                budget[fp] -= 1
+                baselined.append(finding)
+            else:
+                actionable.append(finding)
+        stale = []
+        remaining = dict(budget)  # unmatched counts after consumption
+        for entry in self.entries:
+            fp = entry.get("fingerprint", "")
+            if remaining.get(fp, 0) > 0:
+                remaining[fp] -= 1
+                stale.append(entry)
+        return actionable, baselined, stale
+
+    def write(self, path, findings: List[Finding], project: Project) -> dict:
+        """Write a fresh baseline covering `findings`, preserving the
+        justification of any entry whose fingerprint persists."""
+        path = Path(path)
+        old_just = {
+            e.get("fingerprint"): e.get("justification")
+            for e in self.entries
+            if e.get("justification")
+            and e.get("justification") != TODO_JUSTIFICATION
+        }
+        entries = []
+        for finding, fp in fingerprints(findings, project):
+            entries.append({
+                "rule": finding.rule,
+                "path": finding.path,
+                "line": finding.line,
+                "text": _line_text(project, finding),
+                "fingerprint": fp,
+                "justification": old_just.get(fp, TODO_JUSTIFICATION),
+            })
+        payload = {"version": VERSION, "entries": entries}
+        path.parent.mkdir(parents=True, exist_ok=True)
+        tmp = path.with_suffix(path.suffix + ".tmp")
+        tmp.write_text(json.dumps(payload, indent=2) + "\n")
+        tmp.replace(path)
+        return payload

@@ -1,0 +1,62 @@
+"""Text and JSON reporters over an engine.RunResult."""
+
+from __future__ import annotations
+
+from typing import List
+
+from .engine import RunResult
+
+
+def render_text(result: RunResult, *, show_baselined: bool = False) -> str:
+    """Human report: one `path:line: [rule] message` per finding, then a
+    per-rule count summary (the tier-1 failure message names rule and
+    file:line straight from this)."""
+    out: List[str] = []
+    for f in result.findings:
+        out.append(f"{f.location}: [{f.rule}] {f.message}")
+    if show_baselined:
+        for f in result.baselined:
+            out.append(f"{f.location}: [{f.rule}] (baselined) {f.message}")
+    for entry in result.stale_baseline:
+        out.append(
+            f"stale baseline entry: [{entry.get('rule')}] "
+            f"{entry.get('path')}:{entry.get('line')} no longer matches — "
+            f"regenerate with --write-baseline"
+        )
+    total = len(result.findings)
+    per_rule = ", ".join(
+        f"{name}={count}" for name, count in sorted(result.counts.items())
+    )
+    status = "FAIL" if total else "ok"
+    out.append(
+        f"raylint: {status} — {total} finding(s) "
+        f"[{per_rule}] "
+        f"({len(result.baselined)} baselined, {result.suppressed} suppressed"
+        + (f", {len(result.stale_baseline)} stale baseline entr(y/ies)"
+           if result.stale_baseline else "")
+        + ")"
+    )
+    return "\n".join(out)
+
+
+def render_json(result: RunResult) -> dict:
+    """Machine schema (stable, versioned): counts include every ran rule
+    (zeros too) so consumers can assert coverage."""
+    return {
+        "version": 1,
+        "rules": list(result.ran_rules),
+        "counts": dict(result.counts),
+        "findings": [
+            {
+                "rule": f.rule,
+                "path": f.path,
+                "line": f.line,
+                "message": f.message,
+            }
+            for f in result.findings
+        ],
+        "baselined": len(result.baselined),
+        "suppressed": result.suppressed,
+        "stale_baseline": list(result.stale_baseline),
+        "ok": result.ok,
+    }

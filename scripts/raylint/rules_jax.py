@@ -1,0 +1,318 @@
+"""JAX hot-path hygiene: inside functions reachable from jit/shard_map
+step definitions in train/, ops/ and parallel/, flag implicit host syncs
+and recompilation traps.
+
+Host syncs flagged in hot functions:
+- ``float(x)`` on a non-constant — forces a device->host transfer (and a
+  blocking sync) when x is a tracer/array;
+- zero-arg ``.item()`` — the canonical explicit sync;
+- ``np.asarray(...)`` / ``np.array(...)`` on a traced value — silently
+  materializes on host;
+- ``print(...)`` — printing a tracer syncs (and burns time in the step
+  loop); use jax.debug.print.
+
+Recompilation traps (checked in every function of the scoped modules):
+- a ``jit``/``jax.jit`` wrapper constructed inside a loop — a fresh
+  wrapper per iteration means a fresh trace+compile per iteration;
+- ``jit(lambda ...)`` inside a function body — a fresh lambda object per
+  call never hits the jit cache.
+
+Reachability is name-level and per-module: decorated jit/shard_map
+functions (including ``functools.partial(jax.jit, ...)`` decorators) and
+functions passed to ``jit(...)``/``shard_map(...)`` calls are roots; an
+intra-module call graph (bare-name and ``self.<name>`` calls) closes
+over them.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .engine import Finding, Project, Rule, SourceFile, register
+
+HOT_PATH_PREFIXES = (
+    "ray_tpu/train/",
+    "ray_tpu/ops/",
+    "ray_tpu/parallel/",
+)
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+_JIT_NAMES = {"jit", "pjit"}
+_WRAP_NAMES = {"jit", "pjit", "shard_map"}
+_NP_MODULES = {"np", "numpy", "onp"}
+
+
+def _tail(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    """`jit`, `jax.jit`, `pjit`, `shard_map` as a bare reference."""
+    return _tail(node) in _WRAP_NAMES
+
+
+def _is_jit_call(node: ast.Call) -> bool:
+    """A call that produces a compiled wrapper: jit(f), jax.jit(f, ...),
+    shard_map(f, mesh=...), functools.partial(jax.jit, ...)."""
+    if _is_jit_expr(node.func):
+        return True
+    if _tail(node.func) == "partial" and node.args:
+        return _is_jit_expr(node.args[0])
+    return False
+
+
+def _decorated_as_root(fn) -> bool:
+    for dec in fn.decorator_list:
+        if _is_jit_expr(dec):
+            return True
+        if isinstance(dec, ast.Call) and _is_jit_call(dec):
+            return True
+        if isinstance(dec, ast.Call) and _is_jit_expr(dec.func):
+            return True
+    return False
+
+
+def _collect_functions(tree: ast.AST) -> Dict[str, List[ast.AST]]:
+    """name -> FunctionDef nodes (methods and nested defs included; the
+    name-level over-approximation errs toward more coverage)."""
+    out: Dict[str, List[ast.AST]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, _FUNC_NODES):
+            out.setdefault(node.name, []).append(node)
+    return out
+
+
+def _called_names(fn: ast.AST) -> Set[str]:
+    """Bare-name and self-method call targets, plus any function NAME
+    passed as an argument to another call (step functions ride into
+    helpers as values: make_step(loss_fn))."""
+    names: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            t = _tail(node.func)
+            if t:
+                names.add(t)
+            for arg in node.args:
+                if isinstance(arg, ast.Name):
+                    names.add(arg.id)
+    return names
+
+
+def hot_roots(tree: ast.AST) -> Set[str]:
+    """Function names that are jit/shard_map entry points."""
+    roots: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, _FUNC_NODES) and _decorated_as_root(node):
+            roots.add(node.name)
+        if isinstance(node, ast.Call) and _is_jit_call(node):
+            for arg in node.args:
+                if isinstance(arg, ast.Name):
+                    roots.add(arg.id)
+                elif isinstance(arg, ast.Attribute):
+                    roots.add(arg.attr)
+    return roots
+
+
+def reachable_hot_functions(
+    trees,
+) -> Dict[int, Tuple[str, str, ast.AST]]:
+    """id(def node) -> (rel, name, def node) for every function reachable
+    from a hot root through the name-level call graph. `trees` is
+    [(rel, ast)] — the graph spans ALL of them jointly, because jitted
+    steps in train/ call loss/attention helpers defined in ops/."""
+    if isinstance(trees, ast.AST):  # single-module convenience
+        trees = [("", trees)]
+    functions: Dict[str, List[Tuple[str, ast.AST]]] = {}
+    roots: Set[str] = set()
+    for rel, tree in trees:
+        for name, defs in _collect_functions(tree).items():
+            functions.setdefault(name, []).extend(
+                (rel, fn) for fn in defs
+            )
+        roots.update(hot_roots(tree))
+    frontier = [n for n in roots if n in functions]
+    reached: Set[str] = set()
+    while frontier:
+        name = frontier.pop()
+        if name in reached:
+            continue
+        reached.add(name)
+        for _, fn in functions[name]:
+            for callee in _called_names(fn):
+                if callee in functions and callee not in reached:
+                    frontier.append(callee)
+    return {
+        id(fn): (rel, name, fn)
+        for name in reached
+        for rel, fn in functions[name]
+    }
+
+
+def _touches_shape(node: ast.AST) -> bool:
+    """float(x.shape[0] * ...) operates on static Python ints, not
+    device values — never a sync."""
+    return any(
+        isinstance(sub, ast.Attribute) and sub.attr in ("shape", "ndim",
+                                                        "size", "dtype")
+        for sub in ast.walk(node)
+    )
+
+
+def _host_sync_reason(node: ast.Call) -> Optional[str]:
+    func = node.func
+    if isinstance(func, ast.Name):
+        if (
+            func.id == "float"
+            and node.args
+            and not isinstance(node.args[0], ast.Constant)
+            and not _touches_shape(node.args[0])
+        ):
+            return ("float(...) forces a device->host sync on a traced "
+                    "value; keep it as an array (or jnp.float32(...))")
+        if func.id == "print":
+            return ("print(...) inside a jit hot path syncs tracers to "
+                    "host; use jax.debug.print")
+        return None
+    if isinstance(func, ast.Attribute):
+        if func.attr == "item" and not node.args and not node.keywords:
+            return (".item() is an explicit host sync; hot paths must "
+                    "stay on device")
+        if (
+            func.attr in ("asarray", "array")
+            and isinstance(func.value, ast.Name)
+            and func.value.id in _NP_MODULES
+        ):
+            return (f"np.{func.attr}(...) materializes a traced value on "
+                    f"host; use jnp.{func.attr}")
+    return None
+
+
+def _in_function_body(fn: ast.AST):
+    """Walk fn's body without descending into nested function scopes."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(node, _FUNC_NODES + (ast.Lambda,)):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def hot_sync_findings(
+    hot: Dict[int, Tuple[str, str, ast.AST]]
+) -> List[Finding]:
+    """Host-sync findings over a joint reachable-function map."""
+    findings: List[Finding] = []
+    flagged: Set[Tuple[str, int, str]] = set()
+    for rel, name, fn in sorted(
+        hot.values(), key=lambda t: (t[0], t[2].lineno)
+    ):
+        for node in _in_function_body(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            reason = _host_sync_reason(node)
+            if reason is None:
+                continue
+            key = (rel, node.lineno, reason)
+            if key in flagged:
+                continue  # one finding per site even if multiply reachable
+            flagged.add(key)
+            findings.append(Finding(
+                "jax-hot-path", rel, node.lineno,
+                f"in {name}() (reachable from a jit/shard_map step): "
+                f"{reason}",
+            ))
+    return findings
+
+
+_STEP_CALL = re.compile(r"(^|_)step(_fn)?$")
+
+
+def step_loop_findings(sf: SourceFile) -> List[Finding]:
+    """Host syncs inside a step-DISPATCH loop: a For/While whose body
+    calls a `*step`/`*step_fn` wrapper. Syncing there (float()/.item()/
+    np.asarray on the step's outputs) blocks jax's async dispatch every
+    iteration — the device idles while the host converts metrics."""
+    findings: List[Finding] = []
+
+    def is_step_loop(loop: ast.AST) -> Optional[str]:
+        for node in ast.walk(loop):
+            if isinstance(node, ast.Call):
+                t = _tail(node.func)
+                if t and _STEP_CALL.search(t):
+                    return t
+        return None
+
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, (ast.For, ast.While, ast.AsyncFor)):
+            continue
+        step_name = is_step_loop(node)
+        if step_name is None:
+            continue
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            reason = _host_sync_reason(sub)
+            if reason is not None:
+                findings.append(Finding(
+                    "jax-hot-path", sf.rel, sub.lineno,
+                    f"in the step-dispatch loop (calls {step_name}()): "
+                    f"{reason} — syncing every iteration stalls jax "
+                    f"async dispatch",
+                ))
+    return findings
+
+
+def recompile_trap_findings(sf: SourceFile) -> List[Finding]:
+    findings: List[Finding] = []
+
+    # recompilation traps: jit wrappers built in loops / jit(lambda)
+    def walk(node: ast.AST, in_loop: bool, in_fn: bool) -> None:
+        for child in ast.iter_child_nodes(node):
+            child_in_loop = in_loop or isinstance(
+                node, (ast.For, ast.While, ast.AsyncFor)
+            )
+            child_in_fn = in_fn or isinstance(node, _FUNC_NODES)
+            if isinstance(child, ast.Call) and _is_jit_call(child):
+                if child_in_loop:
+                    findings.append(Finding(
+                        "jax-hot-path", sf.rel, child.lineno,
+                        "jit/shard_map wrapper constructed inside a loop — "
+                        "every iteration re-traces and recompiles; hoist "
+                        "the wrapper out of the loop",
+                    ))
+                elif child_in_fn and child.args and isinstance(
+                    child.args[0], ast.Lambda
+                ):
+                    findings.append(Finding(
+                        "jax-hot-path", sf.rel, child.lineno,
+                        "jit(lambda ...) inside a function body — a fresh "
+                        "lambda per call never hits the jit cache and "
+                        "recompiles every call; define the function once",
+                    ))
+            walk(child, child_in_loop, child_in_fn)
+
+    walk(sf.tree, False, False)
+    return findings
+
+
+@register
+class JaxHotPathRule(Rule):
+    name = "jax-hot-path"
+    doc = ("Functions reachable from jit/shard_map step definitions in "
+           "train/, ops/ and parallel/ must not host-sync (float()/"
+           ".item()/np.asarray/print on tracers) or rebuild jit wrappers "
+           "per call/iteration.")
+
+    def check(self, project: Project) -> Iterable[Finding]:
+        scoped = project.files_under(*HOT_PATH_PREFIXES)
+        hot = reachable_hot_functions([(sf.rel, sf.tree) for sf in scoped])
+        yield from hot_sync_findings(hot)
+        for sf in scoped:
+            yield from step_loop_findings(sf)
+            yield from recompile_trap_findings(sf)

@@ -1,0 +1,405 @@
+"""Concurrency analysis passes: lock discipline over `# guarded-by:`
+annotations, module-level lock acquisition-order cycles, and blocking
+calls made while a lock is held.
+
+Annotation conventions:
+
+- ``self._attr = ...  # guarded-by: _lock`` on an attribute assignment
+  inside a class declares that every access of ``self._attr`` outside
+  ``__init__``/``__del__`` must happen inside a ``with self._lock:``
+  block (any lock attribute name works, e.g. ``_inst_lock``).
+  ``# guarded-by: _lock|_free`` accepts either name — a Condition and
+  the Lock it wraps are one guard under two names.
+- ``def _helper(self):  # holds-lock: _lock`` on a ``def`` line declares
+  the method is only ever called with ``_lock`` already held; its body
+  is analyzed as if the lock were acquired (the caller side still gets
+  checked at its own ``with``).
+
+Held tracking is intentionally syntactic. For guarded-attribute checks
+any ``with`` item's final name counts as an acquisition (guards are
+matched by their DECLARED name); for lock-order and blocking-under-lock
+only names containing ``lock`` (case-insensitive) count. Nested function
+bodies (closures, lambdas, callbacks) are NOT treated as running under
+the enclosing ``with`` — they usually run later on another thread.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .engine import Finding, Project, Rule, SourceFile, register
+
+_GUARDED_BY = re.compile(r"#\s*guarded-by:\s*([\w.|]+)")
+_HOLDS_LOCK = re.compile(r"#\s*holds-lock:\s*([\w.,\s]+)")
+_LOCKISH = re.compile(r"lock", re.I)
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+_NESTED_SCOPE = _FUNC_NODES + (ast.Lambda,)
+
+
+def _tail_name(node: ast.AST) -> Optional[str]:
+    """Final attribute/name of an expression: self._lock -> '_lock'."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """Dotted source form of a Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _with_locks(stmt: ast.With) -> List[Tuple[str, str]]:
+    """(tail_name, dotted) for every lock-ish context manager acquired by
+    this `with` statement."""
+    out = []
+    for item in stmt.items:
+        tail = _tail_name(item.context_expr)
+        if tail and _LOCKISH.search(tail):
+            out.append((tail, _dotted(item.context_expr) or tail))
+    return out
+
+
+def _holds_locks(sf: SourceFile, fn: ast.AST) -> Set[str]:
+    """Lock names a `# holds-lock:` comment on the def line grants."""
+    line = sf.lines[fn.lineno - 1] if fn.lineno <= len(sf.lines) else ""
+    m = _HOLDS_LOCK.search(line)
+    if not m:
+        return set()
+    return {part.strip() for part in m.group(1).split(",") if part.strip()}
+
+
+def _guarded_attrs(sf: SourceFile, cls: ast.ClassDef) -> Dict[str, frozenset]:
+    """attr -> acceptable guard names, from `# guarded-by:` comments
+    attached to `self.<attr> = ...` (or class-level `<attr> = ...`)
+    assignment lines inside the class. `# guarded-by: _lock|_free`
+    accepts either name (a Condition and the Lock it wraps are one
+    guard under two names)."""
+    annotated: Dict[int, frozenset] = {}
+    for lineno, line in enumerate(sf.lines, 1):
+        m = _GUARDED_BY.search(line)
+        if m:
+            annotated[lineno] = frozenset(
+                part.split(".")[-1]
+                for part in m.group(1).split("|") if part
+            )
+    if not annotated:
+        return {}
+    guarded: Dict[str, frozenset] = {}
+    for node in ast.walk(cls):
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            lock = annotated.get(node.lineno)
+            if lock is None:
+                continue
+            targets = (
+                node.targets if isinstance(node, ast.Assign)
+                else [node.target]
+            )
+            for t in targets:
+                if (
+                    isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id in ("self", "cls")
+                ):
+                    guarded[t.attr] = lock
+                elif isinstance(t, ast.Name):
+                    guarded[t.id] = lock
+    return guarded
+
+
+class _GuardWalker:
+    """Walk one method body tracking which lock tail-names are held,
+    flagging guarded-attribute accesses made without their guard."""
+
+    def __init__(self, sf: SourceFile, cls_name: str,
+                 guarded: Dict[str, str], rule: str):
+        self.sf = sf
+        self.cls_name = cls_name
+        self.guarded = guarded
+        self.rule = rule
+        self.findings: List[Finding] = []
+
+    def walk(self, node: ast.AST, held: Set[str]) -> None:
+        """Process `node` itself, then descend; `with` bodies re-enter
+        here so nested acquisitions stack correctly."""
+        if isinstance(node, ast.With):
+            # guards are matched by the DECLARED name, so any context
+            # manager counts (Conditions like `with self._free:` guard
+            # state too, without 'lock' in their name)
+            acquired = {
+                tail for tail in (
+                    _tail_name(item.context_expr) for item in node.items
+                ) if tail
+            }
+            for item in node.items:  # the with-expr itself runs unheld
+                self.walk(item.context_expr, held)
+            for stmt in node.body:
+                self.walk(stmt, held | acquired)
+            return
+        if isinstance(node, ast.Attribute):
+            self._check_attr(node, held)
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, _NESTED_SCOPE):
+                continue  # closures run later, usually without the lock
+            self.walk(child, held)
+
+    def _check_attr(self, node: ast.Attribute, held: Set[str]) -> None:
+        if not (
+            isinstance(node.value, ast.Name)
+            and node.value.id in ("self", "cls")
+        ):
+            return
+        guard = self.guarded.get(node.attr)
+        if guard is None or guard & held:
+            return
+        spec = "|".join(sorted(guard))
+        main = sorted(guard)[0]
+        self.findings.append(Finding(
+            self.rule, self.sf.rel, node.lineno,
+            f"{self.cls_name}.{node.attr} is declared guarded-by "
+            f"{spec} but is accessed without holding it "
+            f"(wrap in `with self.{main}:` or mark the enclosing "
+            f"method `# holds-lock: {main}`)",
+        ))
+
+
+def lock_discipline_findings(sf: SourceFile) -> List[Finding]:
+    findings: List[Finding] = []
+    for cls in ast.walk(sf.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        guarded = _guarded_attrs(sf, cls)
+        if not guarded:
+            continue
+        for fn in cls.body:
+            if not isinstance(fn, _FUNC_NODES):
+                continue
+            if fn.name in ("__init__", "__del__"):
+                continue  # construction/teardown precede or outlive sharing
+            walker = _GuardWalker(sf, cls.name, guarded, "lock-discipline")
+            walker.walk(fn, _holds_locks(sf, fn))
+            findings.extend(walker.findings)
+    return findings
+
+
+@register
+class LockDisciplineRule(Rule):
+    name = "lock-discipline"
+    doc = ("Attributes annotated `# guarded-by: <lock>` may only be "
+           "accessed inside `with self.<lock>:` (or from a method marked "
+           "`# holds-lock: <lock>`).")
+
+    def check(self, project: Project) -> Iterable[Finding]:
+        for sf in project.files_under("ray_tpu/"):
+            yield from lock_discipline_findings(sf)
+
+
+# ----------------------------------------------------------------- lock-order
+
+
+def _order_edges(sf: SourceFile) -> List[Tuple[str, str, int]]:
+    """(outer_lock, inner_lock, lineno) acquisition edges per module;
+    lock identity is `<ClassName>.<dotted expr>` so same-named locks in
+    different classes don't alias."""
+    edges: List[Tuple[str, str, int]] = []
+
+    def qualify(dotted: str, cls: Optional[str]) -> str:
+        if dotted.startswith(("self.", "cls.")) and cls:
+            return f"{cls}.{dotted.split('.', 1)[1]}"
+        return dotted
+
+    def walk(node: ast.AST, held: List[str], cls: Optional[str]) -> None:
+        if isinstance(node, ast.ClassDef):
+            cls = node.name
+        if isinstance(node, ast.With):
+            acquired = [
+                qualify(dotted, cls) for _, dotted in _with_locks(node)
+            ]
+            for lock in acquired:
+                for outer in held:
+                    if outer != lock:
+                        edges.append((outer, lock, node.lineno))
+            for stmt in node.body:
+                walk(stmt, held + acquired, cls)
+            return
+        for child in ast.iter_child_nodes(node):
+            walk(child, held, cls)
+
+    walk(sf.tree, [], None)
+    return edges
+
+
+def _find_cycles(edges: List[Tuple[str, str, int]]) -> List[List[str]]:
+    graph: Dict[str, Set[str]] = {}
+    for a, b, _ in edges:
+        graph.setdefault(a, set()).add(b)
+        graph.setdefault(b, set())
+    cycles: List[List[str]] = []
+    seen_cycles: Set[frozenset] = set()
+    for start in sorted(graph):
+        stack = [(start, [start])]
+        while stack:
+            node, path = stack.pop()
+            for nxt in sorted(graph.get(node, ())):
+                if nxt == start:
+                    key = frozenset(path)
+                    if key not in seen_cycles:
+                        seen_cycles.add(key)
+                        cycles.append(path + [start])
+                elif nxt not in path and len(path) < 8:
+                    stack.append((nxt, path + [nxt]))
+    return cycles
+
+
+def lock_order_findings(sf: SourceFile) -> List[Finding]:
+    edges = _order_edges(sf)
+    if not edges:
+        return []
+    findings = []
+    for cycle in _find_cycles(edges):
+        first_edge_line = min(
+            lineno for a, b, lineno in edges
+            if a in cycle and b in cycle
+        )
+        findings.append(Finding(
+            "lock-order", sf.rel, first_edge_line,
+            "lock acquisition order cycle: " + " -> ".join(cycle) +
+            " — two threads taking these locks in opposite orders "
+            "deadlock; pick one global order",
+        ))
+    return findings
+
+
+@register
+class LockOrderRule(Rule):
+    name = "lock-order"
+    doc = ("Within a module, nested `with <lock>:` acquisitions must form "
+           "a DAG — opposite-order acquisition of two locks is a "
+           "deadlock.")
+
+    def check(self, project: Project) -> Iterable[Finding]:
+        for sf in project.files_under("ray_tpu/"):
+            yield from lock_order_findings(sf)
+
+
+# --------------------------------------------------------- blocking-under-lock
+
+_SUBPROCESS_BLOCKING = {"run", "call", "check_call", "check_output", "Popen"}
+
+
+def _blocking_reason(call: ast.Call, from_time_sleep: bool) -> Optional[str]:
+    """Why this call blocks, or None. Heuristics tuned for this tree:
+
+    - time.sleep / bare sleep (when imported from time)
+    - zero-positional-arg .join() — thread/queue join; str.join takes one
+    - .result() / .wait() — future/event waits
+    - .get(timeout=...) / .get(block=...) — queue-style blocking gets
+    - subprocess.run/call/check_call/check_output/Popen
+    - .call(...) on an rpc/client/stub-named receiver (RpcClient.call)
+    - api.get / ray_tpu.get — object-store waits
+    - .recv( / .accept( — socket waits
+    - open() / os.open() — file I/O
+    - pickle/cloudpickle dump(s)/load(s) — unbounded serialization work
+    """
+    func = call.func
+    if isinstance(func, ast.Name):
+        if func.id == "sleep" and from_time_sleep:
+            return "sleep() (time.sleep)"
+        if func.id == "open":
+            return "open() (file I/O)"
+        return None
+    if not isinstance(func, ast.Attribute):
+        return None
+    attr = func.attr
+    recv = _tail_name(func.value)
+    if attr == "sleep" and recv == "time":
+        return "time.sleep()"
+    if recv == "os" and attr == "open":
+        return "os.open() (file I/O)"
+    if recv in ("pickle", "cloudpickle") and attr in (
+        "dump", "dumps", "load", "loads"
+    ):
+        return (f"{recv}.{attr}() (serializing arbitrary object graphs "
+                f"stalls every other holder)")
+    if recv == "subprocess" and attr in _SUBPROCESS_BLOCKING:
+        return f"subprocess.{attr}()"
+    if attr == "join" and not call.args:
+        return ".join() (thread/queue join; str.join takes an argument)"
+    if attr == "result" and not call.args:
+        return ".result() (future wait)"
+    if attr == "wait":
+        return ".wait()"
+    if attr == "get":
+        if recv in ("api", "ray_tpu"):
+            return f"{recv}.get() (object-store wait)"
+        if any(kw.arg in ("timeout", "block") for kw in call.keywords):
+            return ".get(timeout=/block=) (queue-style blocking get)"
+        return None
+    if attr == "call" and recv and re.search(r"rpc|client|stub", recv, re.I):
+        return f"{recv}.call() (synchronous RPC)"
+    if attr in ("recv", "accept") and recv not in ("re", "random"):
+        return f".{attr}() (socket wait)"
+    return None
+
+
+def blocking_under_lock_findings(sf: SourceFile) -> List[Finding]:
+    findings: List[Finding] = []
+    imports_time_sleep = any(
+        isinstance(node, ast.ImportFrom)
+        and node.module == "time"
+        and any(a.name == "sleep" for a in node.names)
+        for node in ast.walk(sf.tree)
+    )
+
+    def walk(node: ast.AST, held: List[str]) -> None:
+        if held and isinstance(node, _NESTED_SCOPE):
+            # a closure/callback body runs later, not under this lock
+            walk(node, [])
+            return
+        if isinstance(node, ast.With):
+            acquired = [tail for tail, _ in _with_locks(node)]
+            for item in node.items:
+                walk(item.context_expr, held)
+            for stmt in node.body:
+                walk(stmt, held + acquired)
+            return
+        if isinstance(node, ast.Call) and held:
+            reason = _blocking_reason(node, imports_time_sleep)
+            if reason is not None:
+                findings.append(Finding(
+                    "blocking-under-lock", sf.rel, node.lineno,
+                    f"blocking call {reason} while holding "
+                    f"{', '.join(sorted(set(held)))} — waits under a "
+                    f"lock serialize every other holder and can "
+                    f"deadlock; move the wait outside the critical "
+                    f"section",
+                ))
+        for child in ast.iter_child_nodes(node):
+            walk(child, held)
+
+    walk(sf.tree, [])
+    return findings
+
+
+@register
+class BlockingUnderLockRule(Rule):
+    name = "blocking-under-lock"
+    doc = ("No sleeps, joins, future/object waits, subprocess invocations "
+           "or synchronous RPCs while holding a lock — the control-plane "
+           "deadlock shape (heartbeat and router paths are the most "
+           "exposed).")
+
+    def check(self, project: Project) -> Iterable[Finding]:
+        for sf in project.files_under("ray_tpu/"):
+            yield from blocking_under_lock_findings(sf)

@@ -404,20 +404,14 @@ def test_serve_request_spans_yield_ttft_tpot():
 
 
 def test_metric_names_static_check():
-    """Tier-1 wiring for scripts/check_metrics_names.py: the package obeys
-    the raytpu_ prefix + no-duplicate-direct-registration rules, and the
-    checker actually catches violations."""
+    """scripts/check_metrics_names.py is now a shim over the raylint
+    metrics-names rule; the repo-wide gate runs ONCE in
+    tests/test_raylint.py. Here: the shim's compat API still flags a
+    bad package, not just passes everything."""
     import pathlib
-    import subprocess
-    import sys as _sys
 
     repo = pathlib.Path(__file__).resolve().parent.parent
     script = repo / "scripts" / "check_metrics_names.py"
-    proc = subprocess.run(
-        [_sys.executable, str(script)], capture_output=True, text=True
-    )
-    assert proc.returncode == 0, proc.stderr
-    # the checker must flag a bad package, not just pass everything
     import importlib.util
 
     spec = importlib.util.spec_from_file_location("cmn", script)
