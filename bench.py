@@ -185,10 +185,29 @@ def _collect_telemetry(step, state, batch, n_steps: int = 5) -> dict:
     }
 
 
+def _goodput_block(acct) -> dict:
+    """The BENCH JSON `goodput` block: bucket seconds + goodput fraction
+    from the same accountant/gauges the train controller publishes
+    (util/goodput) — wall-time attribution rides every round."""
+    report = acct.report()
+    return {
+        "wall_time_s": report["wall_time_s"],
+        "buckets": {
+            b: s for b, s in report["buckets"].items() if s > 0.0
+        },
+        "goodput_s": report["goodput_s"],
+        "goodput_fraction": report["goodput_fraction"],
+    }
+
+
 def main() -> None:
     from ray_tpu.models import count_params, get_config
     from ray_tpu.parallel import MeshSpec, build_mesh
     from ray_tpu.train import create_train_state, default_optimizer, make_train_step
+    from ray_tpu.util.goodput import GoodputAccountant
+
+    acct = GoodputAccountant("bench")
+    acct.begin("init")
 
     # full layer-unroll measured fastest on-chip at this size (+15% over
     # scan: XLA fuses/overlaps across layer boundaries)
@@ -206,16 +225,19 @@ def main() -> None:
         )
     }
 
+    acct.begin("compile")  # warmup = compile + first dispatches
     for _ in range(WARMUP_STEPS):
         state, metrics = step(state, batch)
     float(metrics["loss"])  # value fetch: block_until_ready is unreliable
     # on tunneled-TPU platforms, so sync via an actual device read
 
+    acct.begin("step_compute")
     t0 = time.perf_counter()
     for _ in range(MEASURE_STEPS):
         state, metrics = step(state, batch)
     float(metrics["loss"])
     elapsed = time.perf_counter() - t0
+    acct.finish()
 
     tokens_per_sec = MEASURE_STEPS * BATCH * SEQ / elapsed
     step_time_s = elapsed / MEASURE_STEPS
@@ -273,6 +295,10 @@ def main() -> None:
         dp_sync = _dp_sync_fields(n_params, mesh.shape.get("dp", 1))
     except Exception:  # noqa: BLE001 - the headline number must still print
         dp_sync = {}
+    try:
+        goodput = _goodput_block(acct)
+    except Exception:  # noqa: BLE001 - the headline number must still print
+        goodput = {}
     print(
         json.dumps(
             {
@@ -287,6 +313,7 @@ def main() -> None:
                 "batch": BATCH,
                 "seq": SEQ,
                 "profiling": profiling_block,
+                "goodput": goodput,
                 "telemetry": telemetry,
                 **ring,
                 **attn,

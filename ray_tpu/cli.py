@@ -172,19 +172,66 @@ def _cmd_logs(args) -> int:
     return 0
 
 
+def _fmt_event(e) -> str:
+    ts = time.strftime("%H:%M:%S", time.localtime(e.get("ts", 0)))
+    node = str(e.get("node") or "-")[:8]
+    kind = e.get("kind") or "-"
+    extra = f" {e['extra']}" if e.get("extra") else ""
+    return (f"{ts} {e['severity']:7s} {node:8s} {kind:22s} "
+            f"[{e['source']}] {e['message']}{extra}")
+
+
 def _cmd_events(args) -> int:
+    """The cluster-wide flight-recorder tail (merged + sorted by wall
+    time), filterable by --kind/--node/--severity/--since; --follow
+    keeps polling for new events until interrupted."""
     import ray_tpu
     from .util import state
 
     _observer_init(args)
     time.sleep(1.0)
-    for node_hex, evs in state.cluster_events(limit=args.limit).items():
-        print(f"=== node {node_hex[:12]} ===")
-        for e in evs:
-            extra = f" {e['extra']}" if e.get("extra") else ""
-            print(f"{e.get('ts', 0):.3f} {e['severity']:7s} "
-                  f"[{e['source']}] {e['message']}{extra}")
-        print()
+    filters = dict(kind=args.kind, node=args.node, severity=args.severity)
+    cursor = float(args.since or 0.0)
+    try:
+        while True:
+            evs = state.events(limit=args.limit, since=cursor, **filters)
+            # strictly-after cursor: events() is >=, so skip the boundary
+            evs = [e for e in evs if e.get("ts", 0.0) > cursor or cursor == 0.0]
+            for e in evs:
+                print(_fmt_event(e), flush=True)
+            if evs:
+                cursor = max(e.get("ts", 0.0) for e in evs)
+            if not args.follow:
+                break
+            time.sleep(args.poll)
+    except KeyboardInterrupt:
+        pass
+    ray_tpu.shutdown()
+    return 0
+
+
+def _cmd_postmortem(args) -> int:
+    """Snapshot events + spans + metrics + node stats + profile metas
+    into one bundle archive with a reconstructed Perfetto episode
+    timeline (util/postmortem)."""
+    import ray_tpu
+    from .util import state
+
+    if args.address:
+        _observer_init(args)
+        time.sleep(1.0)  # let the cluster view + event table populate
+    else:
+        ray_tpu.init(detect_accelerators=not args.no_tpu)
+    manifest = state.postmortem(args.output, note=args.note or "")
+    counts = manifest["counts"]
+    print(f"wrote {args.output}: {counts['events']} event(s), "
+          f"{counts['spans']} span(s), {counts['nodes']} node(s), "
+          f"{counts['profiles']} profile meta(s)")
+    for name, meta in sorted(manifest["files"].items()):
+        print(f"  {name}: {meta['bytes']} bytes sha256={meta['sha256'][:12]}")
+    if manifest.get("errors"):
+        print(f"  (degraded planes: {sorted(manifest['errors'])})")
+    print("open the bundle's timeline.json in ui.perfetto.dev")
     ray_tpu.shutdown()
     return 0
 
@@ -366,10 +413,33 @@ def build_parser() -> argparse.ArgumentParser:
     lp.add_argument("--tail", type=int, default=50)
     lp.add_argument("--token", default=None)
 
-    ep = sub.add_parser("events", help="structured cluster events")
+    ep = sub.add_parser("events", help="typed cluster flight-recorder events")
     ep.add_argument("--address", help="head GCS address to join as observer")
     ep.add_argument("--limit", type=int, default=50)
     ep.add_argument("--token", default=None)
+    ep.add_argument("--kind", default=None,
+                    help="only events of this registered kind "
+                         "(e.g. preempt.announced, ckpt.saved)")
+    ep.add_argument("--node", default=None,
+                    help="only events attributed to this node id hex prefix")
+    ep.add_argument("--severity", default=None,
+                    help="only events at this severity (case-insensitive)")
+    ep.add_argument("--since", type=float, default=None,
+                    help="only events with wall ts >= this epoch-seconds value")
+    ep.add_argument("--follow", "-f", action="store_true",
+                    help="keep polling and printing new events (ctrl-c stops)")
+    ep.add_argument("--poll", type=float, default=1.0,
+                    help="poll interval for --follow, seconds")
+
+    pm = sub.add_parser(
+        "postmortem", help="snapshot a causal postmortem bundle (.tgz)"
+    )
+    pm.add_argument("--output", default="postmortem.tgz",
+                    help="bundle archive path")
+    pm.add_argument("--note", default=None,
+                    help="free-text note recorded in the bundle manifest")
+    pm.add_argument("--address", help="head GCS address to join as observer")
+    pm.add_argument("--token", default=None)
 
     tp = sub.add_parser("timeline", help="dump a chrome-trace of this session")
     tp.add_argument("output", nargs="?", default="timeline.json")
@@ -418,6 +488,7 @@ def main(argv=None) -> int:
         "down": _cmd_down,
         "logs": _cmd_logs,
         "events": _cmd_events,
+        "postmortem": _cmd_postmortem,
         "timeline": _cmd_timeline,
         "profile": _cmd_profile,
         "dashboard": _cmd_dashboard,

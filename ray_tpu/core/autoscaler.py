@@ -266,7 +266,9 @@ class Autoscaler:
                     from ..util.events import emit
 
                     emit("INFO", "autoscaler",
-                         f"terminated idle node {node.node_id.hex()[:12]}")
+                         f"terminated idle node {node.node_id.hex()[:12]}",
+                         kind="autoscaler.scaled",
+                         node=node.node_id.hex(), direction="down")
                     self.provider.terminate_node(node)
                     node_type = node.labels.get("node_type")
                     if node_type in self._per_type_count:

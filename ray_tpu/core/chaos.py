@@ -181,6 +181,19 @@ def maybe_inject(task_name: str, node=None) -> None:
         ):
             _state.injected += 1
             fail_ordinal = _state.injected
+    if preempt or kill or delay > 0 or fail_ordinal:
+        # Flight-recorder breadcrumb BEFORE the perturbation lands: the
+        # postmortem timeline must show the injection even when the
+        # injection is os._exit.
+        mode = ("preempt_node" if preempt else "kill_node" if kill
+                else "delay" if delay > 0 else "failure")
+        node_id = getattr(node, "node_id", None)
+        from ..util.events import emit
+
+        emit("WARNING", "chaos",
+             f"chaos injected {mode} via task {task_name!r}",
+             kind="chaos.injected", mode=mode,
+             node=node_id.hex() if node_id is not None else None)
     if preempt:
         # Announced death: the hook drains the task's node for the
         # warning window (pubsub-announced) and kills it afterwards. The

@@ -76,7 +76,7 @@ from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
 from .exceptions import ActorDiedError
-from .gcs import PREEMPT_CHANNEL
+from .gcs import EVENT_NS, PREEMPT_CHANNEL
 from .gcs_service import PG_NS, GcsClient
 from .ids import ActorID, NodeID, ObjectID
 from .object_transfer import ObjectTransferServer, fetch_object, push_object
@@ -568,6 +568,9 @@ class ClusterContext:
         # piggyback can republish without a read-modify-write race)
         self._info: Dict[str, Any] = {}  # guarded-by: _lock
         self._last_stats_ts = 0.0
+        # flight-recorder federation cursor: last local event seq shipped
+        # into the GCS _events table (watch-loop thread only)
+        self._events_cursor = 0
 
         store.set_cluster_hooks(
             fetch_remote=self._fetch_remote,
@@ -676,6 +679,31 @@ class ClusterContext:
             self._info["stats"] = snap
             info = dict(self._info)
         self.gcs.kv_put(self.node_id.hex(), info, namespace=NODE_NS)
+        self._federate_events()
+
+    def _federate_events(self) -> None:
+        """Ship this node's new flight-recorder events into the GCS
+        `_events` table (same cadence + failure envelope as the stats
+        piggyback). Each node owns its key, so the read-modify-write is
+        single-writer; the cursor walks oldest-first and never skips —
+        a burst just drains over several periods."""
+        from ..util.events import events
+        from .config import cfg
+
+        batch = events().since(self._events_cursor,
+                               max_n=cfg.events_federate_batch)
+        if not batch:
+            return
+        my_hex = self.node_id.hex()
+        tail = self.gcs.kv_get(my_hex, namespace=EVENT_NS) or []
+        tail.extend(
+            e if e.get("node") else dict(e, node=my_hex) for e in batch
+        )
+        cap = cfg.events_table_cap
+        if len(tail) > cap:
+            del tail[: len(tail) - cap]
+        self.gcs.kv_put(my_hex, tail, namespace=EVENT_NS)
+        self._events_cursor = batch[-1]["seq"]
 
     def _watch_loop(self) -> None:
         from .config import cfg
@@ -732,6 +760,7 @@ class ClusterContext:
             emit("INFO", "cluster",
                  f"node {node_hex[:12]} "
                  f"{'rediscovered' if known is not None else 'discovered'}",
+                 kind="node.discovered", node=node_hex,
                  address=info["address"])
             logger.info("%s cluster node %s at %s",
                         "rediscovered" if known is not None else "discovered",
@@ -754,7 +783,8 @@ class ClusterContext:
             return
         from ..util.events import emit
 
-        emit("WARNING", "cluster", f"node {node_hex[:12]} died", reason=reason)
+        emit("WARNING", "cluster", f"node {node_hex[:12]} died",
+             kind="node.dead", node=node_hex, reason=reason)
         logger.warning("cluster node %s died (%s)", node_hex[:12], reason)
         self.runtime.scheduler.remove_node(node.node_id)
         self.gcs.kv_delete(node_hex, namespace=NODE_NS)
@@ -871,7 +901,8 @@ class ClusterContext:
         emit("WARNING", "cluster",
              f"node {self.node_id.hex()[:12]} preempting: {reason} "
              f"({warning_s:.1f}s warning, fate={fate})",
-             deadline=deadline)
+             kind="preempt.announced", node=self.node_id.hex(),
+             deadline=deadline, warning_s=warning_s)
         logger.warning("preemption notice (%s): %s warning %.1fs",
                        fate, reason, warning_s)
 
@@ -1672,7 +1703,8 @@ class ClusterContext:
 
         emit("WARNING", "actors",
              f"actor {proxy.display_name} restarted on node "
-             f"{node.node_id.hex()[:12]}", reason=why)
+             f"{node.node_id.hex()[:12]}", kind="actor.restart",
+             node=node.node_id.hex(), reason=why)
         logger.warning(
             "actor %s restarted on node %s (%s)",
             proxy.display_name, node.node_id.hex()[:12], why,
@@ -2267,7 +2299,8 @@ class ClusterContext:
         from ..util.events import emit
 
         emit("WARNING", "cluster",
-             f"parked undeliverable completion of task {task_hex[:12]}")
+             f"parked undeliverable completion of task {task_hex[:12]}",
+             kind="task.parked")
         logger.warning(
             "parked undeliverable completion of task %s (owner unreachable); "
             "the owner's poll loop can reclaim it for %.0fs",

@@ -209,6 +209,25 @@ define_flag("serve_slo_check_period_s", 5.0,
             "Interval between serve SLO monitor evaluations of the PR-2 "
             "latency histograms.")
 
+# flight recorder (durable events + federation + goodput accounting)
+define_flag("events_dir", "",
+            "Directory for durable per-node event-log segments; each "
+            "node writes bounded JSONL under <dir>/<node-prefix>/ "
+            "('' = in-memory ring only).")
+define_flag("events_segment_bytes", 1 << 20,
+            "Rotate a node's current event segment file once it exceeds "
+            "this many bytes (atomic rename into a numbered segment).")
+define_flag("events_segments_keep", 8,
+            "Rotated event segments retained per node before the oldest "
+            "is pruned.")
+define_flag("events_federate_batch", 256,
+            "Max events a node ships into the GCS _events table per "
+            "stats-piggyback period (the cursor never skips; a burst "
+            "just takes more periods to drain).")
+define_flag("events_table_cap", 2000,
+            "Per-node cap on events retained in the GCS _events table "
+            "(the cluster-wide queryable tail).")
+
 # profiling plane (coordinated capture + cost accounting)
 define_flag("profile_default_duration_s", 2.0,
             "Default capture window for `ray_tpu profile` / "

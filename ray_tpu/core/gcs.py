@@ -30,6 +30,13 @@ PREEMPT_CHANNEL = "node_preemption"
 # driver's ProfileStore; the record names them per node).
 PROFILE_NS = "_profiles"
 
+# GCS KV namespace for the federated flight-recorder event table:
+# node_hex -> bounded list of that node's recent typed events, shipped
+# incrementally on the stats-piggyback path (core/cluster.py). This is
+# the durable cluster-wide tail `state.events()` / `ray_tpu events` /
+# `ray_tpu postmortem` read back.
+EVENT_NS = "_events"
+
 
 class KVStore:
     """Namespaced key-value store (reference: gcs_kv_manager.h)."""
@@ -107,6 +114,7 @@ class PubSub:
                     emit("WARNING", "gcs",
                          f"pubsub subscriber on channel {channel!r} raised; "
                          f"further failures suppressed: {exc!r}",
+                         kind="gcs.subscriber_error",
                          channel=channel, callback=repr(cb))
                 logger.warning("pubsub subscriber on %r failed: %r", channel, exc)
 

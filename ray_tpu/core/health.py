@@ -106,7 +106,8 @@ class HealthCheckManager:
                 "Targets (process actors, nodes) declared dead by the "
                 "health-check manager.",
             ).inc()
-            emit("WARNING", "health", f"{target_id} declared dead")
+            emit("WARNING", "health", f"{target_id} declared dead",
+                 kind="health.dead")
             logger.warning("health check: %s declared dead", target_id)
             try:
                 on_dead(target_id)
@@ -224,7 +225,7 @@ class MemoryMonitor:
 
         emit("ERROR", "health",
              f"OOM policy killed worker {victim.pid}",
-             usage=round(usage, 3), policy=self.policy)
+             kind="health.oom", usage=round(usage, 3), policy=self.policy)
         logger.warning(
             "memory usage %.0f%% >= %.0f%%: killing worker %d (%s policy); "
             "its task will retry if retriable",

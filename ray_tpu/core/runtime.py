@@ -193,6 +193,17 @@ class Runtime:
 
         _logs.install()
         _logs.set_node_id(self.scheduler.head_node().node_id.hex())
+        # flight recorder: durable bounded event segments for this node
+        # (cfg.events_dir; the in-memory ring always runs)
+        if cfg.events_dir:
+            import os as _os
+
+            from ..util.events import events as _events
+
+            _events().configure_segments(_os.path.join(
+                cfg.events_dir,
+                self.scheduler.head_node().node_id.hex()[:12],
+            ))
         # telemetry plane: per-node stats sampling + node-local gauges
         # (core/stats.py); the cluster heartbeat piggybacks snapshots
         # into the GCS node table and /metrics federates head-side
@@ -273,7 +284,8 @@ class Runtime:
             return
         from ..util.events import emit
 
-        emit("INFO", "gcs", f"restored GCS snapshot from {path}")
+        emit("INFO", "gcs", f"restored GCS snapshot from {path}",
+             kind="gcs.restored")
         for info in extra.get("jobs", ()):  # job records survive restarts
             if info.status in (JobStatus.PENDING, JobStatus.RUNNING):
                 # the driver process died with the old control plane
@@ -933,6 +945,13 @@ class Runtime:
         )
         if marked is None or not node.alive:
             return  # unknown or already gone
+        from ..util.events import emit
+
+        emit("WARNING", "cluster",
+             f"node {node.node_id.hex()[:12]} preempting: {reason} "
+             f"({warning_s:.1f}s warning)",
+             kind="preempt.announced", node=node.node_id.hex(),
+             deadline=deadline, warning_s=warning_s)
         self.gcs.pubsub.publish(PREEMPT_CHANNEL, {
             "node_hex": node.node_id.hex(),
             "reason": reason,
@@ -958,7 +977,8 @@ class Runtime:
         node_hex = node.node_id.hex()
         emit("WARNING", "cluster",
              f"preempted node {node_hex[:12]} died after its warning "
-             f"window", reason=reason)
+             f"window", kind="node.preempt_expired", node=node_hex,
+             reason=reason)
         self.scheduler.remove_node(node.node_id)
         with self._lock:
             doomed = [

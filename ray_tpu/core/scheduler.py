@@ -447,7 +447,8 @@ class ClusterScheduler:
 
         emit("WARNING", "cluster",
              f"node {node_hex[:12]} PREEMPTING: new placements stop "
-             f"({reason})", node=node_hex, deadline=deadline)
+             f"({reason})", kind="preempt.drain", node=node_hex,
+             deadline=deadline)
         get_or_create_counter(
             "raytpu_node_preemptions_total",
             "Nodes that entered the PREEMPTING/draining state.",
@@ -734,7 +735,7 @@ class ClusterScheduler:
         emit(severity, "placement_groups",
              f"placement group {pg.id.hex()[:12]} -> {state}"
              + (f" ({reason})" if reason else ""),
-             pg=pg.id.hex(), state=state, **extra)
+             kind="pg.transition", pg=pg.id.hex(), state=state, **extra)
         get_or_create_counter(
             "raytpu_pg_state_transitions_total",
             "Placement-group FSM transitions by target state.",
@@ -822,7 +823,7 @@ class ClusterScheduler:
                 emit("WARNING", "placement_groups",
                      f"placement group {pg.id.hex()[:12]} reschedule "
                      f"attempt {attempt} failed: {err}",
-                     pg=pg.id.hex())
+                     kind="pg.reschedule_failed", pg=pg.id.hex())
                 logger.warning("PG %s reschedule attempt %d failed: %s",
                                pg.id.hex()[:12], attempt, err)
                 if pg.reschedules_used >= budget:

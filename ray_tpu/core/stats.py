@@ -149,6 +149,18 @@ class NodeStatsCollector:
         except Exception:  # noqa: BLE001 - degraded snapshot over a raise
             return {}
 
+    @staticmethod
+    def _sample_events() -> Dict[str, Any]:
+        """Flight-recorder health: emitted count + ring occupancy +
+        durable-segment state (util/events) — rides the heartbeat so
+        the head can see a node whose event plane went quiet."""
+        from ..util.events import events
+
+        try:
+            return events().stats()
+        except Exception:  # noqa: BLE001 - degraded snapshot over a raise
+            return {}
+
     def snapshot(self) -> Dict[str, Any]:
         """One telemetry snapshot of this node. Keys are stable: the GCS
         node table, `state.summary()["node_stats"]`, and `ray_tpu
@@ -173,6 +185,7 @@ class NodeStatsCollector:
             # heartbeat-piggybacked snapshot (util/profiling keeps jax
             # imports function-local, so this costs nothing on observers)
             "profiling": self._sample_profiling(),
+            "events": self._sample_events(),
         }
         if cluster is not None:
             snap["agent"] = dict(cluster.agent_stats)

@@ -263,9 +263,23 @@ class _Handler(BaseHTTPRequestHandler):
                 raise ValueError("profile endpoint needs ?id=<profile_id>")
             return state.get_profile(query["id"])
         if name == "events":
-            return state.list_events()
+            # the merged cluster-wide flight-recorder tail (filterable
+            # like `ray_tpu events`: ?kind=&node=&severity=&since=)
+            return state.events(
+                limit=int(query.get("limit", 200)),
+                kind=query.get("kind"),
+                node=query.get("node"),
+                severity=query.get("severity"),
+                since=float(query.get("since", 0.0)),
+            )
         if name == "cluster_events":
             return state.cluster_events()
+        if name == "goodput":
+            # serve-side SLO attainment + any train goodput gauges land
+            # in /metrics; this endpoint serves the serve ledger
+            from .util.goodput import serve_slo_report
+
+            return serve_slo_report()
         if name == "logs":
             # the UI shows ~12 lines/node; don't ship 200 per refresh
             return state.cluster_logs(tail=20)

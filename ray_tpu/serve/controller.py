@@ -168,6 +168,13 @@ class ServeController:
         with self._lock:
             state = _DeploymentState(dep, app, source_app=source_app)
             self._states[dep.name] = state
+        from ..util.events import emit
+
+        emit("INFO", "serve",
+             f"deployment {dep.name} deployed "
+             f"(target {state.target_replicas} replica(s))",
+             kind="serve.deploy", deployment=dep.name,
+             target_replicas=state.target_replicas)
         self._reconcile_one(state)  # synchronous first bring-up
         self._ensure_thread()
         return DeploymentHandle(state.replica_set)
@@ -277,6 +284,13 @@ class ServeController:
             victim,
             time.monotonic() + state.deployment.config.drain_timeout_s,
         )
+        from ..util.events import emit
+
+        emit("INFO", "serve",
+             f"deployment {state.deployment.name}: replica {key[:12]} "
+             f"draining", kind="serve.drain",
+             deployment=state.deployment.name, replica=key,
+             ongoing=state.replica_set.ongoing_for(key))
         try:
             victim.prepare_drain.remote()  # best-effort flag on the actor
         except Exception:
@@ -326,6 +340,7 @@ class ServeController:
                 state.forget(key)
         state.replicas = live
         # scale up
+        started = 0
         while len(state.replicas) < state.target_replicas:
             actor_cls = api.remote(_ReplicaWrapper).options(
                 max_concurrency=dep.config.max_ongoing_requests,
@@ -336,16 +351,37 @@ class ServeController:
             replica = actor_cls.remote(dep.cls, state.app.init_args, state.app.init_kwargs)
             state.started_at[_rkey(replica)] = time.monotonic()
             state.replicas.append(replica)
+            started += 1
+        if started:
+            from ..util.events import emit
+
+            emit("INFO", "serve",
+                 f"deployment {dep.name}: +{started} replica(s) "
+                 f"(target {state.target_replicas})",
+                 kind="serve.scaled", deployment=dep.name,
+                 direction="up", delta=started,
+                 target_replicas=state.target_replicas)
         # scale down (newest first): drain, don't guillotine — READY
         # replicas may be mid-request; unready ones die immediately
+        scaled_down = 0
         while len(state.replicas) > state.target_replicas:
             victim = state.replicas.pop()
             key = _rkey(victim)
+            scaled_down += 1
             if key in state.ready_at and dep.config.drain_timeout_s > 0:
                 self._begin_drain(state, victim)
             else:
                 _kill_quietly(victim)
                 state.forget(key)
+        if scaled_down:
+            from ..util.events import emit
+
+            emit("INFO", "serve",
+                 f"deployment {dep.name}: -{scaled_down} replica(s) "
+                 f"(target {state.target_replicas})",
+                 kind="serve.scaled", deployment=dep.name,
+                 direction="down", delta=scaled_down,
+                 target_replicas=state.target_replicas)
         self._reap_draining(state)
         # route only to READY replicas so requests never queue behind a
         # replica's __init__; fall back to all replicas during initial

@@ -171,7 +171,7 @@ class CheckpointManager:
 
             emit("WARNING", "train",
                  f"GC'd uncommitted checkpoint step dir {name} "
-                 f"(torn save)", directory=self.directory)
+                 f"(torn save)", kind="ckpt.gc", directory=self.directory)
             shutil.rmtree(step_dir, ignore_errors=True)
             removed += 1
         return removed
@@ -200,6 +200,7 @@ class CheckpointManager:
             target = "(removed)"
         emit("WARNING", "train",
              f"quarantined corrupt checkpoint step {step}: {reason}",
+             kind="ckpt.quarantine",
              directory=self.directory, step=step, quarantined_to=target)
         get_or_create_counter(
             "raytpu_train_ckpt_fallback_total",

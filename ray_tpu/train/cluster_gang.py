@@ -210,7 +210,7 @@ class ClusterWorkerGroup:
 
         emit("INFO", "train",
              f"gang {self.run_name}: coordinator elected at "
-             f"{self._coordinator}",
+             f"{self._coordinator}", kind="train.coordinator",
              bundle0=(
                  node0.node_id.hex() if node0 is not None else None
              ))

@@ -51,6 +51,10 @@ class Result:
     # mfu, step_flops, roofline fractions — None when the train_fn never
     # reported them (custom loops without LMTrainer.profiling_metrics)
     profiling: Optional[Dict[str, Any]] = None
+    # wall-time attribution of the run (util/goodput): bucket seconds
+    # summing to wall time, goodput fraction — the same numbers the
+    # raytpu_train_goodput_seconds gauges and the BENCH block carry
+    goodput: Optional[Dict[str, Any]] = None
 
 
 class _PreemptRestart:
@@ -118,6 +122,10 @@ class TrainController:
         # newest cost-analysis accounting drained from rank-0 reports
         # (published as gauges by the poll loop; lands in Result.profiling)
         self.last_profiling: Optional[Dict[str, Any]] = None
+        # wall-time goodput partition of the CURRENT run (util/goodput);
+        # created by run(), transitioned by the poll loop, read by tests
+        self.goodput = None
+        self._attempt_reported = False
 
     def decide_num_workers(self) -> int:
         """Elastic sizing (reference v2 ScalingPolicy): fit the gang to
@@ -145,12 +153,16 @@ class TrainController:
         # each attempt in the XLA device trace (util/profiling) so host
         # phases line up with HLO activity.
         from ..util import tracing
+        from ..util.goodput import GoodputAccountant
 
+        self.goodput = GoodputAccountant(self.run_config.name)
+        self.goodput.begin("init")
         unsubscribe = self._subscribe_preemption()
         try:
             with tracing.span("train.run", run=self.run_config.name) as run_span:
                 result = self._run_traced(run_span)
         finally:
+            self.goodput.finish()
             unsubscribe()
         return result
 
@@ -240,7 +252,12 @@ class TrainController:
                     self.status = RunStatus.RUNNING
                     emit("INFO", "train",
                          f"run {self.run_config.name}: gang of {num_workers} "
-                         f"running (attempt {self.num_restarts + 1})")
+                         f"running (attempt {self.num_restarts + 1})",
+                         kind="train.gang_started", run=self.run_config.name,
+                         attempt=self.num_restarts
+                         + self.num_preempt_restarts + 1,
+                         workers=num_workers,
+                         resume_from_step=self.latest_checkpoint_step)
                     outcome = self._poll_until_done(group)
                 if outcome is None:  # clean finish
                     attempt_span.end(
@@ -250,7 +267,8 @@ class TrainController:
                     emit("INFO", "train",
                          f"run {self.run_config.name} finished "
                          f"({self.num_restarts} restart(s), "
-                         f"{self.num_preempt_restarts} preemption(s))")
+                         f"{self.num_preempt_restarts} preemption(s))",
+                         kind="train.finished", run=self.run_config.name)
                     return self._result(None)
                 if isinstance(outcome, _PreemptRestart):
                     preempt = outcome
@@ -278,7 +296,8 @@ class TrainController:
                     )
                     self.status = RunStatus.ERRORED
                     emit("ERROR", "train",
-                         f"run {self.run_config.name}: {error}")
+                         f"run {self.run_config.name}: {error}",
+                         kind="train.errored", run=self.run_config.name)
                     return self._result(error)
                 self._begin_preempt_restart(preempt, run_span)
                 continue
@@ -286,10 +305,13 @@ class TrainController:
             if policy.should_restart():
                 self.status = RunStatus.RESTARTING
                 self.num_restarts += 1
+                self.goodput.begin("ckpt_restore")
                 emit("WARNING", "train",
                      f"run {self.run_config.name} restarting from "
                      f"checkpoint step {self.latest_checkpoint_step} "
-                     f"(restart {self.num_restarts}): {error}")
+                     f"(restart {self.num_restarts}): {error}",
+                     kind="train.restart", run=self.run_config.name,
+                     restart=self.num_restarts)
                 # the train_fn is responsible for resuming from
                 # latest_checkpoint_step (passed through train_config)
                 with tracing.span("train.restore", parent=run_span.context,
@@ -304,7 +326,8 @@ class TrainController:
             self.status = RunStatus.ERRORED
             emit("ERROR", "train",
                  f"run {self.run_config.name} errored after "
-                 f"{self.num_restarts} restart(s): {error}")
+                 f"{self.num_restarts} restart(s): {error}",
+                 kind="train.errored", run=self.run_config.name)
             return self._result(error)
 
     def _set_resume_step(self) -> None:
@@ -330,6 +353,7 @@ class TrainController:
 
         self.status = RunStatus.RESTARTING
         self.num_preempt_restarts += 1
+        self.goodput.begin("preempt_restart")
         get_or_create_counter(
             "raytpu_train_preempt_restarts_total",
             "Gang restarts triggered by announced node preemption "
@@ -341,6 +365,10 @@ class TrainController:
              f"(emergency checkpoint "
              f"{'taken' if preempt.checkpointed else 'NOT taken'}, resume "
              f"step {self.latest_checkpoint_step}; failure budget untouched)",
+             kind="train.preempt_restart", run=self.run_config.name,
+             preempted_node=preempt.notice.get("node_hex"),
+             emergency_checkpoint=preempt.checkpointed,
+             resume_from_step=self.latest_checkpoint_step,
              preempt_restarts=self.num_preempt_restarts)
         with tracing.span("train.restore", parent=run_span.context,
                           lane=f"train:{self.run_config.name}",
@@ -367,6 +395,7 @@ class TrainController:
         self.stall_watchdog = StallWatchdog(
             self.run_config.name, group.num_workers
         )
+        self._attempt_reported = False
         try:
             return self._poll_cycle(
                 group, result_refs, cursors, notice, baseline_ckpt,
@@ -382,13 +411,19 @@ class TrainController:
                 notice = self._next_preempt_notice(group)
                 if notice is not None:
                     baseline_ckpt = self.latest_checkpoint_step
+                    # the window between the notice and the restart is
+                    # checkpoint traffic, not training
+                    self.goodput.begin("ckpt_save")
                     from ..util.events import emit
 
                     emit("WARNING", "train",
                          f"run {self.run_config.name}: preemption notice "
                          f"for node {notice.get('node_hex', '?')[:12]} — "
                          f"requesting emergency checkpoint "
-                         f"(window {notice.get('warning_s', 0):.1f}s)")
+                         f"(window {notice.get('warning_s', 0):.1f}s)",
+                         kind="preempt.notice", run=self.run_config.name,
+                         preempted_node=notice.get("node_hex"),
+                         warning_s=notice.get("warning_s", 0))
             try:
                 if notice is not None and flags_supported:
                     try:
@@ -414,8 +449,15 @@ class TrainController:
                 for metrics, ckpt_step, rank, ts in p["reports"]:
                     cursors[i] += 1
                     self.stall_watchdog.observe_report(rank, ts)
+                    if not self._attempt_reported:
+                        # first report of the attempt: bring-up is over
+                        # (unless a preemption window is already open)
+                        self._attempt_reported = True
+                        if notice is None:
+                            self.goodput.begin("step_compute")
                     if rank == 0:
                         self.metrics_history.append(metrics)
+                        self.goodput.observe_report_metrics(metrics)
                         if isinstance(metrics, dict) and "mfu" in metrics:
                             self._publish_profiling(metrics)
                     if ckpt_step is not None:
@@ -424,9 +466,10 @@ class TrainController:
                             ckpt_step if prev is None else max(prev, ckpt_step)
                         )
                         if prev is None or ckpt_step > prev:
-                            # instant span: checkpoint progress on the
-                            # run's waterfall
+                            # instant span + flight-recorder event:
+                            # checkpoint progress on the run's waterfall
                             from ..util import tracing
+                            from ..util.events import emit
 
                             now = time.time()
                             tracing.tracer().record_span(
@@ -435,6 +478,14 @@ class TrainController:
                                 attrs={"run": self.run_config.name,
                                        "step": ckpt_step, "rank": rank},
                             )
+                            emit("INFO", "train",
+                                 f"run {self.run_config.name}: checkpoint "
+                                 f"step {ckpt_step}"
+                                 + (" (emergency)" if notice is not None
+                                    else ""),
+                                 kind="ckpt.saved",
+                                 run=self.run_config.name, step=ckpt_step,
+                                 rank=rank, emergency=notice is not None)
                 if p["done"]:
                     # finished workers are not stragglers: silence from
                     # them must not trip the stall watchdog
@@ -463,6 +514,14 @@ class TrainController:
                     return repr(e)
                 return None
             self.stall_watchdog.check()
+            # stall time is badput: swap the partition with the watchdog
+            # verdict (only across the compute<->stall edge so a
+            # preemption window's ckpt_save bucket is never clobbered)
+            if self.stall_watchdog.stalled:
+                if self.goodput.current == "step_compute":
+                    self.goodput.begin("stall")
+            elif self.goodput.current == "stall":
+                self.goodput.begin("step_compute")
             time.sleep(self.poll_interval)
 
     def _publish_profiling(self, metrics: Dict[str, Any]) -> None:
@@ -514,6 +573,7 @@ class TrainController:
         return latest is not None and (baseline is None or latest > baseline)
 
     def _result(self, error: Optional[str]) -> Result:
+        self.goodput.finish()
         return Result(
             metrics=self.metrics_history[-1] if self.metrics_history else {},
             metrics_history=list(self.metrics_history),
@@ -523,4 +583,5 @@ class TrainController:
             num_restarts=self.num_restarts,
             num_preempt_restarts=self.num_preempt_restarts,
             profiling=self.last_profiling,
+            goodput=self.goodput.report(),
         )

@@ -342,7 +342,7 @@ def quarantine_checkpoint(trial_dir: str, name: str, reason: str) -> None:
             pass
     emit("WARNING", "train",
          f"quarantined corrupt checkpoint {name}: {reason}",
-         trial_dir=trial_dir, checkpoint=name)
+         kind="ckpt.quarantine", trial_dir=trial_dir, checkpoint=name)
     get_or_create_counter(
         "raytpu_train_ckpt_fallback_total",
         "Checkpoint restores that fell back past a corrupt/torn "

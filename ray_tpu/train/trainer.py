@@ -175,7 +175,19 @@ class LMTrainer:
         last_metrics: Dict[str, Any] = {}
         steps = 0
         window_t0, window_steps = t0, 0
-        for batch in batches:
+        # per-window phase seconds: the goodput accountant (util/goodput)
+        # re-attributes these out of the step_compute bucket when the
+        # report reaches the controller
+        window_input_wait = 0.0
+        window_ckpt_save = 0.0
+        batch_iter = iter(batches)
+        while True:
+            t_in = time.perf_counter()
+            try:
+                batch = next(batch_iter)  # input pipeline wait happens HERE
+            except StopIteration:
+                break
+            window_input_wait += time.perf_counter() - t_in
             if num_steps is not None and steps >= num_steps:
                 break
             tokens = batch["tokens"]
@@ -191,6 +203,9 @@ class LMTrainer:
                 elapsed = now - t0
                 metrics["tokens_per_sec"] = tokens_done / max(elapsed, 1e-9)
                 metrics["step"] = int(self.state.step)
+                metrics["input_wait_s"] = round(window_input_wait, 6)
+                metrics["ckpt_save_s"] = round(window_ckpt_save, 6)
+                window_input_wait = window_ckpt_save = 0.0
                 # MFU/roofline from the compiled step's cost_analysis()
                 # over this window's measured step time (the first window
                 # absorbs the compile, so its MFU reads low)
@@ -201,7 +216,9 @@ class LMTrainer:
                 last_metrics = metrics
                 report_fn(metrics)
             if ckpt_every and steps % ckpt_every == 0 and self.ckpt_mgr is not None:
+                t_ck = time.perf_counter()
                 self.save_checkpoint()
+                window_ckpt_save += time.perf_counter() - t_ck
         if self.ckpt_mgr is not None and self.ckpt_config.checkpoint_every:
             self.save_checkpoint()
             self.ckpt_mgr.wait_until_finished()

@@ -1,4 +1,5 @@
-"""ray_tpu.util — observability (metrics, state API, task timeline)."""
+"""ray_tpu.util — observability (metrics, state API, flight recorder,
+goodput accounting, task timeline)."""
 
 from .metrics import (  # noqa: F401
     Counter,
@@ -31,7 +32,15 @@ from .state import (  # noqa: F401
     summary,
     trace_dump,
 )
-from . import tracing, watchdog  # noqa: F401
+from . import goodput, postmortem, tracing, watchdog  # noqa: F401
+from .events import (  # noqa: F401
+    EVENT_KINDS,
+    EventLog,
+    event_kinds,
+    register_event_kind,
+)
+from .goodput import GoodputAccountant, serve_slo_report  # noqa: F401
+from .postmortem import build_bundle, load_bundle  # noqa: F401
 from .actor_pool import ActorPool  # noqa: F401
 from .profiling import (  # noqa: F401
     ProfilingError,

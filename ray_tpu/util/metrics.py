@@ -91,7 +91,7 @@ class Gauge(_Metric):
                     emit("WARNING", "metrics",
                          f"callback gauge {self.name} sampler raised; "
                          f"series suppressed until it recovers: {exc!r}",
-                         metric=self.name)
+                         kind="metrics.sampler_error", metric=self.name)
                 return []
             # A callback may honor tag_keys by returning tagged samples:
             # an iterable of (tags_dict, value) pairs. A bare number stays

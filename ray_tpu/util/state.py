@@ -444,9 +444,67 @@ def chrome_tracing_dump(path: Optional[str] = None) -> str:
 def list_events(limit: int = 500, severity: Optional[str] = None,
                 source: Optional[str] = None) -> List[Dict[str, Any]]:
     """Structured runtime events of THIS process (util/events.py)."""
-    from .events import events
+    from .events import events as _events
 
-    return events().list(limit=limit, severity=severity, source=source)
+    return _events().list(limit=limit, severity=severity, source=source)
+
+
+def events(limit: int = 1000, *, kind: Optional[str] = None,
+           node: Optional[str] = None, since: float = 0.0,
+           severity: Optional[str] = None,
+           source: Optional[str] = None) -> List[Dict[str, Any]]:
+    """The cluster-wide flight-recorder tail, sorted by wall time: this
+    process's event ring merged with every node's federated tail from
+    the GCS `_events` table (core/cluster.py ships them on the stats
+    piggyback). Filters: `kind` (registered event kind), `node` (id hex
+    prefix), `since` (wall ts), `severity` (case-insensitive), `source`.
+    Deduped by (node, seq) — the head's own events appear both locally
+    and in the table."""
+    from .events import events as _events
+    from .events import normalize_severity
+
+    merged: Dict[Any, Dict[str, Any]] = {}
+    for e in _events().list(limit=10_000):
+        merged[(e.get("node"), e["seq"])] = e
+    if _rt.is_initialized():
+        from ..core.gcs import EVENT_NS
+
+        runtime = _rt.get_runtime()
+        ctx = getattr(runtime, "cluster", None)
+        try:
+            if ctx is not None:
+                for key in ctx.gcs.kv_keys(namespace=EVENT_NS):
+                    for e in ctx.gcs.kv_get(key, namespace=EVENT_NS) or []:
+                        merged.setdefault((e.get("node"), e.get("seq")), e)
+            else:
+                kv = runtime.gcs.kv
+                for key in kv.keys(namespace=EVENT_NS):
+                    for e in kv.get(key, namespace=EVENT_NS) or []:
+                        merged.setdefault((e.get("node"), e.get("seq")), e)
+        except Exception:  # noqa: BLE001 - the local ring still answers
+            pass
+    sev = normalize_severity(severity) if severity is not None else None
+    out = [
+        e for e in merged.values()
+        if e.get("ts", 0.0) >= since
+        and (kind is None or e.get("kind") == kind)
+        and (node is None or str(e.get("node") or "").startswith(node))
+        and (sev is None or e.get("severity") == sev)
+        and (source is None or e.get("source") == source)
+    ]
+    out.sort(key=lambda e: (e.get("ts", 0.0), e.get("seq", 0)))
+    return out[-limit:] if limit else out
+
+
+def postmortem(output: str, note: str = "") -> Dict[str, Any]:
+    """Snapshot the cluster's observability planes — events, span
+    buffers, /metrics/cluster, node stats, profile metas — into one
+    postmortem bundle archive at `output`, including the reconstructed
+    wall-clock-aligned Perfetto timeline. Returns the bundle manifest.
+    The CLI command `ray_tpu postmortem` is a thin wrapper."""
+    from .postmortem import build_bundle
+
+    return build_bundle(output, note=note)
 
 
 def cluster_events(limit: int = 500) -> Dict[str, List[Dict[str, Any]]]:

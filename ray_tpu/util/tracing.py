@@ -426,8 +426,42 @@ def export_chrome_trace(spans: List[Dict[str, Any]],
     device trace's per-device tracks (util/profiling
     load_device_trace_events, already wall-clock aligned) into the same
     file, so one timeline shows what the runtime asked for AND what the
-    chip did."""
+    chip did.
+
+    Parent→child links that CROSS a lane (a remote task's execute span
+    parenting back to the driver's submit span, a router hop landing on
+    a replica) additionally emit chrome flow events (ph "s"/"f") so the
+    cross-node causality renders as arrows between tracks, not just
+    vertically stacked slices."""
     events: List[Dict[str, Any]] = list(extra_events or [])
+    by_id = {s["span_id"]: s for s in spans}
+
+    def _pid(s: Dict[str, Any]) -> str:
+        return s.get("lane") or s["trace_id"][:8]
+
+    def _tid(s: Dict[str, Any]) -> str:
+        return s["name"].split(".", 1)[0]
+
+    for s in spans:
+        parent = by_id.get(s["parent_id"]) if s.get("parent_id") else None
+        if parent is None or _pid(parent) == _pid(s):
+            continue
+        # flow id from the child span id: unique per edge, stable across
+        # re-exports of the same span set
+        flow_id = int(s["span_id"][:12], 16)
+        events.append({
+            "name": "span-link", "cat": "flow", "ph": "s", "id": flow_id,
+            "ts": parent["start_ts"] * 1e6,
+            "pid": _pid(parent), "tid": _tid(parent),
+            "args": {"trace_id": s["trace_id"], "child": s["name"]},
+        })
+        events.append({
+            "name": "span-link", "cat": "flow", "ph": "f", "bp": "e",
+            "id": flow_id,
+            "ts": max(s["start_ts"], parent["start_ts"]) * 1e6,
+            "pid": _pid(s), "tid": _tid(s),
+            "args": {"trace_id": s["trace_id"], "parent": parent["name"]},
+        })
     for s in spans:
         end = s["end_ts"] or s["start_ts"]
         pid = s.get("lane") or s["trace_id"][:8]

@@ -26,7 +26,7 @@ from __future__ import annotations
 import math
 import threading
 import time
-from typing import Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 from .events import emit
 from .metrics import get_or_create_counter, get_or_create_gauge, registry
@@ -170,11 +170,12 @@ class StallWatchdog:
             emit("WARNING", "watchdog",
                  f"run {self.run_name} STALLED: {reason} "
                  f"(straggler rank {straggler})",
+                 kind="watchdog.stall",
                  run=self.run_name, straggler_rank=straggler)
         else:
             emit("INFO", "watchdog",
                  f"run {self.run_name} recovered from stall",
-                 run=self.run_name)
+                 kind="watchdog.recovered", run=self.run_name)
 
     def close(self) -> None:
         """Run over: clear the stalled gauge so a finished run never
@@ -217,6 +218,9 @@ class ServeSLOMonitor:
         self._lock = threading.Lock()
         # histogram name -> previous cumulative (bucket counts, total)
         self._prev: Dict[str, Tuple[List[int], int]] = {}
+        # slo -> {"windows", "violated", "requests", "last_p99_s"} — the
+        # attainment ledger the serve goodput report reads
+        self._attainment: Dict[str, Dict[str, Any]] = {}
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
 
@@ -267,7 +271,25 @@ class ServeSLOMonitor:
                 continue
             p99 = _histogram_quantile(buckets, n, 0.99)
             out[slo] = p99
-            if objective > 0 and p99 > objective:
+            violated = objective > 0 and p99 > objective
+            with self._lock:
+                led = self._attainment.setdefault(slo, {
+                    "windows": 0, "violated": 0, "requests": 0,
+                    "objective_s": objective, "last_p99_s": 0.0,
+                })
+                led["windows"] += 1
+                led["requests"] += n
+                led["violated"] += 1 if violated else 0
+                led["objective_s"] = objective
+                led["last_p99_s"] = p99
+                attained = 1.0 - led["violated"] / led["windows"]
+            get_or_create_gauge(
+                "raytpu_serve_slo_attainment",
+                "Fraction of evaluation windows whose p99 met the "
+                "configured SLO objective (the serve-side goodput).",
+                tag_keys=("slo",),
+            ).set(attained, tags={"slo": slo})
+            if violated:
                 get_or_create_counter(
                     "raytpu_serve_slo_burn_total",
                     "SLO-violating windows observed by the serve SLO "
@@ -279,8 +301,25 @@ class ServeSLOMonitor:
                      f"{'inf' if math.isinf(p99) else f'{p99:.3f}s'} over "
                      f"objective {objective:.3f}s "
                      f"({n} request(s) this window)",
+                     kind="watchdog.slo_burn",
                      slo=slo, objective=objective, samples=n)
         return out
+
+    def attainment_report(self) -> Dict[str, Dict[str, Any]]:
+        """Per-SLO window attainment ledger (the serve analogue of the
+        train goodput report): windows evaluated, windows violated,
+        requests covered, attainment fraction."""
+        with self._lock:
+            out = {}
+            for slo, led in self._attainment.items():
+                windows = led["windows"]
+                out[slo] = {
+                    **led,
+                    "attainment": (
+                        1.0 - led["violated"] / windows if windows else 1.0
+                    ),
+                }
+            return out
 
     # -------------------------------------------------------- background run
 

@@ -11,7 +11,9 @@ comments, committed baseline, text/JSON reporters) carrying:
 - blocking-under-lock: no sleeps/joins/waits/RPCs inside a critical
   section;
 - jax-hot-path: no host syncs or recompilation traps in functions
-  reachable from jit/shard_map step definitions.
+  reachable from jit/shard_map step definitions;
+- event-kinds: every events.emit call site passes a kind registered in
+  the flight-recorder event schema (util/events.py EVENT_KINDS).
 
 Run ``python -m scripts.raylint`` from the repo root; see README
 "Static analysis".
@@ -32,5 +34,6 @@ from .engine import (  # noqa: F401
 from . import rules_legacy  # noqa: F401,E402
 from . import rules_locks  # noqa: F401,E402
 from . import rules_jax  # noqa: F401,E402
+from . import rules_events  # noqa: F401,E402
 
 DEFAULT_BASELINE = "scripts/raylint/baseline.json"

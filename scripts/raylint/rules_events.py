@@ -1,0 +1,139 @@
+"""event-kinds: every flight-recorder emit names a registered kind.
+
+The cluster event plane (ray_tpu/util/events.py) is TYPED: consumers —
+the postmortem reconstructor, the goodput accountant, `ray_tpu events
+--kind` — key off the ``kind`` field, so an emit without one (or with a
+typo'd one) silently drops out of every downstream view. This rule
+holds every ``emit(...)`` / ``events().emit(...)`` call site under
+``ray_tpu/`` to the registry:
+
+- the call must pass ``kind=`` ;
+- the value must be a string literal (dynamic kinds defeat static
+  checking — build the registry entry instead);
+- the literal must be registered: a key of the ``EVENT_KINDS`` dict
+  literal in util/events.py, or the first argument of any
+  ``register_event_kind("...")`` call in the tree.
+
+``ray_tpu/util/events.py`` itself is exempt (it defines the plumbing
+that forwards ``kind`` through). Call sites with a legitimate reason to
+bypass the registry belong in the baseline with a justification.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Set
+
+from .engine import Finding, Project, Rule, SourceFile, register
+
+EVENTS_MODULE_REL = "ray_tpu/util/events.py"
+
+
+def registered_kinds(project: Project) -> Set[str]:
+    """The static kind registry: EVENT_KINDS literal keys plus every
+    register_event_kind("...") string-literal call in the tree."""
+    kinds: Set[str] = set()
+    events_sf = project.file(EVENTS_MODULE_REL)
+    if events_sf is not None:
+        for node in ast.walk(events_sf.tree):
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, ast.AnnAssign):  # EVENT_KINDS: Dict[...] = {}
+                targets = [node.target]
+            else:
+                continue
+            if (any(isinstance(t, ast.Name) and t.id == "EVENT_KINDS"
+                    for t in targets)
+                    and isinstance(node.value, ast.Dict)):
+                for key in node.value.keys:
+                    if isinstance(key, ast.Constant) and isinstance(key.value, str):
+                        kinds.add(key.value)
+    for sf in project.files:
+        for node in ast.walk(sf.tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id == "register_event_kind"
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                kinds.add(node.args[0].value)
+    return kinds
+
+
+def _emit_aliases(tree: ast.AST) -> Set[str]:
+    """Local names bound to util.events' emit via `from ... import`."""
+    aliases: Set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ImportFrom):
+            continue
+        module = node.module or ""
+        if not (module == "events" or module.endswith(".events")
+                or module == "util.events"):
+            continue
+        for alias in node.names:
+            if alias.name == "emit":
+                aliases.add(alias.asname or alias.name)
+    return aliases
+
+
+def _is_events_factory_call(func: ast.AST) -> bool:
+    """True for `events().emit` / `<x>.events().emit` receivers."""
+    return (isinstance(func, ast.Call)
+            and ((isinstance(func.func, ast.Name)
+                  and func.func.id == "events")
+                 or (isinstance(func.func, ast.Attribute)
+                     and func.func.attr == "events")))
+
+
+def emit_call_findings(sf: SourceFile, kinds: Set[str],
+                       rule_name: str = "event-kinds") -> List[Finding]:
+    aliases = _emit_aliases(sf.tree)
+    out: List[Finding] = []
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        is_emit = (isinstance(func, ast.Name) and func.id in aliases) or (
+            isinstance(func, ast.Attribute) and func.attr == "emit"
+            and _is_events_factory_call(func.value)
+        )
+        if not is_emit:
+            continue
+        msg = _check_kind_kwarg(node, kinds)
+        if msg is not None:
+            out.append(Finding(rule_name, sf.rel, node.lineno, msg))
+    return out
+
+
+def _check_kind_kwarg(call: ast.Call, kinds: Set[str]) -> Optional[str]:
+    kind_kw = next((kw for kw in call.keywords if kw.arg == "kind"), None)
+    if kind_kw is None:
+        # positional kind (4th positional arg of emit) counts too
+        if len(call.args) >= 4:
+            kind_kw = ast.keyword(arg="kind", value=call.args[3])
+        else:
+            return ("events.emit without kind=: pass a registered event "
+                    "kind (see EVENT_KINDS in util/events.py)")
+    if not (isinstance(kind_kw.value, ast.Constant)
+            and isinstance(kind_kw.value.value, str)):
+        return ("events.emit kind= must be a string literal so the "
+                "registry check stays static")
+    kind = kind_kw.value.value
+    if kind not in kinds:
+        return (f"events.emit kind={kind!r} is not registered in "
+                f"EVENT_KINDS (util/events.py) or via register_event_kind")
+    return None
+
+
+@register
+class EventKindsRule(Rule):
+    name = "event-kinds"
+    doc = ("every events.emit call site in ray_tpu/ passes a kind= "
+           "string literal registered in the event schema")
+
+    def check(self, project: Project) -> Iterable[Finding]:
+        kinds = registered_kinds(project)
+        for sf in project.files_under("ray_tpu/"):
+            if sf.rel == EVENTS_MODULE_REL:
+                continue  # the plumbing that forwards kind through
+            yield from emit_call_findings(sf, kinds, self.name)

@@ -26,7 +26,7 @@ from scripts.raylint.reporters import render_json, render_text  # noqa: E402
 ALL_RULES = {
     "typed-errors", "metrics-names", "atomic-writes", "lazy-jax",
     "kernel-fallbacks", "lock-discipline", "lock-order",
-    "blocking-under-lock", "jax-hot-path",
+    "blocking-under-lock", "jax-hot-path", "event-kinds",
 }
 
 
