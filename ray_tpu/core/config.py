@@ -263,6 +263,23 @@ define_flag("dp_quant_block", 512,
             "Block size of the int8 gradient quantizer (one f32 scale per "
             "block of this many elements).")
 
+# serve throughput (PERF_NOTES.md round 7)
+define_flag("serve_ragged_kernel", True,
+            "Dispatch paged attention through the ragged Pallas kernel on "
+            "TPU backends (one launch for mixed prefill+decode batches, "
+            "shard_map-wrapped under a tp mesh); False pins the XLA "
+            "gather/reference path everywhere.")
+define_flag("autoscale_burn_windows", 1,
+            "New SLO-violating windows (ServeSLOMonitor attainment "
+            "ledger) since the last autoscale pass that trigger a "
+            "one-replica scale-up for slo_driven deployments "
+            "(0 disables the SLO term).")
+define_flag("autoscale_pressure_floor", 0.25,
+            "Minimum demand signal (router ongoing-per-replica over "
+            "target, or max engine batch_fill) required before an SLO "
+            "burn may scale up: a burn with an idle router is a "
+            "cold-start artifact, not missing capacity.")
+
 # memory monitor / OOM
 define_flag("memory_monitor_interval_s", 0.25,
             "Polling interval of the host memory monitor (0 = disabled).")

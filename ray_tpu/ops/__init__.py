@@ -11,6 +11,10 @@ None). None auto-selects pallas on TPU backends, xla elsewhere.
 """
 
 from .attention import flash_attention, mha_reference  # noqa: F401
+from .ragged_paged_attention import (  # noqa: F401
+    ragged_paged_attention,
+    ragged_reference_attention,
+)
 from .ring_attention import ring_attention, ring_attention_sharded  # noqa: F401
 from .ulysses import ulysses_attention, ulysses_attention_sharded  # noqa: F401
 from .layers import (  # noqa: F401

@@ -12,13 +12,23 @@ from typing import Any, Callable, Dict, Optional, Tuple
 
 @dataclasses.dataclass
 class AutoscalingConfig:
-    """Scale on ongoing requests (reference serve/_private/autoscaling_state.py)."""
+    """Scale on ongoing requests (reference serve/_private/autoscaling_state.py).
+
+    With slo_driven=True the controller additionally reads the
+    ServeSLOMonitor attainment ledger each pass: new SLO-violating
+    windows (TTFT/queue p99 over objective) bump the target by one
+    replica — beyond what the ongoing-count heuristic asks for — as long
+    as there is real demand pressure (cfg.autoscale_pressure_floor), and
+    scale-down stays damped through scale_down_delay_s and the graceful
+    drain path. Thresholds live on cfg (autoscale_burn_windows,
+    autoscale_pressure_floor) so operators tune them fleet-wide."""
 
     min_replicas: int = 1
     max_replicas: int = 4
     target_ongoing_requests: float = 2.0
     interval_s: float = 0.5
     scale_down_delay_s: float = 2.0
+    slo_driven: bool = False
 
 
 @dataclasses.dataclass

@@ -174,6 +174,39 @@ def _ensure_engine_gauges() -> None:
         tag_keys=("engine",), fn=_engine_metric_sampler("decode_mfu"),
     )
 
+    get_or_create_gauge(
+        "raytpu_engine_prefix_cache_hits",
+        "Cumulative prefix-cache page hits (pages of prompt KV reused "
+        "instead of re-prefilled).",
+        tag_keys=("engine",), fn=_engine_metric_sampler("prefix_cache_hits"),
+    )
+    get_or_create_gauge(
+        "raytpu_engine_prefix_cache_misses",
+        "Cumulative prefix-cache page misses (page-aligned prompt pages "
+        "that had to prefill).",
+        tag_keys=("engine",), fn=_engine_metric_sampler("prefix_cache_misses"),
+    )
+    get_or_create_gauge(
+        "raytpu_engine_prefix_cache_evictions",
+        "Cumulative cache-pinned pages evicted back to the pool under "
+        "allocation pressure.",
+        tag_keys=("engine",),
+        fn=_engine_metric_sampler("prefix_cache_evictions"),
+    )
+    get_or_create_gauge(
+        "raytpu_engine_prefix_cache_pages",
+        "Pages currently pinned by the prefix cache (each holds one "
+        "prompt page's KV warm for reuse).",
+        tag_keys=("engine",), fn=_engine_metric_sampler("prefix_cache_pages"),
+    )
+    get_or_create_gauge(
+        "raytpu_engine_prefix_cache_hit_rate",
+        "Lifetime fraction of page-aligned prompt pages served from the "
+        "prefix cache.",
+        tag_keys=("engine",),
+        fn=_engine_metric_sampler("prefix_cache_hit_rate"),
+    )
+
     def token_mix():
         out = []
         for label, e in list(_ENGINES.items()):

@@ -29,9 +29,11 @@ host-side compaction.
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import math
 import threading
-from typing import Any, Dict, List, Optional, Tuple
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -39,6 +41,7 @@ import numpy as np
 
 from ...models.transformer import TransformerConfig, _norm
 from ...ops import apply_rope, rope_frequencies
+from ...ops.ragged_paged_attention import ragged_paged_attention
 
 Params = Dict[str, Any]
 
@@ -49,6 +52,13 @@ class PagedConfig:
     num_pages: int = 256          # pool size (page 0 reserved as scratch)
     max_pages_per_slot: int = 16  # static block-table width
     chunk_pages: int = 4          # prefill chunk = chunk_pages * page_size
+    # Prefix/KV-cache reuse (PrefixCache): requests sharing a page-aligned
+    # prompt prefix reuse its KV instead of re-prefilling. Off by default —
+    # retired prompts then PIN their pages (cache holds a ref) until pool
+    # pressure evicts them, which changes allocator-accounting invariants
+    # tests and capacity planning may rely on.
+    prefix_cache: bool = False
+    prefix_cache_pages: int = 0   # max cached pages; 0 = pool-pressure only
 
     @property
     def chunk_tokens(self) -> int:
@@ -80,11 +90,19 @@ def init_paged_cache(
 
 
 class PageAllocator:
-    """Host-side free list over the page pool. Page 0 is never handed out."""
+    """Host-side REFCOUNTED free list over the page pool.
+
+    Prefix caching means a physical page can back several block tables at
+    once (N slots sharing a system prompt, plus the cache's own pin), so
+    ownership is a count, not a set: `alloc` hands out pages at refcount 1,
+    `share` adds a holder, and `free` drops one — the page returns to the
+    free list only when the LAST holder lets go. Page 0 is the scratch
+    page: never handed out, never refcounted, and `free`/`share` ignore it.
+    """
 
     def __init__(self, num_pages: int):
         self._free = list(range(num_pages - 1, 0, -1))
-        self._allocated: set = set()
+        self._refs: Dict[int, int] = {}
         self._lock = threading.Lock()
 
     def alloc(self, n: int) -> Optional[List[int]]:
@@ -92,23 +110,171 @@ class PageAllocator:
             if len(self._free) < n:
                 return None
             pages = [self._free.pop() for _ in range(n)]
-            self._allocated.update(pages)
+            for p in pages:
+                self._refs[p] = 1
             return pages
 
-    def free(self, pages: List[int]) -> None:
-        # Double-free guard: a page not currently allocated is ignored, so a
-        # buggy caller can never put the same physical page on the free list
-        # twice (which would hand it to two slots and corrupt both KV caches).
+    def share(self, pages: Sequence[int]) -> None:
+        """Add one holder to each page. Sharing a page that is not
+        currently allocated is a caller bug and raises — silently
+        resurrecting a freed page would corrupt whichever slot the free
+        list hands it to next."""
         with self._lock:
             for p in pages:
-                if p > 0 and p in self._allocated:
-                    self._allocated.discard(p)
-                    self._free.append(p)
+                if p <= 0:
+                    continue
+                if p not in self._refs:
+                    raise ValueError(f"share of unallocated page {p}")
+                self._refs[p] += 1
+
+    def free(self, pages: Sequence[int]) -> None:
+        # Drop ONE holder per page. The double-free guard survives from the
+        # pre-refcount allocator: a page with no live holders is ignored, so
+        # a buggy caller can never put the same physical page on the free
+        # list twice (which would hand it to two slots and corrupt both).
+        with self._lock:
+            for p in pages:
+                if p > 0 and p in self._refs:
+                    self._refs[p] -= 1
+                    if self._refs[p] <= 0:
+                        del self._refs[p]
+                        self._free.append(p)
+
+    def refcount(self, page: int) -> int:
+        with self._lock:
+            return self._refs.get(page, 0)
 
     @property
     def available(self) -> int:
         with self._lock:
             return len(self._free)
+
+
+# ---------------------------------------------------------------- prefix cache
+
+
+def _chain_hash(prev: bytes, chunk: Sequence[int]) -> bytes:
+    """Collision-resistant chain hash of page-aligned token chunks.
+
+    KV for a page is a pure function of every token up to the page's end
+    (causal attention), so keying page p by H(H(...), tokens of page p)
+    makes a hit sufficient for reuse. blake2b rather than python hash():
+    a tuple-hash collision would silently splice one prompt's KV into
+    another request."""
+    h = hashlib.blake2b(prev, digest_size=16)
+    h.update(np.asarray(chunk, dtype=np.int64).tobytes())
+    return h.digest()
+
+
+class PrefixCache:
+    """Refcounted page-level prefix cache over the allocator.
+
+    Maps the chain hash of each fully-prompt-covered page to the physical
+    page holding its KV. The cache itself holds ONE reference per entry
+    (the pin that keeps a finished request's prompt pages warm); every
+    slot that reuses a page takes its own reference via `allocator.share`.
+    Eviction (LRU, and only of pages whose sole holder is the cache) is
+    driven by pool pressure: the engine calls `evict` when an alloc
+    fails, so cached prefixes never starve admissions — but pages still
+    referenced by live slots are pinned and survive the sweep.
+    """
+
+    def __init__(self, allocator: PageAllocator, page_size: int,
+                 capacity_pages: int = 0):
+        self.allocator = allocator
+        self.page_size = page_size
+        self.capacity_pages = capacity_pages  # 0 = bounded by pool pressure only
+        self._entries: "OrderedDict[bytes, int]" = OrderedDict()
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def lookup(self, prompt: Sequence[int]) -> List[int]:
+        """Longest cached page-aligned prefix of `prompt`, capped so at
+        least one prompt token is always left to prefill (its logits seed
+        sampling — vLLM caps its hit the same way). Matched pages get one
+        reference taken FOR THE CALLER; the caller releases them through
+        the normal refcounted free path when the slot retires."""
+        ps = self.page_size
+        max_reuse = max(0, (len(prompt) - 1) // ps)
+        matched: List[int] = []
+        digest = b""
+        with self._lock:
+            for p in range(max_reuse):
+                digest = _chain_hash(digest, prompt[p * ps:(p + 1) * ps])
+                page = self._entries.get(digest)
+                if page is None:
+                    break
+                matched.append(page)
+                self._entries.move_to_end(digest)
+            self.hits += len(matched)
+            self.misses += max_reuse - len(matched)
+        if matched:
+            self.allocator.share(matched)
+        return matched
+
+    def register(self, prompt: Sequence[int], pages: Sequence[int]) -> int:
+        """Publish every page fully covered by `prompt` (KV already
+        written by this slot's prefill). The cache takes its own reference
+        per NEW entry; hashes already present keep their existing page.
+        Returns the number of pages newly published."""
+        ps = self.page_size
+        full = len(prompt) // ps
+        added = 0
+        with self._lock:
+            digest = b""
+            for p in range(full):
+                digest = _chain_hash(digest, prompt[p * ps:(p + 1) * ps])
+                if digest in self._entries:
+                    self._entries.move_to_end(digest)
+                    continue
+                if (
+                    self.capacity_pages > 0
+                    and len(self._entries) >= self.capacity_pages
+                    and not self._evict_locked(1)
+                ):
+                    break
+                page = pages[p]
+                self.allocator.share([page])
+                self._entries[digest] = page
+                self._entries.move_to_end(digest)
+                added += 1
+        return added
+
+    def evict(self, n: int) -> int:
+        """Release up to n cache-pinned pages back toward the pool (LRU
+        first, skipping pages live slots still hold)."""
+        with self._lock:
+            return self._evict_locked(n)
+
+    def _evict_locked(self, n: int) -> int:
+        dropped = 0
+        for digest, page in list(self._entries.items()):
+            if dropped >= n:
+                break
+            if self.allocator.refcount(page) != 1:
+                continue  # pinned by a live slot: survives the sweep
+            del self._entries[digest]
+            self.allocator.free([page])
+            self.evictions += 1
+            dropped += 1
+        return dropped
+
+    def stats(self) -> Dict[str, float]:
+        with self._lock:
+            hits, misses = self.hits, self.misses
+            return {
+                "hits": float(hits),
+                "misses": float(misses),
+                "evictions": float(self.evictions),
+                "pages": float(len(self._entries)),
+                "hit_rate": hits / max(1, hits + misses),
+            }
 
 
 # ------------------------------------------------------------------ attention
@@ -138,8 +304,11 @@ def _gather_ref_attention(q, k_cache, v_cache, block_tables, lengths):
 
 
 def paged_attention(q, k_cache, v_cache, block_tables, lengths, *, page_size: int,
-                    use_kernel: Optional[bool] = None):
-    """Dispatch: Pallas paged kernel on TPU, gather reference elsewhere.
+                    use_kernel: Optional[bool] = None, mesh=None,
+                    interpret: bool = False):
+    """Decode-step paged attention: the q_len == 1 case of the ragged
+    kernel. Dispatch: Pallas ragged kernel on TPU, gather reference
+    elsewhere.
 
     The Mosaic lowering requires the trailing block dims be (8, 128)-
     divisible, so the kernel is only eligible for head_dim % 128 == 0 and
@@ -147,37 +316,36 @@ def paged_attention(q, k_cache, v_cache, block_tables, lengths, *, page_size: in
     test configs, GPT-2's 64-dim heads) take the gather reference, which
     XLA fuses well at those sizes anyway.
 
-    use_kernel=False forces the gather path: under a tensor-parallel mesh
-    the GSPMD partitioner cannot split a Pallas call, while the gather
-    reference partitions cleanly on the (tp-sharded) kv-head axis."""
-    head_dim = q.shape[-1]
+    Tensor parallelism: the kernel path is `shard_map`-wrapped over the
+    tp mesh axis inside `ragged_paged_attention` (GSPMD cannot partition
+    a pallas_call, but both head axes divide by tp, so each shard runs
+    the kernel on its local head group) — use_kernel=False is no longer
+    forced under a mesh; pass `mesh` instead. The gather reference still
+    partitions cleanly on the kv-head axis under plain GSPMD."""
+    b, hq, head_dim = q.shape
     if use_kernel is None:
         use_kernel = (
             jax.default_backend() == "tpu"
             and head_dim % 128 == 0
             and page_size % 8 == 0
         )
-    if use_kernel:
-        from jax.experimental.pallas.ops.tpu.paged_attention import (
-            paged_attention as _kernel,
-        )
-
-        hq = q.shape[1]
-        hkv = k_cache.shape[0]
-        # kernel layout: q (B, Hq, D); pages (Hkv, P, ps, D); scale built in?
-        # The kernel computes unscaled q·k, so pre-scale q.
-        scaled = q / math.sqrt(q.shape[-1])
-        pages_per_block = max(1, min(4, block_tables.shape[1]))
-        while block_tables.shape[1] % pages_per_block:
-            pages_per_block -= 1
-        return _kernel(
-            scaled,
-            k_cache,
-            v_cache,
-            lengths,
+    if use_kernel or interpret:
+        block_q = 8
+        # adapt (B, Hq, D) single-token lanes to the ragged layout: one
+        # block_q-row region per lane, real row 0, q_len 1
+        q_r = jnp.swapaxes(q, 0, 1)[:, :, None, :]  # (Hq, B, 1, D)
+        q_r = jnp.pad(q_r, ((0, 0), (0, 0), (0, block_q - 1), (0, 0)))
+        q_r = q_r.reshape(hq, b * block_q, head_dim)
+        ones = jnp.ones((b,), jnp.int32)
+        out = ragged_paged_attention(
+            q_r, k_cache, v_cache,
+            jnp.arange(b, dtype=jnp.int32), ones, ones, lengths,
             block_tables,
-            pages_per_compute_block=pages_per_block,
+            block_q=block_q, max_q_blocks=1,
+            use_kernel=True, interpret=interpret, mesh=mesh,
         )
+        out = out.reshape(hq, b, block_q, head_dim)[:, :, 0, :]
+        return jnp.swapaxes(out, 0, 1)  # (B, Hq, D)
     return _gather_ref_attention(q, k_cache, v_cache, block_tables, lengths)
 
 
@@ -305,6 +473,207 @@ def batched_chunk_prefill_step(
     return logits, {"k": k_full, "v": v_full}
 
 
+def ragged_mixed_step(
+    params: Params,
+    cache: Dict[str, jax.Array],
+    page_rows: jax.Array,       # (P+B, maxP) tables: prefill lanes then decode
+    chunk_page_ids: jax.Array,  # (P, cp) pages each prefill chunk fills
+    prefill_tokens: jax.Array,  # (P, C) chunks, right-padded
+    offsets: jax.Array,         # (P,) tokens already ingested (page-aligned)
+    totals: jax.Array,          # (P,) offset + real tokens (0 = inactive)
+    dec_tokens: jax.Array,      # (B,) decode input tokens
+    dec_positions: jax.Array,   # (B,) decode write positions
+    dec_active: jax.Array,      # (B,) int32 1 = lane decodes this tick
+    config: TransformerConfig,
+    *,
+    page_size: int,
+    block_q: int = 8,
+    use_kernel: Optional[bool] = None,
+    mesh=None,
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array, Dict[str, jax.Array]]:
+    """ONE device call for a mixed tick: P prefill chunks AND B decode
+    lanes run through a single token-major transformer pass whose
+    attention is one ragged-paged-attention launch per layer. This
+    replaces the split batched_chunk_prefill_step + paged_decode_step
+    dispatch: a tick with both kinds of work used to pay two compiled
+    programs and two passes over the page pool.
+
+    Token-major layout: T = P*C + B*block_q rows. Prefill lane p owns rows
+    [p*C, (p+1)*C) (C = chunk tokens, a multiple of block_q); decode lane
+    b owns the block_q-row region at P*C + b*block_q with its single real
+    token at row 0. The ragged descriptor (q_lens = chunk fill / 1 / 0,
+    kv_lens = totals / position+1 / 0) masks everything else off, so
+    inactive lanes burn pad-row FLOPs but write only to the scratch page.
+
+    Returns (prefill last-token logits (P, V), decode logits (B, V),
+    updated cache).
+    """
+    c = config
+    dt = c.dtype
+    p_lanes, chunk = prefill_tokens.shape
+    b_lanes = dec_tokens.shape[0]
+    chunk_pages = chunk // page_size
+    if chunk % block_q:
+        raise ValueError(f"chunk tokens ({chunk}) must divide by block_q "
+                         f"({block_q})")
+    t_tokens = p_lanes * chunk + b_lanes * block_q
+
+    # ---- token-major embedding -------------------------------------------
+    pre_pos = offsets[:, None] + jnp.arange(chunk)[None, :]     # (P, C)
+    dec_region_pos = jnp.zeros((b_lanes, block_q), jnp.int32).at[:, 0].set(
+        dec_positions
+    )
+    positions = jnp.concatenate(
+        [pre_pos.reshape(-1), dec_region_pos.reshape(-1)]
+    )  # (T,)
+    dec_region_tok = jnp.zeros((b_lanes, block_q), jnp.int32).at[:, 0].set(
+        dec_tokens
+    )
+    tokens = jnp.concatenate(
+        [prefill_tokens.reshape(-1), dec_region_tok.reshape(-1)]
+    )  # (T,)
+    x = params["wte"].astype(dt)[tokens]  # (T, E)
+    if c.pos_emb == "learned":
+        x = x + params["wpe"].astype(dt)[jnp.clip(positions, 0, c.max_seq - 1)]
+        rope_tables = None
+    else:
+        rope_tables = rope_frequencies(c.head_dim, c.max_seq, c.rope_theta)
+
+    # ---- ragged descriptor (static regions, dynamic lengths) -------------
+    cb = chunk // block_q
+    starts = jnp.concatenate([
+        jnp.arange(p_lanes, dtype=jnp.int32) * cb,
+        p_lanes * cb + jnp.arange(b_lanes, dtype=jnp.int32),
+    ])
+    counts = jnp.concatenate([
+        jnp.full((p_lanes,), cb, jnp.int32),
+        jnp.ones((b_lanes,), jnp.int32),
+    ])
+    q_lens = jnp.concatenate([
+        (totals - offsets).astype(jnp.int32),
+        dec_active.astype(jnp.int32),
+    ])
+    kv_lens = jnp.concatenate([
+        totals.astype(jnp.int32),
+        (dec_positions + 1) * dec_active.astype(jnp.int32),
+    ])
+
+    flat_ids = chunk_page_ids.reshape(-1)                 # (P*cp,)
+    dec_page_idx = jnp.arange(b_lanes)
+    dec_pages = page_rows[p_lanes + dec_page_idx, dec_positions // page_size]
+    dec_rows = dec_positions % page_size
+
+    k_full, v_full = cache["k"], cache["v"]
+    num_pages = k_full.shape[1] // c.n_layers
+    zero = jnp.int32(0)
+    for i in range(c.n_layers):
+        lp = {name: w[i] for name, w in params["blocks"].items()}
+        h = _norm(x, lp["ln1_scale"], lp.get("ln1_bias"), c.norm)
+        # heads-leading token-major projections: (T, E) @ (E, H, D) -> (H, T, D)
+        q = jnp.einsum("te,ehd->htd", h, lp["wq"].astype(dt))
+        k = jnp.einsum("te,ehd->htd", h, lp["wk"].astype(dt))
+        v = jnp.einsum("te,ehd->htd", h, lp["wv"].astype(dt))
+        if c.use_bias:
+            q = q + lp["bq"].astype(dt)[:, None, :]
+            k = k + lp["bk"].astype(dt)[:, None, :]
+            v = v + lp["bv"].astype(dt)[:, None, :]
+        if rope_tables is not None:
+            cos, sin = rope_tables
+            q = apply_rope(q[None], cos, sin, positions[None])[0]
+            k = apply_rope(k[None], cos, sin, positions[None])[0]
+        # prefill KV: whole-page DUS per (lane, chunk page), as in
+        # batched_chunk_prefill_step
+        layer_flat = flat_ids + i * num_pages
+        kp = (
+            k[:, : p_lanes * chunk]
+            .reshape(k.shape[0], p_lanes * chunk_pages, page_size, k.shape[-1])
+            .astype(c.dtype)
+        )
+        vp = (
+            v[:, : p_lanes * chunk]
+            .reshape(v.shape[0], p_lanes * chunk_pages, page_size, v.shape[-1])
+            .astype(c.dtype)
+        )
+        for j in range(p_lanes * chunk_pages):
+            start = (zero, layer_flat[j], zero, zero)
+            k_full = jax.lax.dynamic_update_slice(k_full, kp[:, j][:, None], start)
+            v_full = jax.lax.dynamic_update_slice(v_full, vp[:, j][:, None], start)
+        # decode KV: per-lane row DUS at (page, row), as in paged_decode_step
+        for lane in range(b_lanes):
+            row_idx = p_lanes * chunk + lane * block_q
+            upd_k = k[:, row_idx].astype(c.dtype)[:, None, None, :]
+            upd_v = v[:, row_idx].astype(c.dtype)[:, None, None, :]
+            start = (zero, dec_pages[lane] + i * num_pages, dec_rows[lane], zero)
+            k_full = jax.lax.dynamic_update_slice(k_full, upd_k, start)
+            v_full = jax.lax.dynamic_update_slice(v_full, upd_v, start)
+        # ONE ragged attention launch for every lane, prefill and decode
+        attn = ragged_paged_attention(
+            q, k_full, v_full, starts, counts, q_lens, kv_lens,
+            page_rows + i * num_pages,
+            block_q=block_q, max_q_blocks=cb,
+            use_kernel=use_kernel, mesh=mesh, interpret=interpret,
+        )  # (Hq, T, D)
+        out = jnp.einsum("htd,hde->te", attn.astype(dt), lp["wo"].astype(dt))
+        if c.use_bias:
+            out = out + lp["bo"].astype(dt)
+        x = x + out
+        h = _norm(x, lp["ln2_scale"], lp.get("ln2_bias"), c.norm)
+        up = jnp.einsum("te,ef->tf", h, lp["w_up"].astype(dt))
+        if c.use_bias:
+            up = up + lp["b_up"].astype(dt)
+        if c.act == "swiglu":
+            from ...ops import swiglu
+
+            act = swiglu(jnp.einsum("te,ef->tf", h, lp["w_gate"].astype(dt)), up)
+        else:
+            from ...ops import gelu
+
+            act = gelu(up)
+        down = jnp.einsum("tf,fe->te", act, lp["w_down"].astype(dt))
+        if c.use_bias:
+            down = down + lp["b_down"].astype(dt)
+        x = x + down
+    x = _norm(x, params["lnf_scale"], params.get("lnf_bias"), c.norm)
+    head = params.get("lm_head")
+    if head is None:
+        head = params["wte"].T
+    # vocab projection ONLY for sample rows: each prefill lane's last real
+    # token and each decode lane's region row 0
+    last = jnp.clip(totals - offsets - 1, 0, chunk - 1)
+    pre_rows = jnp.arange(p_lanes) * chunk + last
+    dec_rows_x = p_lanes * chunk + jnp.arange(b_lanes) * block_q
+    x_sample = x[jnp.concatenate([pre_rows, dec_rows_x])]  # (P+B, E)
+    logits = jnp.einsum("be,ev->bv", x_sample, head.astype(dt))
+    return logits[:p_lanes], logits[p_lanes:], {"k": k_full, "v": v_full}
+
+
+def copy_page(
+    cache: Dict[str, jax.Array], src: jax.Array, dst: jax.Array,
+    *, n_layers: int,
+) -> Dict[str, jax.Array]:
+    """Copy one logical page (every layer's stripe) src -> dst in the flat
+    pool: the device half of copy-on-write divergence. Layer i's stripe
+    lives at page + i*num_pages (see init_paged_cache)."""
+    k_full, v_full = cache["k"], cache["v"]
+    num_pages = k_full.shape[1] // n_layers
+    zero = jnp.int32(0)
+    for i in range(n_layers):
+        s = src + i * num_pages
+        d = dst + i * num_pages
+        k_pg = jax.lax.dynamic_slice(
+            k_full, (zero, s, zero, zero),
+            (k_full.shape[0], 1, k_full.shape[2], k_full.shape[3]),
+        )
+        v_pg = jax.lax.dynamic_slice(
+            v_full, (zero, s, zero, zero),
+            (v_full.shape[0], 1, v_full.shape[2], v_full.shape[3]),
+        )
+        k_full = jax.lax.dynamic_update_slice(k_full, k_pg, (zero, d, zero, zero))
+        v_full = jax.lax.dynamic_update_slice(v_full, v_pg, (zero, d, zero, zero))
+    return {"k": k_full, "v": v_full}
+
+
 def paged_decode_step(
     params: Params,
     cache: Dict[str, jax.Array],
@@ -315,6 +684,8 @@ def paged_decode_step(
     *,
     page_size: int,
     use_kernel: Optional[bool] = None,
+    mesh=None,
+    interpret: bool = False,
 ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """One continuous-batching decode step over the paged cache."""
     c = config
@@ -372,7 +743,8 @@ def paged_decode_step(
             v_full = jax.lax.dynamic_update_slice(v_full, upd_v, start)
         attn = paged_attention(
             q[:, :, 0, :], k_full, v_full, layer_tables, lengths,
-            page_size=page_size, use_kernel=use_kernel,
+            page_size=page_size, use_kernel=use_kernel, mesh=mesh,
+            interpret=interpret,
         )[:, :, None, :]
         out = jnp.einsum("bhsd,hde->bse", attn.astype(dt), lp["wo"].astype(dt))
         if c.use_bias:

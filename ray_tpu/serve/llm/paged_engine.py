@@ -35,6 +35,7 @@ import itertools
 import queue
 import threading
 import time
+from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
@@ -60,9 +61,12 @@ from .engine import (
 from .paged import (
     PagedConfig,
     PageAllocator,
+    PrefixCache,
     batched_chunk_prefill_step,
+    copy_page,
     init_paged_cache,
     paged_decode_step,
+    ragged_mixed_step,
 )
 
 
@@ -127,7 +131,7 @@ def _sample_filtered(logits, key, temps, top_ks, top_ps):
 
 
 def build_decode_block(mc: TransformerConfig, page_size: int, K: int,
-                       sample_fn, use_kernel=None):
+                       sample_fn, use_kernel=None, mesh=None):
     """K fused decode+sample steps; tokens never leave the device.
     Output row 0 is the INPUT token vector — a freshly prefilled
     lane's first sampled token rides along with its first block,
@@ -142,7 +146,7 @@ def build_decode_block(mc: TransformerConfig, page_size: int, K: int,
             cache, toks_c, pos_c, key_c = carry
             logits, cache = paged_decode_step(
                 params, cache, block_tables, toks_c, pos_c, mc,
-                page_size=page_size, use_kernel=use_kernel,
+                page_size=page_size, use_kernel=use_kernel, mesh=mesh,
             )
             key_c, sub = jax.random.split(key_c)
             nxt = sample_fn(logits, sub, temps, *filters)
@@ -166,6 +170,37 @@ def build_batched_chunk_fn(mc: TransformerConfig, page_size: int):
         )
 
     return _batched_chunk
+
+
+def mixed_block_q(chunk_tokens: int) -> int:
+    """Ragged q-block size for a given prefill chunk length: 8 (the
+    Mosaic-tileable size the kernel wants) whenever the chunk divides by
+    it, else the largest power of two that does (tiny test configs — the
+    XLA reference path handles any block_q)."""
+    bq = 8
+    while chunk_tokens % bq:
+        bq //= 2
+    return max(bq, 1)
+
+
+def build_mixed_step(mc: TransformerConfig, page_size: int,
+                     use_kernel=None, mesh=None, block_q: int = 8):
+    """The single mixed tick: P prefill chunks + B decode lanes through
+    one ragged-paged-attention program (replaces the split
+    build_batched_chunk_fn + per-step decode dispatch for ticks that have
+    prefill work; the K-step fused decode block remains the decode-only
+    steady state)."""
+
+    def _mixed(params, cache, page_rows, chunk_page_ids, tokens,
+               offsets, totals, dec_tokens, dec_positions, dec_active):
+        return ragged_mixed_step(
+            params, cache, page_rows, chunk_page_ids, tokens, offsets,
+            totals, dec_tokens, dec_positions, dec_active, mc,
+            page_size=page_size, block_q=block_q, use_kernel=use_kernel,
+            mesh=mesh,
+        )
+
+    return _mixed
 
 
 def serving_shardings(model_config: TransformerConfig, mesh, rules=None):
@@ -323,14 +358,32 @@ class PagedLLMEngine:
             they unstall."""
             return jnp.where(mask, new, old)
 
-        # Under a TP mesh the Pallas kernel cannot be partitioned; the
-        # gather reference shards cleanly on the kv-head axis. Single
-        # device keeps the kernel (auto-dispatch).
-        tp_active = mesh is not None and mesh.size > 1
-        use_kernel = False if tp_active else None
-        dec_plain = build_decode_block(mc, ps, K, _sample_plain, use_kernel)
-        dec_filtered = build_decode_block(mc, ps, K, _sample_filtered, use_kernel)
-        batched_chunk = build_batched_chunk_fn(mc, ps)
+        def _dec_pack(old, new, mask):
+            """Pack a mixed tick's decode samples for fetch + carry: row 0
+            is the tick's INPUT tokens (a fresh lane's first sampled token
+            rides there, like a decode block's row 0), row 1 the per-lane
+            merged output (non-dispatched lanes keep their pending token —
+            same invariant as _merge_tokens)."""
+            merged = jnp.where(mask, new, old)
+            return jnp.stack([old, merged]), merged
+
+        # Kernel dispatch: auto (None) selects the Pallas ragged kernel on
+        # TPU at tileable shapes and the XLA schedule-replay reference
+        # elsewhere. Under a TP mesh the kernel call is shard_map-wrapped
+        # over the tp axis inside ragged_paged_attention, so a mesh no
+        # longer forces the gather fallback (the old `use_kernel = False
+        # if tp_active` pessimization).
+        from ...core.config import cfg
+
+        use_kernel = None if cfg.serve_ragged_kernel else False
+        bq = mixed_block_q(pc.chunk_tokens)
+        self._block_q = bq
+        dec_plain = build_decode_block(mc, ps, K, _sample_plain, use_kernel,
+                                       mesh=mesh)
+        dec_filtered = build_decode_block(mc, ps, K, _sample_filtered,
+                                          use_kernel, mesh=mesh)
+        mixed = build_mixed_step(mc, ps, use_kernel, mesh, block_q=bq)
+        _copy = lambda cache, s, d: copy_page(cache, s, d, n_layers=mc.n_layers)  # noqa: E731
         if mesh is not None:
             param_sh, cache_sh, rep = serving_shardings(mc, mesh)
             self.params = jax.device_put(params, param_sh)
@@ -345,10 +398,14 @@ class PagedLLMEngine:
                 in_shardings=common_in + (rep, rep),
                 out_shardings=(rep, rep, cache_sh),
             )
-            self._batched_chunk = jax.jit(
-                batched_chunk, donate_argnums=(1,),
-                in_shardings=(param_sh, cache_sh, rep, rep, rep, rep, rep),
-                out_shardings=(rep, cache_sh),
+            self._mixed = jax.jit(
+                mixed, donate_argnums=(1,),
+                in_shardings=(param_sh, cache_sh) + (rep,) * 8,
+                out_shardings=(rep, rep, cache_sh),
+            )
+            self._copy_page = jax.jit(
+                _copy, donate_argnums=(0,),
+                in_shardings=(cache_sh, rep, rep), out_shardings=cache_sh,
             )
             self._tokens_dev = jax.device_put(
                 jnp.zeros((self.config.max_slots,), jnp.int32), rep
@@ -356,13 +413,22 @@ class PagedLLMEngine:
         else:
             self._decode_block_plain = jax.jit(dec_plain, donate_argnums=(1,))
             self._decode_block_filtered = jax.jit(dec_filtered, donate_argnums=(1,))
-            self._batched_chunk = jax.jit(batched_chunk, donate_argnums=(1,))
+            self._mixed = jax.jit(mixed, donate_argnums=(1,))
+            self._copy_page = jax.jit(_copy, donate_argnums=(0,))
             self._tokens_dev = jnp.zeros((self.config.max_slots,), jnp.int32)
         self._sample = jax.jit(_sample_filtered)
         self._scatter_tokens = jax.jit(_scatter_tokens, donate_argnums=(0,))
         self._take = jax.jit(_take)
         self._merge_tokens = jax.jit(_merge_tokens, donate_argnums=(0,))
+        self._dec_pack = jax.jit(_dec_pack)
         self._key = jax.random.PRNGKey(0)
+        self.prefix_cache: Optional[PrefixCache] = (
+            PrefixCache(self.allocator, ps, pc.prefix_cache_pages)
+            if pc.prefix_cache else None
+        )
+        # requests popped from the queue but not yet seated (admission hit
+        # pool exhaustion after the pop) — retried FIFO before the queue
+        self._pending: "deque[_Request]" = deque()
         self.metrics: Dict[str, float] = {
             "generated_tokens": 0.0,
             "decode_steps": 0.0,
@@ -378,6 +444,15 @@ class PagedLLMEngine:
             "tick_seconds": 0.0,
             "prefill_tokens": 0.0,
             "decode_tokens": 0.0,
+            # prefix-cache counters (engine.py gauge registry mirrors
+            # these as raytpu_engine_prefix_cache_*); zero when disabled
+            "prefix_cache_hits": 0.0,
+            "prefix_cache_misses": 0.0,
+            "prefix_cache_evictions": 0.0,
+            "prefix_cache_pages": 0.0,
+            "prefix_cache_hit_rate": 0.0,
+            "prefix_cache_cow": 0.0,
+            "mixed_ticks": 0.0,
         }
         self._tick_cost = None  # decode-block cost, set at first dispatch
         self.metrics_label = _register_engine_metrics(self, "paged")
@@ -403,20 +478,33 @@ class PagedLLMEngine:
         ct, cp = pc.chunk_tokens, pc.chunk_pages
         b = 1
         while True:
-            logits, self.cache = self._batched_chunk(
+            logits, dec_logits, self.cache = self._mixed(
                 self.params,
                 self.cache,
-                jnp.zeros((b, pc.max_pages_per_slot), jnp.int32),
+                jnp.zeros((b + ms, pc.max_pages_per_slot), jnp.int32),
                 jnp.zeros((b, cp), jnp.int32),     # scratch page only
                 jnp.zeros((b, ct), jnp.int32),
                 jnp.zeros((b,), jnp.int32),
                 jnp.zeros((b,), jnp.int32),        # totals 0: inactive
+                self._tokens_dev,
+                jnp.zeros((ms,), jnp.int32),
+                jnp.zeros((ms,), jnp.int32),       # no decode ride-alongs
             )
             self._key, sub = jax.random.split(self._key)
             self._sample(
                 logits, sub, jnp.zeros((b,), jnp.float32),
                 jnp.zeros((b,), jnp.int32), jnp.ones((b,), jnp.float32),
             )
+            if b == 1:
+                self._key, sub = jax.random.split(self._key)
+                self._sample(
+                    dec_logits, sub, jnp.zeros((ms,), jnp.float32),
+                    jnp.zeros((ms,), jnp.int32), jnp.ones((ms,), jnp.float32),
+                )
+                self._dec_pack(
+                    self._tokens_dev, jnp.zeros((ms,), jnp.int32),
+                    jnp.zeros((ms,), bool),
+                )
             if b >= ms:
                 break
             b = min(b * 2, ms)
@@ -485,6 +573,17 @@ class PagedLLMEngine:
             prompt_tokens, max_tokens, temperature, **sampling
         ).result()
 
+    def stats(self) -> Dict[str, float]:
+        """Point-in-time engine statistics: the metrics dict plus live
+        allocator/prefix-cache state (the latter read fresh, not from the
+        last loop tick)."""
+        out = dict(self.metrics)
+        out["pages_free"] = float(self.allocator.available)
+        if self.prefix_cache is not None:
+            for key, val in self.prefix_cache.stats().items():
+                out[f"prefix_cache_{key}"] = val
+        return out
+
     def shutdown(self) -> None:
         self._stop.set()
         self._wake.set()
@@ -494,37 +593,75 @@ class PagedLLMEngine:
 
     # ------------------------------------------------------------- admission
 
-    def _admit(self) -> None:
-        for idx, slot in enumerate(self.slots):
-            if not slot.free or self._queue.empty():
-                continue
-            pages = self.allocator.alloc(self.paged.chunk_pages)
-            if pages is None:
-                self.metrics["page_stalls"] += 1
-                return
-            request = None
-            while request is None:
+    def _alloc_pages(self, n: int) -> Optional[List[int]]:
+        """Pool alloc with prefix-cache pressure relief: when the free
+        list comes up short, evict cache-pinned pages (LRU, never pages a
+        live slot shares) to cover the shortfall and retry once. Cached
+        prefixes therefore never starve admissions or decode growth."""
+        pages = self.allocator.alloc(n)
+        if pages is None and self.prefix_cache is not None:
+            if self.prefix_cache.evict(n - self.allocator.available) > 0:
+                pages = self.allocator.alloc(n)
+        return pages
+
+    def _next_request(self) -> Optional[_Request]:
+        """FIFO next admissible request: retries deferred admissions first
+        (popped last tick but stalled on pages), skipping anything whose
+        deadline expired while it waited."""
+        while True:
+            if self._pending:
+                candidate = self._pending.popleft()
+            else:
                 try:
                     candidate = self._queue.get_nowait()
                 except queue.Empty:
-                    self.allocator.free(pages)
-                    return
-                if (
-                    candidate.deadline_ts is not None
-                    and time.time() >= candidate.deadline_ts
-                ):
-                    # expired while queued: fail fast, never take a slot
-                    self.metrics["timeouts"] = (
-                        self.metrics.get("timeouts", 0.0) + 1
-                    )
-                    _timeout_request(candidate)
-                    candidate.out.put(None)
-                    continue
-                request = candidate
+                    return None
+            if (
+                candidate.deadline_ts is not None
+                and time.time() >= candidate.deadline_ts
+            ):
+                # expired while queued: fail fast, never take a slot
+                self.metrics["timeouts"] = (
+                    self.metrics.get("timeouts", 0.0) + 1
+                )
+                _timeout_request(candidate)
+                candidate.out.put(None)
+                continue
+            return candidate
+
+    def _admit(self) -> None:
+        for idx, slot in enumerate(self.slots):
+            if not slot.free:
+                continue
+            if not self._pending and self._queue.empty():
+                continue
+            request = self._next_request()
+            if request is None:
+                return
+            # Prefix reuse: the longest cached page-aligned prefix of the
+            # prompt arrives pre-filled (lookup takes this slot's refs);
+            # only the tail still needs chunk prefill.
+            hit: List[int] = (
+                self.prefix_cache.lookup(request.prompt)
+                if self.prefix_cache is not None else []
+            )
+            # hit pages can be chunk-misaligned, so cap fresh pages at the
+            # block-table width (prefill tops up page-by-page from there)
+            fresh_n = min(
+                self.paged.chunk_pages,
+                self.paged.max_pages_per_slot - len(hit),
+            )
+            pages = self._alloc_pages(fresh_n)
+            if pages is None:
+                if hit:
+                    self.allocator.free(hit)
+                self._pending.appendleft(request)  # keep FIFO order
+                self.metrics["page_stalls"] += 1
+                return
             slot.request = request
-            slot.pages = pages
+            slot.pages = list(hit) + pages
             slot.position = 0
-            slot.prefill_offset = 0
+            slot.prefill_offset = len(hit) * self.paged.page_size
             slot.prefill_t0 = time.time()
             if request.span is not None:
                 request.span.set_attribute(
@@ -538,28 +675,73 @@ class PagedLLMEngine:
             slot.emit_remaining = request.max_tokens
             slot.finished_emit = False
             self.block_tables[idx, :] = 0
-            self.block_tables[idx, : len(pages)] = pages
+            self.block_tables[idx, : len(slot.pages)] = slot.pages
 
     # --------------------------------------------------------------- prefill
 
-    def _prefill_tick(self) -> bool:
-        """Ingest one chunk for EVERY prefilling slot in one batched
-        device call (lanes padded to the next power of two; vLLM batches
-        prefill chunks across sequences the same way) — a burst of
-        admissions prefills together instead of serializing TTFT. Final
-        chunks sample their first tokens on device, batched. Returns True
-        if any chunk ran."""
+    def _ensure_private_page(self, idx: int, slot: _PagedSlot,
+                             page_index: int) -> bool:
+        """Copy-on-write guard before a decode write: if the page at the
+        write frontier is shared (prefix cache pin or another slot), copy
+        its KV stripes to a fresh page, swap the block table, and drop
+        this slot's ref on the shared original. Page-granular sharing plus
+        forward-only writes means the engine never organically writes a
+        shared page today (lookup stops short of the first page a request
+        writes); the guard makes that invariant enforced rather than
+        assumed. Returns False (and stalls the lane) if no page is free
+        for the copy."""
+        if self.prefix_cache is None:
+            return True
+        page = slot.pages[page_index]
+        if page <= 0 or self.allocator.refcount(page) <= 1:
+            return True
+        fresh = self._alloc_pages(1)
+        if fresh is None:
+            if not slot.stalled:
+                slot.stalled = True
+                self.metrics["page_stalls"] += 1
+            return False
+        self.cache = self._copy_page(
+            self.cache, jnp.asarray(page, jnp.int32),
+            jnp.asarray(fresh[0], jnp.int32),
+        )
+        self.allocator.free([page])
+        slot.pages[page_index] = fresh[0]
+        self.block_tables[idx, page_index] = fresh[0]
+        self.metrics["prefix_cache_cow"] += 1
+        return True
+
+    def _mixed_tick(self) -> bool:
+        """THE mixed tick: one ragged-paged-attention device call ingests
+        a chunk for EVERY prefilling slot AND advances every decodable
+        lane one step. Prefill lanes pad to the next power of two (a
+        handful of compiled programs covers every burst size); decode
+        lanes ride along in the same launch instead of waiting behind the
+        prefill backlog, so a burst of long prompts no longer freezes
+        running streams for its whole duration (the split
+        batched-chunk/decode-block dispatch it replaces preferred prefill
+        for whole ticks at a time). Final chunks sample their first
+        tokens on device, batched. Decode-only ticks return False and the
+        K-step fused decode block (steady state) takes over."""
         ct = self.paged.chunk_tokens
         cp = self.paged.chunk_pages
+        ps = self.paged.page_size
+        maxp = self.paged.max_pages_per_slot
+        ms = self.config.max_slots
         work: List[Tuple[int, int, int]] = []  # (slot_idx, offset, first_page)
         for idx, slot in enumerate(self.slots):
             if not slot.prefilling:
                 continue
             offset = slot.prefill_offset
-            first_page = offset // self.paged.page_size
-            need = first_page + cp - len(slot.pages)
+            first_page = offset // ps
+            # a prefix hit can leave first_page chunk-misaligned, so the
+            # chunk's page window may brush the block-table cap: grow only
+            # to the cap — window pages past it stay scratch-mapped, and
+            # only pad rows land there (real tokens always fit in maxp
+            # pages by the submit() capacity check)
+            need = min(first_page + cp, maxp) - len(slot.pages)
             if need > 0:
-                extra = self.allocator.alloc(need)
+                extra = self._alloc_pages(need)
                 if extra is None:
                     slot.stalled = True
                     self.metrics["page_stalls"] += 1
@@ -570,12 +752,10 @@ class PagedLLMEngine:
             work.append((idx, offset, first_page))
         if not work:
             return False
-        # pad the lane count to a power of two: a handful of compiled
-        # programs covers every burst size without per-size recompiles
         b = 1 << (len(work) - 1).bit_length()
-        b = min(b, self.config.max_slots)
+        b = min(b, ms)
         tokens = np.zeros((b, ct), dtype=np.int32)
-        page_rows = np.zeros((b, self.paged.max_pages_per_slot), dtype=np.int32)
+        page_rows = np.zeros((b + ms, maxp), dtype=np.int32)
         chunk_ids = np.zeros((b, cp), dtype=np.int32)  # inactive → scratch 0
         offsets = np.zeros((b,), dtype=np.int32)
         totals = np.zeros((b,), dtype=np.int32)  # 0 = inactive lane
@@ -586,10 +766,49 @@ class PagedLLMEngine:
             self.metrics["prefill_tokens"] += float(n_real)
             tokens[lane, :n_real] = prompt[offset : offset + n_real]
             page_rows[lane] = self.block_tables[idx]
-            chunk_ids[lane] = slot.pages[first_page : first_page + cp]
+            window = slot.pages[first_page : first_page + cp]
+            chunk_ids[lane, : len(window)] = window
             offsets[lane] = offset
             totals[lane] = offset + n_real
-        logits, self.cache = self._batched_chunk(
+        # ---- decode ride-along: every decodable lane advances one step
+        # in the same launch (gated like a decode block: its fetch entry
+        # occupies an inflight slot)
+        dec_positions = np.zeros((ms,), dtype=np.int32)
+        dec_active = np.zeros((ms,), dtype=np.int32)
+        dec_temps = np.zeros((ms,), dtype=np.float32)
+        dec_ks = np.zeros((ms,), dtype=np.int32)
+        dec_ps = np.ones((ms,), dtype=np.float32)
+        dec_lanes: List[Tuple[int, _Request, bool]] = []
+        if self._inflight < self.config.max_inflight_blocks:
+            cap = self.paged.max_slot_tokens
+            for i, slot in enumerate(self.slots):
+                if not slot.decodable:
+                    continue
+                if slot.position + 1 > cap:
+                    slot.done_dispatching = True
+                    continue
+                pages_needed = slot.position // ps + 1
+                if pages_needed > len(slot.pages):
+                    extra = self._alloc_pages(pages_needed - len(slot.pages))
+                    if extra is None:
+                        if not slot.stalled:
+                            slot.stalled = True
+                            self.metrics["page_stalls"] += 1
+                        continue
+                    slot.pages.extend(extra)
+                    self.block_tables[i, : len(slot.pages)] = slot.pages
+                if not self._ensure_private_page(i, slot, slot.position // ps):
+                    continue
+                slot.stalled = False
+                page_rows[b + i] = self.block_tables[i]
+                dec_positions[i] = slot.position
+                dec_active[i] = 1
+                dec_temps[i] = slot.request.temperature
+                dec_ks[i] = slot.request.top_k
+                dec_ps[i] = slot.request.top_p
+                dec_lanes.append((i, slot.request, slot.awaiting_first))
+                slot.awaiting_first = False
+        logits, dec_logits, self.cache = self._mixed(
             self.params,
             self.cache,
             jnp.asarray(page_rows),
@@ -597,8 +816,36 @@ class PagedLLMEngine:
             jnp.asarray(tokens),
             jnp.asarray(offsets),
             jnp.asarray(totals),
+            self._tokens_dev,
+            jnp.asarray(dec_positions),
+            jnp.asarray(dec_active),
         )
-        # bookkeeping + batched first-token sampling for finishing lanes
+        self.metrics["mixed_ticks"] += 1
+        # ---- decode bookkeeping: sample, merge, and ship the pair of
+        # token rows exactly like a K=1 decode block
+        if dec_lanes:
+            self._key, sub = jax.random.split(self._key)
+            sampled = self._sample(
+                dec_logits, sub, jnp.asarray(dec_temps),
+                jnp.asarray(dec_ks), jnp.asarray(dec_ps),
+            )
+            stacked, merged = self._dec_pack(
+                self._tokens_dev, sampled, jnp.asarray(dec_active == 1)
+            )
+            self._tokens_dev = merged
+            _async_fetch(stacked)
+            for i, _, _ in dec_lanes:
+                slot = self.slots[i]
+                slot.position += 1
+                slot.dispatch_remaining -= 1
+                slot.blocks_in_flight += 1
+                if slot.dispatch_remaining <= 0:
+                    slot.done_dispatching = True
+            self._inflight += 1
+            self._fetchq.put(("block", dec_lanes, stacked))
+            self.metrics["decode_blocks"] += 1
+            self.metrics["decode_steps"] += 1
+        # ---- prefill bookkeeping + batched first-token sampling
         lane_slots = np.full((b,), self.config.max_slots, dtype=np.int32)
         temps = np.zeros((b,), dtype=np.float32)
         top_ks = np.zeros((b,), dtype=np.int32)
@@ -626,6 +873,10 @@ class PagedLLMEngine:
                 temps[lane] = request.temperature
                 top_ks[lane] = request.top_k
                 top_ps[lane] = request.top_p
+                if self.prefix_cache is not None:
+                    # publish every page the finished prompt fully covers
+                    # (their KV is final: decode writes start past them)
+                    self.prefix_cache.register(request.prompt, slot.pages)
         if finished:
             self._key, sub = jax.random.split(self._key)
             sampled = self._sample(
@@ -650,6 +901,10 @@ class PagedLLMEngine:
                 else:
                     slot.awaiting_first = True
         return True
+
+    # Historical name: drivers and tests tick prefill through it; it now
+    # runs the full mixed tick (prefill chunks + decode ride-along).
+    _prefill_tick = _mixed_tick
 
     # ---------------------------------------------------------------- decode
 
@@ -682,7 +937,7 @@ class PagedLLMEngine:
                 continue
             pages_needed = (slot.position + useful - 1) // ps + 1
             if pages_needed > len(slot.pages):
-                extra = self.allocator.alloc(pages_needed - len(slot.pages))
+                extra = self._alloc_pages(pages_needed - len(slot.pages))
                 if extra is None:
                     if not slot.stalled:
                         slot.stalled = True
@@ -690,6 +945,12 @@ class PagedLLMEngine:
                     continue
                 slot.pages.extend(extra)
                 self.block_tables[i, : len(slot.pages)] = slot.pages
+            # COW: every page this block will write must be privately held
+            if not all(
+                self._ensure_private_page(i, slot, pi)
+                for pi in range(slot.position // ps, pages_needed)
+            ):
+                continue
             slot.stalled = False
             bt[i] = self.block_tables[i]
             positions[i] = slot.position
@@ -917,6 +1178,8 @@ class PagedLLMEngine:
             self._loop_inner()
         except BaseException as exc:  # noqa: BLE001 - engine death boundary
             self._death_cause = exc
+            while self._pending:  # deferred admissions fail like queued ones
+                self._queue.put(self._pending.popleft())
             _fail_all_requests(self.slots, self._queue, exc)
             raise
 
@@ -946,11 +1209,20 @@ class PagedLLMEngine:
                 if slot.request is not None and not slot.prefilling:
                     self._maybe_retire(i, slot.request)
             occupied = sum(1 for s in self.slots if not s.free)
-            self.metrics["ongoing"] = occupied + self._queue.qsize()
+            self.metrics["ongoing"] = (
+                occupied + self._queue.qsize() + len(self._pending)
+            )
             self.metrics["pages_in_use"] = float(
                 pc.num_pages - 1 - self.allocator.available
             )
             self.metrics["batch_fill"] = occupied / max(len(self.slots), 1)
+            if self.prefix_cache is not None:
+                pcs = self.prefix_cache.stats()
+                self.metrics["prefix_cache_hits"] = pcs["hits"]
+                self.metrics["prefix_cache_misses"] = pcs["misses"]
+                self.metrics["prefix_cache_evictions"] = pcs["evictions"]
+                self.metrics["prefix_cache_pages"] = pcs["pages"]
+                self.metrics["prefix_cache_hit_rate"] = pcs["hit_rate"]
             if progressed:
                 _observe_tick(self, time.perf_counter() - tick_t0)
             if occupied == 0 and not self._inflight:

@@ -89,6 +89,7 @@ EVENT_KINDS: Dict[str, str] = {
     "serve.deploy": "a serve deployment was (re)deployed",
     "serve.scaled": "a deployment scaled its replica count",
     "serve.drain": "a serve replica began draining",
+    "serve.autoscale": "the serve autoscaler changed a replica target",
     # chaos
     "chaos.injected": "a chaos injection fired (delay/failure/kill/preempt)",
     # watchdogs

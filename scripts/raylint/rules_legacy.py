@@ -343,6 +343,10 @@ REQUIRED_FLAGS = (
     "dp_allreduce_dtype",
     "dp_shard_update",
     "dp_quant_block",
+    # serve throughput round (ragged kernel + SLO autoscaler)
+    "serve_ragged_kernel",
+    "autoscale_burn_windows",
+    "autoscale_pressure_floor",
 )
 
 # RayTpuConfig API that is not a flag read

@@ -1,0 +1,318 @@
+"""Prefix/KV-cache reuse: refcounted allocator, page-level prefix cache,
+copy-on-write guard, and the engine's end-to-end reuse path.
+
+The load-bearing invariants (vLLM's automatic prefix caching, adapted to
+the flat TPU page pool):
+- a physical page may back several block tables at once; it returns to
+  the free list only when the LAST holder releases it;
+- the cache holds exactly one pin per entry, live slots take their own
+  refs through `lookup`, and eviction never touches a page a slot holds;
+- a slot about to WRITE a shared page copies it first (COW) — never
+  observable through the public API today (sharing is page-granular and
+  writes are forward-only), so these tests manufacture sharing directly.
+"""
+
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from ray_tpu.core.exceptions import RequestTimeoutError
+from ray_tpu.models import get_config, init_params
+from ray_tpu.serve.llm.paged import PagedConfig, PageAllocator, PrefixCache
+from ray_tpu.serve.llm.paged_engine import PagedEngineConfig, PagedLLMEngine
+
+from tests.test_paged_engine import _greedy_reference
+
+
+def _prefix_engine(model="llama-tiny", seed=0, **paged_over):
+    config = get_config(model)
+    params = init_params(config, jax.random.PRNGKey(seed))
+    paged = dict(
+        page_size=8, num_pages=64, max_pages_per_slot=8, chunk_pages=2,
+        prefix_cache=True,
+    )
+    paged.update(paged_over)
+    engine = PagedLLMEngine(
+        config, params,
+        PagedEngineConfig(max_slots=4, paged=PagedConfig(**paged)),
+    )
+    return config, params, engine
+
+
+# ----------------------------------------------------------------- allocator
+
+
+def test_refcount_shared_page_freed_only_at_last_holder():
+    a = PageAllocator(num_pages=8)
+    pages = a.alloc(2)
+    assert a.refcount(pages[0]) == 1
+    a.share([pages[0]])
+    assert a.refcount(pages[0]) == 2
+    a.free(pages)          # slot retires: shared page keeps one holder
+    assert a.refcount(pages[0]) == 1
+    assert a.refcount(pages[1]) == 0
+    assert a.available == 6
+    a.free([pages[0]])     # last holder lets go: page recycles
+    assert a.available == 7
+    assert pages[0] in a.alloc(7)
+
+
+def test_share_of_unallocated_page_raises():
+    a = PageAllocator(num_pages=4)
+    with pytest.raises(ValueError, match="unallocated"):
+        a.share([2])
+    p = a.alloc(1)
+    a.free(p)
+    with pytest.raises(ValueError, match="unallocated"):
+        a.share(p)  # freed: resurrecting it would corrupt the next owner
+
+
+def test_scratch_page_never_refcounted():
+    a = PageAllocator(num_pages=4)
+    a.share([0])
+    a.free([0])
+    a.free([0])
+    assert a.refcount(0) == 0
+    assert a.available == 3
+    assert 0 not in a.alloc(3)
+
+
+def test_double_free_guard_survives_refcounting():
+    a = PageAllocator(num_pages=4)
+    p = a.alloc(1)
+    a.free(p)
+    a.free(p)  # buggy second free: ignored, not a second free-list entry
+    assert a.available == 3
+    got = a.alloc(3)
+    assert len(set(got)) == 3
+
+
+# -------------------------------------------------------------- prefix cache
+
+
+def test_lookup_leaves_at_least_one_token_to_prefill():
+    """Even a fully cached prompt must re-prefill its last token — its
+    logits seed sampling (vLLM caps its hit identically)."""
+    a = PageAllocator(num_pages=16)
+    cache = PrefixCache(a, page_size=4)
+    prompt = [1, 2, 3, 4, 5, 6, 7, 8]
+    pages = a.alloc(2)
+    assert cache.register(prompt, pages) == 2
+    assert a.refcount(pages[0]) == 2  # cache pin on top of the slot's ref
+    # exactly 2 pages of prompt: at most ONE page may be reused
+    hit = cache.lookup(prompt)
+    assert hit == [pages[0]]
+    assert a.refcount(pages[0]) == 3  # caller took its own ref
+    # longer prompt sharing the prefix reuses both pages
+    hit2 = cache.lookup(prompt + [9])
+    assert hit2 == pages
+    stats = cache.stats()
+    assert stats["hits"] == 3.0 and stats["hit_rate"] > 0.5
+
+
+def test_lookup_stops_at_first_divergent_page():
+    a = PageAllocator(num_pages=16)
+    cache = PrefixCache(a, page_size=4)
+    pages = a.alloc(3)
+    cache.register([1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12], pages)
+    hit = cache.lookup([1, 2, 3, 4, 99, 6, 7, 8, 9, 10, 11, 12, 13])
+    assert hit == [pages[0]]  # page 2 diverges: chain hash misses
+
+
+def test_eviction_is_lru_and_skips_pinned_pages():
+    a = PageAllocator(num_pages=16)
+    cache = PrefixCache(a, page_size=4)
+    pa = a.alloc(1)
+    pb = a.alloc(1)
+    cache.register([1, 2, 3, 4], pa)
+    cache.register([5, 6, 7, 8], pb)
+    a.free(pa)
+    a.free(pb)  # both now held only by the cache
+    a.share(pa)  # ...then a "live slot" pins the LRU entry
+    assert cache.evict(2) == 1  # only the unpinned page drops
+    assert a.refcount(pa[0]) == 2  # pinned entry survived the sweep
+    assert a.refcount(pb[0]) == 0
+    assert cache.lookup([1, 2, 3, 4, 0]) == pa  # still cached
+    assert cache.stats()["evictions"] == 1.0
+
+
+def test_capacity_cap_stops_register_and_evicts_when_unpinned():
+    a = PageAllocator(num_pages=16)
+    cache = PrefixCache(a, page_size=4, capacity_pages=2)
+    pages = a.alloc(3)
+    added = cache.register([1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12], pages)
+    # third entry blocked: capacity full and both entries are pinned by
+    # the registering slot itself (live refs), so nothing can evict yet
+    assert added == 2
+    assert len(cache) == 2
+    assert a.refcount(pages[2]) == 1  # no cache pin taken on the overflow
+    a.free(pages)  # slot retires: only the cache pins remain
+    other = a.alloc(1)
+    assert cache.register([9, 9, 9, 9], other) == 1  # now LRU evicts
+    assert len(cache) == 2
+    assert cache.stats()["evictions"] == 1.0
+
+
+# ------------------------------------------------------- engine: reuse path
+
+
+def test_engine_prefix_reuse_matches_greedy_and_counts_hits():
+    """End-to-end: a repeated prompt and a shared-prefix prompt both reuse
+    cached KV pages AND still emit exactly the unpaged greedy tokens —
+    reuse is a latency optimization, never a semantics change."""
+    config, params, engine = _prefix_engine()
+    try:
+        prompt = [int(t) for t in
+                  np.random.default_rng(5).integers(1, 200, size=20)]
+        first = engine.generate(prompt, max_tokens=6)
+        assert first == _greedy_reference(config, params, prompt, 6)
+        base = engine.stats()
+        assert base["prefix_cache_pages"] >= 2.0  # 16/8 full prompt pages
+        assert base["prefix_cache_hits"] == 0.0
+
+        # identical prompt: both full pages come from the cache
+        again = engine.generate(prompt, max_tokens=6)
+        assert again == first
+        stats = engine.stats()
+        assert stats["prefix_cache_hits"] >= 2.0
+        assert stats["prefix_cache_hit_rate"] > 0.0
+
+        # shared system prefix, different tail: cached pages + fresh KV
+        forked = prompt[:16] + [int(t) for t in
+                                np.random.default_rng(9).integers(1, 200, 8)]
+        got = engine.generate(forked, max_tokens=6)
+        assert got == _greedy_reference(config, params, forked, 6)
+        assert engine.stats()["prefix_cache_hits"] >= 4.0
+    finally:
+        engine.shutdown()
+
+
+def test_engine_alloc_under_pressure_evicts_cache_not_admissions():
+    """Pool exhaustion with cache-pinned pages: admission reclaims LRU
+    cache pages instead of stalling behind retired prompts forever."""
+    config = get_config("llama-tiny")
+    params = init_params(config, jax.random.PRNGKey(0))
+    engine = PagedLLMEngine(
+        config, params,
+        PagedEngineConfig(max_slots=2, paged=PagedConfig(
+            page_size=8, num_pages=10, max_pages_per_slot=4, chunk_pages=1,
+            prefix_cache=True,
+        )),
+    )
+    try:
+        prompt = [int(t) for t in
+                  np.random.default_rng(1).integers(1, 200, size=16)]
+        engine.generate(prompt, max_tokens=4)
+        assert engine.stats()["prefix_cache_pages"] >= 2.0
+        # starve the free list so the next admission MUST evict
+        hoard = engine.allocator.alloc(engine.allocator.available)
+        assert hoard
+        fresh = [int(t) for t in
+                 np.random.default_rng(2).integers(200, 400, size=8)]
+        got = engine.submit(fresh, max_tokens=4).result(timeout=60)
+        assert got == _greedy_reference(config, params, fresh, 4)
+        assert engine.stats()["prefix_cache_evictions"] >= 1.0
+        engine.allocator.free(hoard)
+    finally:
+        engine.shutdown()
+
+
+# -------------------------------------------- engine: COW + deadline (manual)
+
+
+def _manual_engine(monkeypatch, **paged_over):
+    monkeypatch.setattr(PagedLLMEngine, "_loop", lambda self: None)
+    return _prefix_engine(**paged_over)
+
+
+def test_cow_guard_copies_shared_page_and_drops_ref(monkeypatch):
+    """_ensure_private_page on a shared page: fresh page swapped into the
+    block table, the shared original keeps its other holder, and the COW
+    metric ticks. Sharing is manufactured via allocator.share — the engine
+    never organically writes a shared page (page-granular lookup stops
+    short of the first written page)."""
+    config, params, engine = _manual_engine(monkeypatch)
+    try:
+        engine.submit([5, 17, 42, 7, 3, 11, 9, 2, 8], max_tokens=4)
+        engine._admit()
+        slot = engine.slots[0]
+        while slot.prefilling:
+            assert engine._prefill_tick()
+        victim = slot.pages[0]
+        # prefill registered the one full prompt page: cache pin is live
+        assert engine.allocator.refcount(victim) == 2
+        engine.allocator.share([victim])  # simulate another holder
+        assert engine._ensure_private_page(0, slot, 0)
+        assert slot.pages[0] != victim
+        assert engine.block_tables[0, 0] == slot.pages[0]
+        assert engine.allocator.refcount(victim) == 2  # our ref dropped
+        assert engine.allocator.refcount(slot.pages[0]) == 1
+        assert engine.metrics["prefix_cache_cow"] == 1.0
+        # private and scratch pages short-circuit without copying
+        assert engine._ensure_private_page(0, slot, 0)
+        assert engine.metrics["prefix_cache_cow"] == 1.0
+        engine.allocator.free([victim])
+    finally:
+        engine.shutdown()
+
+
+def test_cow_guard_stalls_lane_when_pool_exhausted(monkeypatch):
+    config, params, engine = _manual_engine(monkeypatch)
+    try:
+        engine.submit([5, 17, 42, 7, 3, 11, 9, 2, 8], max_tokens=4)
+        engine._admit()
+        slot = engine.slots[0]
+        while slot.prefilling:
+            assert engine._prefill_tick()
+        engine.allocator.share([slot.pages[0]])
+        hoard = engine.allocator.alloc(engine.allocator.available)
+        assert not engine._ensure_private_page(0, slot, 0)
+        assert slot.stalled
+        assert engine.metrics["page_stalls"] >= 1.0
+        engine.allocator.free(hoard)
+        engine.allocator.free([slot.pages[0]])
+    finally:
+        engine.shutdown()
+
+
+def test_deadline_sweep_releases_refs_but_keeps_cache_entries(monkeypatch):
+    """A slot evicted by the deadline sweep releases its refs through the
+    refcounted free path: shared prefix pages drop back to their cache pin
+    (NOT the free list), fresh pages recycle, and the cache still hits."""
+    config, params, engine = _manual_engine(monkeypatch)
+    try:
+        prompt = [int(t) for t in
+                  np.random.default_rng(3).integers(1, 200, size=20)]
+        # A prefills fully -> registers the 2 full prompt pages
+        a_stream = engine.submit(prompt, max_tokens=4)
+        engine._admit()
+        slot_a = engine.slots[0]
+        while slot_a.prefilling:
+            assert engine._prefill_tick()
+        cached = engine.prefix_cache.lookup(prompt)  # probe: take + return refs
+        assert len(cached) == 2
+        engine.allocator.free(cached)
+        # B reuses them (refs now: A + cache + B = 3 per shared page)
+        b_stream = engine.submit(prompt, max_tokens=4,
+                                 deadline_ts=time.time() + 30)
+        engine._admit()
+        slot_b = engine.slots[1]
+        assert slot_b.pages[:2] == cached
+        assert engine.allocator.refcount(cached[0]) == 3
+        n_b_pages = len(slot_b.pages)
+        free_before = engine.allocator.available
+        # B's deadline expires: sweep retires it through the refcounted path
+        slot_b.request.deadline_ts = time.time() - 1.0
+        engine._deadline_sweep()
+        with pytest.raises(RequestTimeoutError):
+            b_stream.result(timeout=5)
+        assert engine.slots[1].free
+        assert engine.allocator.refcount(cached[0]) == 2  # A + cache pin
+        # only B's PRIVATE pages returned to the free list
+        assert engine.allocator.available == free_before + (n_b_pages - 2)
+        assert engine.prefix_cache.lookup(prompt) == cached  # entries intact
+        engine.allocator.free(cached)
+    finally:
+        engine.shutdown()

@@ -1,0 +1,175 @@
+"""Ragged paged-attention parity drill (tier-1, interpret mode).
+
+The contract under test (ray_tpu/ops/ragged_paged_attention.py): the
+Pallas kernel run in interpret mode and the XLA schedule-replay
+reference are BIT-EXACT at f32 — the reference replays the kernel's
+block schedule op for op (same dot shapes, same mask constant, same
+online-softmax update order), so TPU-vs-CPU numerics questions reduce
+to Mosaic codegen, never to algorithm drift. A dense per-sequence
+softmax pins the semantics both agree on.
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.ops.ragged_paged_attention import (
+    ragged_paged_attention,
+    ragged_reference_attention,
+)
+from ray_tpu.serve.llm.paged import paged_attention
+
+BQ = 8
+
+
+def _mixed_batch(seed=0, Hq=4, Hkv=2, D=16, ps=8, pool=32, maxP=6):
+    """The canonical mixed ragged batch: two prefill chunks (one
+    page-misaligned, one chunk-aligned continuation), two decode lanes
+    (one mid-sequence, one nearly fresh), one inactive lane."""
+    rng = np.random.default_rng(seed)
+    q_lens = np.array([13, 16, 1, 1, 0], np.int32)
+    kv_lens = np.array([13, 48, 37, 5, 0], np.int32)
+    counts = np.array([2, 2, 1, 1, 1], np.int32)
+    starts = np.concatenate([[0], np.cumsum(counts)[:-1]]).astype(np.int32)
+    t = int(counts.sum()) * BQ
+    tables = np.zeros((5, maxP), np.int32)
+    nxt = 1
+    for s in range(5):
+        for j in range((int(kv_lens[s]) + ps - 1) // ps):
+            tables[s, j] = nxt
+            nxt += 1
+    assert nxt <= pool
+    q = rng.standard_normal((Hq, t, D)).astype(np.float32)
+    kp = rng.standard_normal((Hkv, pool, ps, D)).astype(np.float32)
+    vp = rng.standard_normal((Hkv, pool, ps, D)).astype(np.float32)
+    return (
+        jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+        jnp.asarray(starts), jnp.asarray(counts),
+        jnp.asarray(q_lens), jnp.asarray(kv_lens), jnp.asarray(tables),
+    ), (q, kp, vp, starts, counts, q_lens, kv_lens, tables)
+
+
+def test_interpret_kernel_bitwise_matches_reference():
+    """The tier-1 parity drill: mixed prefill+decode ragged batch,
+    interpret-mode Pallas kernel vs the XLA reference, f32, bit-exact."""
+    args, _ = _mixed_batch()
+    out_kernel = np.asarray(
+        ragged_paged_attention(*args, block_q=BQ, interpret=True)
+    )
+    out_ref = np.asarray(
+        ragged_paged_attention(*args, block_q=BQ, use_kernel=False)
+    )
+    assert np.array_equal(out_kernel, out_ref), (
+        "interpret kernel and schedule-replay reference diverged "
+        f"(max diff {np.abs(out_kernel - out_ref).max()})"
+    )
+
+
+def test_reference_matches_dense_softmax_per_sequence():
+    """Semantic ground truth: every active sequence's valid rows equal a
+    dense causal softmax over its own pages (GQA repeat, positions
+    kv_len - q_len + row)."""
+    args, (q, kp, vp, starts, counts, q_lens, kv_lens, tables) = _mixed_batch()
+    out = np.asarray(ragged_paged_attention(*args, block_q=BQ, use_kernel=False))
+    d = q.shape[-1]
+    groups = q.shape[0] // kp.shape[0]
+    for s in range(len(q_lens)):
+        ql, kl = int(q_lens[s]), int(kv_lens[s])
+        if ql == 0:
+            continue
+        rows = slice(int(starts[s]) * BQ, int(starts[s]) * BQ + ql)
+        k_seq = np.repeat(kp[:, tables[s]].reshape(kp.shape[0], -1, d)[:, :kl],
+                          groups, 0)
+        v_seq = np.repeat(vp[:, tables[s]].reshape(vp.shape[0], -1, d)[:, :kl],
+                          groups, 0)
+        logits = np.einsum("hqd,hkd->hqk", q[:, rows] / math.sqrt(d), k_seq)
+        pos = kl - ql + np.arange(ql)
+        logits = np.where(
+            (np.arange(kl)[None, :] <= pos[:, None])[None], logits, -1e30
+        )
+        p = np.exp(logits - logits.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        ref = np.einsum("hqk,hkd->hqd", p, v_seq)
+        np.testing.assert_allclose(ref, out[:, rows], atol=2e-5, rtol=2e-5)
+
+
+def test_pad_rows_finite_and_inactive_lanes_zero():
+    """Pad rows beyond each region's q_len are finite and deterministic
+    (bitwise-pinned by the parity drill above — never NaN, never read by
+    callers); fully inactive lanes (q_len == 0) come back as exact zeros
+    (the finalize guard skips them, leaving the zero-initialized output)."""
+    args, (_, _, _, starts, counts, q_lens, _, _) = _mixed_batch()
+    out = np.asarray(ragged_paged_attention(*args, block_q=BQ, interpret=True))
+    assert np.isfinite(out).all()
+    for s in range(len(q_lens)):
+        if int(q_lens[s]) == 0:
+            lo = int(starts[s]) * BQ
+            hi = lo + int(counts[s]) * BQ
+            assert np.all(out[:, lo:hi] == 0.0), f"inactive seq {s} not zeroed"
+
+
+def test_tp2_shard_map_bitwise_matches_single_device():
+    """Satellite: the shard_map-wrapped TP path over a tp=2 CPU mesh is
+    bitwise identical to the single-device kernel — heads split across
+    shards, each runs the same schedule on its local group."""
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 CPU devices (conftest forces 8)")
+    from jax.sharding import Mesh
+
+    args, _ = _mixed_batch(seed=3)
+    mesh = Mesh(np.array(jax.devices()[:2]), ("tp",))
+    out_tp = ragged_paged_attention(*args, block_q=BQ, interpret=True,
+                                    mesh=mesh)
+    out_one = ragged_paged_attention(*args, block_q=BQ, interpret=True)
+    assert np.array_equal(np.asarray(out_tp), np.asarray(out_one))
+
+
+def test_decode_paged_attention_kernel_path_with_tp_mesh():
+    """Satellite regression for the old `use_kernel = False if tp_active`
+    pessimization: paged_attention's kernel path must run (and agree with
+    the gather reference) under a tp=2 mesh."""
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 CPU devices (conftest forces 8)")
+    from jax.sharding import Mesh
+
+    rng = np.random.default_rng(7)
+    b, hq, hkv, d, ps, pool, maxp = 3, 4, 2, 16, 8, 16, 4
+    q = jnp.asarray(rng.standard_normal((b, hq, d)), jnp.float32)
+    kc = jnp.asarray(rng.standard_normal((hkv, pool, ps, d)), jnp.float32)
+    vc = jnp.asarray(rng.standard_normal((hkv, pool, ps, d)), jnp.float32)
+    tables = np.zeros((b, maxp), np.int32)
+    tables[0, :2] = [1, 2]
+    tables[1, :3] = [3, 4, 5]
+    tables[2, :1] = [6]
+    lengths = jnp.asarray([11, 20, 3], jnp.int32)
+    mesh = Mesh(np.array(jax.devices()[:2]), ("tp",))
+    out_kernel = paged_attention(
+        q, kc, vc, jnp.asarray(tables), lengths, page_size=ps,
+        interpret=True, mesh=mesh,
+    )
+    out_ref = paged_attention(
+        q, kc, vc, jnp.asarray(tables), lengths, page_size=ps,
+        use_kernel=False,
+    )
+    np.testing.assert_allclose(
+        np.asarray(out_kernel), np.asarray(out_ref), atol=2e-5, rtol=2e-5
+    )
+
+
+def test_gather_reference_helper_matches_dispatcher():
+    """ragged_reference_attention (the exported schedule-replay helper)
+    and the use_kernel=False dispatcher path agree bitwise — callers may
+    use either as the pinned reference."""
+    args, _ = _mixed_batch(seed=11)
+    q, kp, vp, starts, counts, q_lens, kv_lens, tables = args
+    sm = 1.0 / math.sqrt(q.shape[-1])
+    direct = ragged_reference_attention(
+        (q.astype(jnp.float32) * sm).astype(q.dtype), kp, vp,
+        starts, counts, q_lens, kv_lens, tables,
+        block_q=BQ, max_q_blocks=int(q.shape[1]) // BQ,
+    )
+    dispatched = ragged_paged_attention(*args, block_q=BQ, use_kernel=False)
+    assert np.array_equal(np.asarray(direct), np.asarray(dispatched))

@@ -1,0 +1,190 @@
+"""SLO-driven replica autoscaling drill.
+
+The scenario the burn term exists for: latency is burning (TTFT p99 over
+objective) while the ongoing-request count still looks fine — queued work
+waiting on slow TTFT registers as few ongoing requests, so the reference
+heuristic never scales. The drill injects a burn window into the
+ServeSLOMonitor ledger under real-but-light demand, watches the
+controller scale UP one replica with reason "slo_burn", then go idle and
+scale back DOWN through the graceful drain path — the whole episode
+reconstructable afterward from the event log and the
+raytpu_serve_slo_attainment gauge alone.
+"""
+
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.core.config import cfg
+from ray_tpu.util.events import events
+from ray_tpu.util.metrics import get_or_create_histogram, registry
+from ray_tpu.util.watchdog import serve_slo_monitor
+
+# boundaries must match the span-derived histogram (tracing.py) so the
+# drill hits the registered series instead of shadowing it
+_TTFT_BOUNDS = (0.005, 0.025, 0.1, 0.5, 2.0, 10.0)
+
+
+@pytest.fixture
+def rt():
+    runtime = ray_tpu.init(num_cpus=8, detect_accelerators=False)
+    cfg.set(
+        serve_slo_ttft_p99_s=0.1,
+        autoscale_burn_windows=1,
+        autoscale_pressure_floor=0.25,
+    )
+    yield runtime
+    serve.shutdown()
+    ray_tpu.shutdown()
+    cfg.reset()
+
+
+def _burn_one_window(n: int = 32) -> None:
+    """Feed the TTFT histogram n samples far over the 0.1s objective and
+    run one monitor evaluation: exactly one new violated window."""
+    hist = get_or_create_histogram(
+        "raytpu_serve_ttft_seconds",
+        "Time to first generated token, from engine request spans.",
+        boundaries=_TTFT_BOUNDS,
+    )
+    for _ in range(n):
+        hist.observe(5.0)
+    report = serve_slo_monitor().check()
+    assert report.get("ttft_p99", 0.0) > 0.1
+
+
+def _wait(predicate, timeout=20.0, msg=""):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(0.05)
+    pytest.fail(msg or "condition not reached in time")
+
+
+def test_slo_burn_scales_up_then_idle_drains_down(rt):
+    release = threading.Event()
+
+    @serve.deployment
+    class Sticky:
+        def __call__(self, x):
+            release.wait(timeout=60)
+            return x
+
+    auto = serve.AutoscalingConfig(
+        min_replicas=1, max_replicas=3,
+        # generous ongoing target: 2 in-flight requests = desired 0.5
+        # replicas, so the BASE heuristic never asks for a second one —
+        # only the SLO term can (and 0.5 demand clears the 0.25 floor)
+        target_ongoing_requests=4.0,
+        scale_down_delay_s=1.0,
+        slo_driven=True,
+    )
+    t0 = time.time()
+    handle = serve.run(
+        Sticky.options(name="sticky", autoscaling=auto).bind()
+    )
+    # prime: one monitor pass + one autoscale pass absorb any violation
+    # history from earlier tests into the per-deployment high-water mark
+    serve_slo_monitor().check()
+    time.sleep(0.6)
+    assert serve.status()["sticky"]["target_replicas"] == 1
+
+    refs = [handle.remote(i) for i in range(2)]  # light, real demand
+    _wait(lambda: serve.status()["sticky"]["ongoing"] >= 2,
+          msg=f"demand never registered: {serve.status()}")
+
+    _burn_one_window()
+    _wait(lambda: serve.status()["sticky"]["target_replicas"] >= 2,
+          msg=f"burn never scaled up: {serve.status()}")
+    _wait(lambda: serve.status()["sticky"]["live_replicas"] >= 2,
+          msg=f"second replica never started: {serve.status()}")
+
+    # drain the demand: idle deployment must come back down -- and must
+    # do it through the DRAINING path, not a kill
+    release.set()
+    ray_tpu.get(refs, timeout=60)
+    _wait(lambda: serve.status()["sticky"]["target_replicas"] == 1,
+          timeout=30, msg=f"never scaled back down: {serve.status()}")
+    _wait(lambda: serve.status()["sticky"]["live_replicas"] == 1
+          and serve.status()["sticky"]["draining_replicas"] == 0,
+          timeout=30, msg=f"drain never completed: {serve.status()}")
+
+    # ---- postmortem: the episode must be reconstructable from the event
+    # log + the attainment gauge, with no access to the live controller
+    log = events().list(kind="serve.autoscale", since_ts=t0, limit=100)
+    ups = [e for e in log if e["extra"]["direction"] == "up"]
+    downs = [e for e in log if e["extra"]["direction"] == "down"]
+    assert ups and ups[0]["extra"]["reason"] == "slo_burn", log
+    assert ups[0]["extra"]["burn_windows"] >= 1
+    assert ups[0]["extra"]["target_replicas"] == 2
+    assert downs and downs[-1]["extra"]["target_replicas"] == 1
+    scaled = events().list(kind="serve.scaled", since_ts=t0, limit=100)
+    assert any(e["extra"]["direction"] == "up" for e in scaled)
+    assert any(e["extra"]["direction"] == "down" for e in scaled)
+    drains = events().list(kind="serve.drain", since_ts=t0, limit=100)
+    assert drains, "scale-down bypassed the graceful drain path"
+    gauge = registry().get("raytpu_serve_slo_attainment")
+    assert gauge is not None
+    attained = {t.get("slo"): v for t, v in gauge.collect()}
+    assert attained.get("ttft_p99", 1.0) < 1.0  # the burn left a record
+
+
+def test_burn_resets_scale_down_damper(rt):
+    """A burning deployment must never shed capacity: burn windows during
+    the scale-down delay push the damper forward instead of letting the
+    idle target drop."""
+
+    @serve.deployment
+    class Quick:
+        def __call__(self, x):
+            return x
+
+    auto = serve.AutoscalingConfig(
+        min_replicas=1, max_replicas=3, target_ongoing_requests=1.0,
+        scale_down_delay_s=0.4, slo_driven=True,
+    )
+    serve.run(Quick.options(name="quick", autoscaling=auto).bind())
+    serve_slo_monitor().check()
+    time.sleep(0.6)
+
+    from ray_tpu.serve import api as serve_api
+
+    state = serve_api._controller._states["quick"]
+    state.target_replicas = 2  # as if a previous burn scaled us up
+    # keep burning while idle: the damper must keep resetting
+    for _ in range(4):
+        _burn_one_window()
+        time.sleep(0.3)
+        assert serve.status()["quick"]["target_replicas"] == 2, (
+            "burning deployment shed capacity"
+        )
+    # burn stops: the idle scale-down finally lands after the delay
+    _wait(lambda: serve.status()["quick"]["target_replicas"] == 1,
+          timeout=30, msg=f"idle scale-down never landed: {serve.status()}")
+
+
+def test_pressure_floor_gates_burn_scale_up(rt):
+    """An SLO burn with NO demand behind it (idle deployment, empty
+    batches) must not scale up — cold-start artifacts and stray burns
+    don't buy replicas."""
+
+    @serve.deployment
+    class Idle:
+        def __call__(self, x):
+            return x
+
+    auto = serve.AutoscalingConfig(
+        min_replicas=1, max_replicas=3, target_ongoing_requests=4.0,
+        scale_down_delay_s=1.0, slo_driven=True,
+    )
+    serve.run(Idle.options(name="idle", autoscaling=auto).bind())
+    serve_slo_monitor().check()
+    time.sleep(0.6)
+
+    _burn_one_window()
+    time.sleep(1.0)  # several reconcile passes
+    assert serve.status()["idle"]["target_replicas"] == 1, serve.status()
