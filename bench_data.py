@@ -1,0 +1,255 @@
+"""Streaming data plane benchmark: chaos-perturbed ingest + gang feed.
+
+Three driver-measured phases, one BENCH JSON line (the PR 12 acceptance
+numbers — nothing here is self-reported by the pipeline under test):
+
+  A. ingest      — distributed streaming read→map over a 4-node
+                   in-process cluster: rows/s, bytes/s, locality hit
+                   rate. vs_baseline compares against the same plan run
+                   driver-local (prefetch window 1, locality off) — the
+                   pre-PR-12 iterator shape.
+  B. capstone    — chaos-perturbed (delay injection on the map stage)
+                   streaming_split gang feed into a 2-worker LMTrainer
+                   gang via train.get_dataset_shard: input_wait
+                   fraction from the goodput accountant, stall-watchdog
+                   silence, rows exactly-once across the gang.
+  C. spill drill — tiny object store + tiny in-flight byte budget:
+                   ingest must spill (spilled_bytes > 0), in-flight
+                   bytes must never exceed the budget, and the rows
+                   must match the unconstrained run exactly.
+
+Run: JAX_PLATFORMS=cpu python bench_data.py
+"""
+
+from __future__ import annotations
+
+import json
+import tempfile
+import time
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu import data
+from ray_tpu.core.chaos import clear_chaos, num_injected, set_chaos
+from ray_tpu.data.dataset import DataContext
+
+ROWS = 200_000
+NUM_BLOCKS = 32
+SEQ_LEN = 16
+BATCH_SIZE = 4
+TRAIN_STEPS = 8
+
+
+def tokenize_block(block):
+    """The "tokenizer" map stage: light compute plus ~10ms of simulated
+    I/O latency per block (remote shard fetch / tokenizer service call —
+    the thing an ingest stage actually waits on). The in-flight window
+    overlaps these waits; a serial driver loop pays them end to end.
+    The name is the chaos name_filter target in phase B."""
+    time.sleep(0.01)
+    toks = block["tokens"].astype(np.int64)
+    acc = (toks * 6364136223846793005 + 1442695040888963407) ^ toks
+    return {"tokens": (acc % 255).astype(np.int32)}
+
+
+def token_dataset() -> data.Dataset:
+    rng = np.random.default_rng(0)
+    return data.from_numpy(
+        {"tokens": rng.integers(0, 255, ROWS).astype(np.int32)},
+        num_blocks=NUM_BLOCKS,
+    ).map_batches(tokenize_block)
+
+
+def drain(ds: data.Dataset):
+    """Driver-side full consumption; returns (rows, bytes, seconds)."""
+    rows = nbytes = 0
+    t0 = time.perf_counter()
+    for block in ds.iter_blocks():
+        col = block["tokens"]
+        rows += int(col.shape[0])
+        nbytes += int(col.nbytes)
+    return rows, nbytes, time.perf_counter() - t0
+
+
+def drain_serial():
+    """The pre-PR-12 shape: the driver submits one task at a time and
+    materializes every block locally before touching the next — no
+    in-flight window, no pipelining across stages, no consumer-side
+    prefetch thread, no locality routing."""
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, 255, ROWS).astype(np.int32)
+    bounds = np.linspace(0, ROWS, NUM_BLOCKS + 1).astype(int)
+    read = ray_tpu.remote(lambda lo, hi: {"tokens": tokens[lo:hi]})
+    tok = ray_tpu.remote(tokenize_block)
+    rows = 0
+    t0 = time.perf_counter()
+    for lo, hi in zip(bounds[:-1], bounds[1:]):
+        block = ray_tpu.get(tok.remote(ray_tpu.get(read.remote(lo, hi))))
+        rows += int(block["tokens"].shape[0])
+    return rows, time.perf_counter() - t0
+
+
+# --------------------------------------------------------------- A: ingest
+
+
+def phase_ingest():
+    ray_tpu.init(num_cpus=8, num_nodes=4, detect_accelerators=False)
+    try:
+        base_rows, base_s = drain_serial()
+
+        ds = token_dataset()
+        rows, nbytes, took = drain(ds)
+        stats = ds.stats() or {}
+        assert rows == base_rows == ROWS, (rows, base_rows)
+        return {
+            "rows_per_s": round(rows / took, 1),
+            "bytes_per_s": round(nbytes / took, 1),
+            "rows": rows,
+            "blocks": stats.get("blocks_consumed"),
+            "locality_hit_rate": stats.get("locality_hit_rate"),
+            "backpressure_stall_s": stats.get("backpressure_stall_s"),
+            "baseline_rows_per_s": round(base_rows / base_s, 1),
+            "speedup": round(base_s / took, 3),
+        }
+    finally:
+        ray_tpu.shutdown()
+
+
+# ------------------------------------------------------------- B: capstone
+
+
+def train_loop(config):
+    import ray_tpu.data as rd
+    from ray_tpu import train
+    from ray_tpu.models import get_config
+    from ray_tpu.train import LMTrainer
+
+    shard = train.get_dataset_shard("train")
+    trainer = LMTrainer(get_config("gpt2-tiny"), learning_rate=1e-3,
+                        total_steps=config["steps"])
+    batches = rd.lm_batch_iterator(shard, seq_len=SEQ_LEN,
+                                   batch_size=BATCH_SIZE)
+    trainer.train(batches, num_steps=config["steps"], report_every=2)
+
+
+def phase_capstone():
+    from ray_tpu.train import RunConfig, ScalingConfig, TrainController
+
+    ray_tpu.init(num_cpus=8, num_nodes=4, detect_accelerators=False)
+    try:
+        ds = token_dataset()
+        # perturb, don't kill: delay injection on the tokenizer stage —
+        # the ingest plane must absorb jitter inside its prefetch window
+        # (map tasks run with max_retries=0; the kill drill lives in
+        # tests/test_data_cluster.py where lineage re-execution is the
+        # point)
+        set_chaos(delay_s=0.05, max_injections=12,
+                  name_filter="tokenize_block", seed=3)
+        try:
+            controller = TrainController(
+                train_loop, ScalingConfig(num_workers=2),
+                RunConfig(name="bench_data_capstone"),
+                {"steps": TRAIN_STEPS},
+                datasets={"train": ds},
+            )
+            result = controller.run()
+        finally:
+            injected = num_injected()
+            clear_chaos()
+        goodput = result.goodput or {}
+        stats = ds.stats() or {}
+        watchdog = controller.stall_watchdog
+        return {
+            "status": str(result.status),
+            "chaos_injected": injected,
+            "input_wait_fraction": goodput.get("input_wait_fraction"),
+            "goodput_fraction": goodput.get("goodput_fraction"),
+            "wall_time_s": goodput.get("wall_time_s"),
+            "watchdog_fired": bool(watchdog.stalled) if watchdog else False,
+            "blocks_consumed": stats.get("blocks_consumed"),
+            "locality_hit_rate": stats.get("locality_hit_rate"),
+        }
+    finally:
+        ray_tpu.shutdown()
+
+
+# ---------------------------------------------------------- C: spill drill
+
+
+def spill_dataset() -> data.Dataset:
+    # 16 blocks x 32768 int32 rows = 128 KiB per block (over the 100 KiB
+    # inline cutoff, so blocks are HOST-tier spill candidates), 2 MiB total
+    rng = np.random.default_rng(7)
+    return data.from_numpy(
+        {"tokens": rng.integers(0, 255, 16 * 32768).astype(np.int32)},
+        num_blocks=16,
+    ).map_batches(tokenize_block)
+
+
+def phase_spill(tmp_dir: str):
+    # unconstrained reference rows first
+    ray_tpu.init(num_cpus=4, num_nodes=2, detect_accelerators=False)
+    try:
+        want = sorted(
+            int(r) for b in spill_dataset().iter_blocks() for r in b["tokens"]
+        )
+    finally:
+        ray_tpu.shutdown()
+
+    budget = 640 << 10  # ~5 blocks in flight...
+    capacity = 256 << 10  # ...through a 2-block store: must spill
+    ray_tpu.init(num_cpus=4, num_nodes=2, detect_accelerators=False,
+                 object_store_capacity=capacity, spill_dir=tmp_dir)
+    ctx = DataContext.get_current()
+    saved = (ctx.target_inflight_bytes, ctx.backpressure_max_stall_s)
+    ctx.target_inflight_bytes = budget
+    ctx.backpressure_max_stall_s = 0.5  # spill heals pressure; bound stalls
+    try:
+        ds = spill_dataset()
+        got = sorted(int(r) for b in ds.iter_blocks() for r in b["tokens"])
+        stats = ds.stats() or {}
+        return {
+            "byte_budget": budget,
+            "max_inflight_bytes": stats.get("max_inflight_bytes"),
+            "within_budget": (stats.get("max_inflight_bytes") or 0) <= budget,
+            "spilled_bytes": stats.get("spilled_bytes"),
+            "spilled": (stats.get("spilled_bytes") or 0) > 0,
+            "backpressure_stall_s": stats.get("backpressure_stall_s"),
+            "rows_match_unconstrained": got == want,
+        }
+    finally:
+        ctx.target_inflight_bytes, ctx.backpressure_max_stall_s = saved
+        ray_tpu.shutdown()
+
+
+def main():
+    ingest = phase_ingest()
+    capstone = phase_capstone()
+    with tempfile.TemporaryDirectory() as tmp:
+        spill = phase_spill(tmp)
+
+    ok = (
+        capstone["status"].endswith("FINISHED")
+        and not capstone["watchdog_fired"]
+        and (capstone["input_wait_fraction"] or 0.0) < 0.05
+        and (ingest["locality_hit_rate"] or 0.0) >= 0.8
+        and spill["spilled"]
+        and spill["within_budget"]
+        and spill["rows_match_unconstrained"]
+    )
+    print("BENCH " + json.dumps({
+        "metric": "data_streaming_ingest",
+        "value": ingest["rows_per_s"],
+        "unit": "rows/s",
+        "vs_baseline": ingest["speedup"],
+        "accepted": ok,
+        "ingest": ingest,
+        "capstone": capstone,
+        "spill_drill": spill,
+    }))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -136,6 +136,7 @@ _DEFAULT_TASK_OPTIONS: Dict[str, Any] = dict(
     runtime_env=None,
     executor="thread",  # "process" → pooled OS worker (GIL-free CPU work)
     stream_max_backlog=None,  # streaming producers: block when consumer lags
+    locality_hint=None,  # NodeID: soft preference for the block-holding node
 )
 
 _DEFAULT_ACTOR_OPTIONS: Dict[str, Any] = dict(
@@ -195,6 +196,7 @@ class RemoteFunction:
             runtime_env=opts.get("runtime_env"),
             executor=opts.get("executor", "thread"),
             stream_max_backlog=opts.get("stream_max_backlog"),
+            locality_hint=opts.get("locality_hint"),
         )
 
     def __call__(self, *args, **kwargs):

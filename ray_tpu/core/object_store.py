@@ -195,6 +195,7 @@ class ObjectStore:
         self.stats = {
             "puts": 0, "gets": 0, "spills": 0, "restores": 0, "evictions": 0,
             "shm_puts": 0, "shm_evictions": 0, "reconstructions": 0, "gc": 0,
+            "spilled_bytes": 0, "restored_bytes": 0,
         }
         # Opt-in native shared-memory tier (plasma-equivalent arena) for
         # large numpy payloads. In-process workers pass objects by reference
@@ -980,6 +981,7 @@ class ObjectStore:
             if entry.spill_path is not None:
                 entry.tier = Tier.SPILLED
                 self.stats["spills"] += 1
+                self.stats["spilled_bytes"] += entry.nbytes
             else:
                 entry.value = None
                 entry.state = ObjectState.LOST
@@ -999,6 +1001,7 @@ class ObjectStore:
         with self._lock:
             self._host_bytes -= entry.nbytes
         self.stats["spills"] += 1
+        self.stats["spilled_bytes"] += entry.nbytes
 
     def _restore(self, entry: ObjectEntry) -> Any:
         with open(entry.spill_path, "rb") as f:
@@ -1008,6 +1011,7 @@ class ObjectStore:
         with self._lock:
             self._host_bytes += entry.nbytes
         self.stats["restores"] += 1
+        self.stats["restored_bytes"] += entry.nbytes
         return value
 
     # -------------------------------------------------- process-worker views

@@ -164,7 +164,11 @@ class Runtime:
         self.object_store.set_resubmit(self.scheduler.submit)
         self._actors: Dict[ActorID, ActorRuntime] = {}
         self._lock = threading.Lock()
-        self._task_events: List[Dict[str, Any]] = []
+        # completion log appended by scheduler worker threads and
+        # scanned by the data plane (locality hints / hit accounting);
+        # its own lock so readers never contend with the actor table
+        self._task_events_lock = threading.Lock()
+        self._task_events: List[Dict[str, Any]] = []  # guarded-by: _task_events_lock
         node_res = default_node_resources(
             num_cpus=num_cpus, num_tpus=num_tpus, resources=resources,
             detect_accelerators=detect_accelerators,
@@ -382,6 +386,7 @@ class Runtime:
         runtime_env: Any = None,
         executor: str = "thread",
         stream_max_backlog: Optional[int] = None,
+        locality_hint: Any = None,
     ) -> Union[ObjectRef, List[ObjectRef], "ObjectRefGenerator"]:
         from . import runtime_env as _renv
 
@@ -416,6 +421,7 @@ class Runtime:
             executor=executor,
             streaming=streaming,
             stream_max_backlog=stream_max_backlog,
+            locality_hint=locality_hint,
         )
         if streaming:
             import weakref
@@ -446,20 +452,20 @@ class Runtime:
         return self.scheduler.cancel(ref.object_id.task_id())
 
     def _on_task_done(self, spec: TaskSpec, error: Optional[BaseException]) -> None:
-        self._task_events.append(
-            {
-                "task_id": spec.task_id.hex(),
-                "name": spec.name,
-                "ok": error is None,
-                "attempt": spec.attempt,
-                "ts": time.time(),
-                "start_ts": spec.start_ts,
-                "end_ts": spec.end_ts or time.time(),
-                "node": spec.node_hex,
-            }
-        )
-        if len(self._task_events) > 100_000:
-            del self._task_events[:50_000]
+        event = {
+            "task_id": spec.task_id.hex(),
+            "name": spec.name,
+            "ok": error is None,
+            "attempt": spec.attempt,
+            "ts": time.time(),
+            "start_ts": spec.start_ts,
+            "end_ts": spec.end_ts or time.time(),
+            "node": spec.node_hex,
+        }
+        with self._task_events_lock:
+            self._task_events.append(event)
+            if len(self._task_events) > 100_000:
+                del self._task_events[:50_000]
 
     # ----------------------------------------------------------------- actors
 
@@ -765,7 +771,21 @@ class Runtime:
         return self.scheduler.available_resources()
 
     def task_events(self) -> List[Dict[str, Any]]:
-        return list(self._task_events)
+        with self._task_events_lock:
+            return list(self._task_events)
+
+    def node_of_task(self, task_id_hex: str) -> Optional[str]:
+        """node_hex that executed a task (latest attempt wins), or None.
+        The data plane uses this to learn which node produced a block
+        (locality hints) and which node ran a map task (hit accounting).
+        The snapshot is taken under the log's lock: a concurrent append
+        or truncation must not shift entries under the reverse scan."""
+        with self._task_events_lock:
+            events = list(self._task_events)
+        for ev in reversed(events):
+            if ev["task_id"] == task_id_hex:
+                return ev["node"] or None
+        return None
 
     # -------------------------------------------------------------- profiling
 

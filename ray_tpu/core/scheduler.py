@@ -124,6 +124,10 @@ class TaskSpec:
     # producer flow control: block when the consumer lags this many items
     # behind (None = unbounded, the reference's default)
     stream_max_backlog: Optional[int] = None
+    # soft locality preference: prefer this node when it is feasible
+    # (data plane schedules map tasks next to their input block); never a
+    # hard filter — a dead or saturated hinted node must not strand work
+    locality_hint: Optional[NodeID] = None
     # internal
     attempt: int = 0
     # resubmits caused by node/worker death (budgeted separately from user
@@ -1201,6 +1205,12 @@ class ClusterScheduler:
         locality = self._arg_locality(spec, feasible)
         if locality:
             return max(feasible, key=lambda n: locality.get(n.node_id, 0))
+        # Explicit locality hint next (data-plane block affinity): honor
+        # it whenever the hinted node is feasible right now.
+        if spec.locality_hint is not None:
+            for n in feasible:
+                if n.node_id == spec.locality_hint:
+                    return n
         # Hybrid: pack onto busy-but-below-threshold nodes first, else
         # spread to the emptiest — randomized among the top-k candidates.
         below = [n for n in feasible if n.utilization() < self.HYBRID_THRESHOLD]

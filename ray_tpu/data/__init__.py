@@ -1,7 +1,11 @@
 """ray_tpu.data — streaming datasets (Ray Data equivalent).
 
-Lazy plans over columnar numpy blocks, executed as backpressured task
-streams on the runtime; device-prefetching batch iterators feed TPU HBM.
+Lazy plans over columnar numpy blocks, executed as a distributed
+streaming executor on the cluster runtime: stages run as locality-
+hinted cluster tasks over object-store block refs, submission is
+windowed in bytes (backpressure that rides the spill path under
+memory pressure), and per-consumer splits pass refs so each dp rank
+fetches its own blocks; device-prefetching batch iterators feed HBM.
 """
 
 from .block import (  # noqa: F401
@@ -31,4 +35,5 @@ from .dataset import (  # noqa: F401
     read_text,
     read_tfrecord,
 )
+from .executor import BlockPrefetcher, StreamStats  # noqa: F401
 from .lm import lm_batch_iterator, pack_tokens  # noqa: F401

@@ -19,7 +19,6 @@ from __future__ import annotations
 import builtins
 import dataclasses
 import itertools
-import queue
 import threading
 from collections import deque
 from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence
@@ -36,6 +35,12 @@ from .block import (
     block_slice,
     block_take,
     block_to_items,
+)
+from .executor import (
+    BlockPrefetcher,
+    StreamStats,
+    budgeted_submit,
+    locality_map_stream,
 )
 from .datasource import (
     BinaryFilesSource,
@@ -74,8 +79,23 @@ class DataContext:
     """Execution knobs (reference DataContext, data/context.py:226)."""
 
     prefetch_blocks: int = 4  # in-flight tasks per stage = backpressure window
-    split_buffer_blocks: int = 4  # per-consumer buffer in streaming_split
+    split_buffer_blocks: int = 4  # staged refs per split in streaming_split
     target_batch_prefetch: int = 2  # device batches in flight
+    # byte-measured half of the in-flight window: a stage stops
+    # submitting once its pending outputs are estimated past this many
+    # bytes (None = count-only windows). 64 MiB default keeps ~16 4 MiB
+    # blocks in flight per stage. Unsealed outputs count as the source's
+    # declared block size (Datasource.estimated_block_nbytes) or, when
+    # undeclared, the max size sealed so far — so the bound is exact for
+    # uniform blocks and can transiently overshoot on heterogeneous ones
+    # (the spill path absorbs the difference).
+    target_inflight_bytes: Optional[int] = 64 << 20
+    # memory-pressure backoff: when the object store's host bytes exceed
+    # this fraction of capacity, submitters stall (bounded) before
+    # riding the spill path
+    store_pressure_fraction: float = 0.9
+    backpressure_max_stall_s: float = 2.0  # max stall per submission
+    locality_aware: bool = True  # hint map tasks onto block-holding nodes
 
     _default: "DataContext" = None
 
@@ -181,9 +201,19 @@ def _actor_pool_stream(
                 pass
 
 
-def _plan_iter(ops: List[_Op], ctx: DataContext) -> Iterator[Any]:
-    """Compose the per-op ref streams (each stage overlaps with the next)."""
+def _plan_iter(ops: List[_Op], ctx: DataContext, stats: StreamStats) -> Iterator[Any]:
+    """Compose the per-op ref streams (each stage overlaps with the next).
+
+    Every stage submits cluster tasks whose outputs stay as refs in the
+    producer node's store; the byte-budgeted window (executor.py) is the
+    backpressure, and map-like stages carry locality hints so they run
+    where their input block lives."""
+    from ..util.events import emit
+
     assert ops and ops[0].kind in ("read", "read_stream")
+    for op in ops:
+        emit("INFO", "data", f"stage {op.kind} submitting",
+             kind="data.stage_start", stage=op.kind)
     if ops[0].kind == "read_stream":
         # unknown-cardinality ingest: ONE streaming-generator task yields
         # blocks as they are produced (num_returns="streaming" substrate)
@@ -204,15 +234,26 @@ def _plan_iter(ops: List[_Op], ctx: DataContext) -> Iterator[Any]:
         )
     else:
         read_remote = api.remote(lambda task: task())
-        stream = _stream_submit(
-            iter(ops[0].source.read_tasks()), lambda t: read_remote.remote(t), ctx.prefetch_blocks
+        stream = budgeted_submit(
+            iter(ops[0].source.read_tasks()),
+            lambda t: read_remote.remote(t),
+            stats=stats,
+            count_window=ctx.prefetch_blocks,
+            byte_budget=ctx.target_inflight_bytes,
+            pressure_fraction=ctx.store_pressure_fraction,
+            max_stall_s=ctx.backpressure_max_stall_s,
+            # sources that know their block size declare it, so the byte
+            # window binds from the FIRST submission instead of only
+            # after a block seals
+            est_bytes=ops[0].source.estimated_block_nbytes(),
         )
 
     for op in ops[1:]:
         if op.kind == "map_batches":
             map_remote = api.remote(op.fn).options(executor=op.executor)
-            stream = _stream_submit(
-                stream, lambda ref, r=map_remote: r.remote(ref), ctx.prefetch_blocks
+            stream = locality_map_stream(
+                stream, map_remote, stats=stats, ctx=ctx,
+                locality=ctx.locality_aware,
             )
         elif op.kind == "map_batches_actors":
             stream = _actor_pool_stream(stream, op, ctx)
@@ -224,8 +265,9 @@ def _plan_iter(ops: List[_Op], ctx: DataContext) -> Iterator[Any]:
                 return block_take(block, np.nonzero(keep)[0]) if len(keep) else block
 
             filt_remote = api.remote(filter_block).options(executor=op.executor)
-            stream = _stream_submit(
-                stream, lambda ref, r=filt_remote: r.remote(ref), ctx.prefetch_blocks
+            stream = locality_map_stream(
+                stream, filt_remote, stats=stats, ctx=ctx,
+                locality=ctx.locality_aware,
             )
         elif op.kind == "limit":
             stream = _limit_stream(stream, op.n)
@@ -235,7 +277,15 @@ def _plan_iter(ops: List[_Op], ctx: DataContext) -> Iterator[Any]:
             stream = _repartition_stream(stream, op.n)
         else:  # pragma: no cover
             raise ValueError(f"unknown op {op.kind}")
-    return stream
+
+    def drained(s):
+        try:
+            yield from s
+        finally:
+            emit("INFO", "data", "pipeline drained",
+                 kind="data.stage_finish", stage=ops[-1].kind)
+
+    return drained(stream)
 
 
 def _limit_stream(stream: Iterator[Any], n: int) -> Iterator[Any]:
@@ -299,6 +349,7 @@ class Dataset:
     def __init__(self, ops: List[_Op], ctx: Optional[DataContext] = None):
         self._ops = ops
         self._ctx = ctx or DataContext.get_current()
+        self._last_stats: Optional[StreamStats] = None
 
     # -- transforms (lazy) --
 
@@ -365,11 +416,26 @@ class Dataset:
     # -- consumption --
 
     def iter_block_refs(self) -> Iterator[Any]:
-        return _plan_iter(self._ops, self._ctx)
+        self._last_stats = StreamStats(
+            byte_budget=self._ctx.target_inflight_bytes)
+        return _plan_iter(self._ops, self._ctx, self._last_stats)
+
+    def stats(self) -> Optional[Dict[str, Any]]:
+        """Counters for the most recent execution of this dataset
+        (blocks/bytes produced+consumed, locality hit rate, backpressure
+        stalls, spill/re-execution deltas) — None before any execution."""
+        return self._last_stats.snapshot() if self._last_stats else None
 
     def iter_blocks(self) -> Iterator[Block]:
-        for ref in self.iter_block_refs():
-            yield api.get(ref)
+        # consumer-side prefetch: up to prefetch_blocks materialized
+        # ahead of the consumer, overlapping fetch with its compute
+        prefetcher = BlockPrefetcher(
+            self.iter_block_refs(), self._ctx.prefetch_blocks,
+            self._last_stats)
+        try:
+            yield from prefetcher
+        finally:
+            prefetcher.close()
 
     def iter_batches(
         self, batch_size: int, *, drop_last: bool = False
@@ -391,22 +457,14 @@ class Dataset:
         columns: Optional[List[str]] = None,
     ) -> Iterator[Dict[str, Any]]:
         """Batches as jax arrays with a device-prefetch window: the next
-        batch's host→device transfer overlaps the current step."""
-        import jax
-
-        def to_device(batch: Block):
-            sel = {k: batch[k] for k in (columns or batch.keys())}
-            if sharding is not None:
-                return {k: jax.device_put(v, sharding) for k, v in sel.items()}
-            return {k: jax.numpy.asarray(v) for k, v in sel.items()}
-
-        window: deque = deque()
-        for batch in self.iter_batches(batch_size, drop_last=drop_last):
-            window.append(to_device(batch))
-            if len(window) > self._ctx.target_batch_prefetch:
-                yield window.popleft()
-        while window:
-            yield window.popleft()
+        batch's host→device transfer overlaps the current step. The
+        first batch yields as soon as it is on device (time-to-first-
+        step pays ONE batch, not the whole window); `sharding=` places
+        each batch per-rank for multihost gangs via jax.device_put."""
+        return _jax_batch_stream(
+            self.iter_batches(batch_size, drop_last=drop_last),
+            self._ctx.target_batch_prefetch, sharding, columns,
+        )
 
     def iter_torch_batches(
         self,
@@ -444,30 +502,78 @@ class Dataset:
         blocks = [b for b in self.iter_blocks()]
         return Dataset([_Op("read", source=_MaterializedSource(blocks))], self._ctx)
 
-    def streaming_split(self, k: int, *, equal: bool = False) -> List["DataIterator"]:
+    def streaming_split(
+        self, k: int, *, equal: bool = False, skip_ahead: bool = False
+    ) -> List["DataIterator"]:
         """k iterators fed round-robin from one execution (reference
         Dataset.streaming_split dataset.py:1699 → StreamSplitDataIterator).
-        Each split applies its own backpressure via a bounded queue."""
-        queues = [
-            # builtins.range: the module-level range() Dataset factory
-            # shadows the builtin inside this module
-            queue.Queue(maxsize=self._ctx.split_buffer_blocks)
-            for _ in builtins.range(k)
-        ]
+
+        Ref-passing and per-consumer: the pump stages only BLOCK REFS —
+        each consumer fetches its own blocks locally (with its own
+        prefetch window), so no block bytes transit the driver.
+
+        Distribution is STRICT round-robin by default: split i receives
+        blocks i, i+k, i+2k, … regardless of consumer pacing, so
+        data-parallel ranks see a deterministic share (±1 block) and a
+        full buffer blocks the pump on that consumer — the right pacing
+        for a gang, whose collectives hold ranks in lockstep anyway.
+
+        equal=True additionally delivers only COMPLETE rounds of k
+        blocks (a trailing partial round is dropped), so every split
+        receives exactly the same number of blocks — the gang-feed
+        setting: with fixed-size blocks and drop_last=True batching,
+        every dp rank agrees on step counts.
+
+        skip_ahead=True (independent consumers ONLY — never a gang)
+        trades determinism for throughput: a ref bound for a full split
+        lands on whichever sibling has room instead of stalling the
+        pump, so one stalled consumer cannot head-of-line-block its
+        siblings, but splits may receive unequal shares."""
+        if equal and skip_ahead:
+            raise ValueError(
+                "equal=True guarantees identical per-split block counts; "
+                "skip_ahead=True redistributes blocks — pick one"
+            )
+        state = _SplitState(k, self._ctx.split_buffer_blocks,
+                            skip_ahead=skip_ahead)
+        # building the plan is lazy (no tasks submitted until the first
+        # pull), so create it here and share its StreamStats with every
+        # consumer before the pump starts
+        refs = self.iter_block_refs()
+        stats = self._last_stats
 
         def pump():
             try:
-                for i, ref in enumerate(self.iter_block_refs()):
-                    queues[i % k].put(("block", api.get(ref)))
-                for q in queues:
-                    q.put(("end", None))
+                round_buf: List[Any] = []
+                for i, ref in enumerate(refs):
+                    if equal:
+                        round_buf.append(ref)
+                        if len(round_buf) == k:
+                            for j, r in enumerate(round_buf):
+                                state.push(j, r)
+                            round_buf.clear()
+                    else:
+                        state.push(i % k, ref)
+                state.finish(None)
+            except _SplitClosed:
+                # consumer-side close (gang shutdown / restart): stop
+                # the upstream generator chain so budgeted_submit stops
+                # submitting block tasks for a gang nobody will feed
+                close = getattr(refs, "close", None)
+                if close is not None:
+                    try:
+                        close()
+                    except Exception:
+                        pass
             except BaseException as e:  # propagate to all consumers
-                for q in queues:
-                    q.put(("error", e))
+                state.finish(e)
 
         thread = threading.Thread(target=pump, daemon=True, name="data-split-pump")
         thread.start()
-        return [DataIterator(q) for q in queues]
+        return [
+            DataIterator(state, i, self._ctx, stats)
+            for i in builtins.range(k)
+        ]
 
 
 class _MaterializedSource(Datasource):
@@ -478,27 +584,189 @@ class _MaterializedSource(Datasource):
         return [(lambda b=b: b) for b in self.blocks]
 
 
+def _jax_batch_stream(
+    batch_iter: Iterator[Block],
+    prefetch: int,
+    sharding,
+    columns: Optional[List[str]],
+) -> Iterator[Dict[str, Any]]:
+    """Device-prefetch window over a host batch iterator. The FIRST
+    batch yields the moment it is enqueued to the device (jax transfers
+    are async), then the window tops up to `prefetch` batches behind the
+    consumer's step — overlap without paying the whole window before
+    step 0."""
+    import jax
+
+    def to_device(batch: Block):
+        sel = {k: batch[k] for k in (columns or batch.keys())}
+        if sharding is not None:
+            return {k: jax.device_put(v, sharding) for k, v in sel.items()}
+        return {k: jax.numpy.asarray(v) for k, v in sel.items()}
+
+    it = iter(batch_iter)
+    window: deque = deque()
+    exhausted = [False]
+
+    def top_up(target: int) -> None:
+        while not exhausted[0] and len(window) < target:
+            try:
+                window.append(to_device(next(it)))
+            except StopIteration:
+                exhausted[0] = True
+
+    top_up(1)  # time-to-first-step pays ONE transfer, not the window
+    while window:
+        yield window.popleft()
+        top_up(max(1, prefetch))
+
+
+class _SplitClosed(Exception):
+    """Raised out of _SplitState.push when a consumer closed the split:
+    the pump's signal to stop pulling refs and shut the upstream chain."""
+
+
+class _SplitState:
+    """Ref router behind streaming_split: the pump stages BLOCK REFS
+    (never bytes) into per-split staging deques; consumers pop refs and
+    fetch blocks themselves. `cap` bounds staged refs per split so the
+    pump's pull pace stays tied to consumption. Routing is strict
+    round-robin unless `skip_ahead` (see Dataset.streaming_split)."""
+
+    def __init__(self, k: int, cap: int, *, skip_ahead: bool = False):
+        self._cv = threading.Condition()
+        self._queues: List[deque] = [
+            deque() for _ in builtins.range(k)
+        ]  # guarded-by: _cv
+        self._done = False  # guarded-by: _cv
+        self._closed = False  # guarded-by: _cv
+        self._error: Optional[BaseException] = None  # guarded-by: _cv
+        self._cap = max(int(cap), 1)
+        self._skip_ahead = skip_ahead
+
+    def push(self, i: int, ref: Any) -> None:
+        with self._cv:
+            while True:
+                if self._closed:
+                    raise _SplitClosed()
+                if len(self._queues[i]) < self._cap:
+                    self._queues[i].append(ref)
+                    self._cv.notify_all()
+                    return
+                if self._skip_ahead:
+                    # opt-in: route to any sibling with room rather than
+                    # stalling every split behind the slowest consumer
+                    # (non-deterministic shares — never for a gang)
+                    for q in self._queues:
+                        if len(q) < self._cap:
+                            q.append(ref)
+                            self._cv.notify_all()
+                            return
+                # the target split (strict) or every split (skip-ahead)
+                # is full: the pump waits, which is what propagates
+                # consumer pacing back up to submission
+                self._cv.wait(timeout=1.0)
+
+    def finish(self, error: Optional[BaseException]) -> None:
+        with self._cv:
+            self._done = True
+            self._error = error
+            self._cv.notify_all()
+
+    def close(self) -> None:
+        """Tear down the split: the pump's next push raises _SplitClosed
+        (exiting the thread and closing the upstream submission chain),
+        staged refs drop so their blocks can be GC'd, and every consumer
+        sees end-of-stream."""
+        with self._cv:
+            self._closed = True
+            self._done = True
+            for q in self._queues:
+                q.clear()
+            self._cv.notify_all()
+
+    def pop(self, i: int):
+        with self._cv:
+            while True:
+                if self._queues[i]:
+                    ref = self._queues[i].popleft()
+                    self._cv.notify_all()
+                    return ("ref", ref)
+                if self._done:
+                    if self._error is not None:
+                        return ("error", self._error)
+                    return ("end", None)
+                self._cv.wait(timeout=1.0)
+
+
 class DataIterator:
-    """One consumer's view of a streaming_split."""
+    """One consumer's view of a streaming_split: pops block REFS from
+    its split and fetches the bytes locally through its own prefetch
+    window (each dp rank pulls blocks to its node; the driver never
+    materializes them)."""
 
-    def __init__(self, q: "queue.Queue"):
-        self._q = q
+    def __init__(self, split: _SplitState, index: int,
+                 ctx: Optional[DataContext] = None,
+                 stats: Optional[StreamStats] = None):
+        self._split = split
+        self._index = index
+        self._ctx = ctx or DataContext.get_current()
+        self._stats = stats
 
-    def iter_blocks(self) -> Iterator[Block]:
+    def _ref_iter(self) -> Iterator[Any]:
         while True:
-            kind, payload = self._q.get()
+            kind, payload = self._split.pop(self._index)
             if kind == "end":
                 return
             if kind == "error":
                 raise payload
             yield payload
 
+    def iter_blocks(self) -> Iterator[Block]:
+        prefetcher = BlockPrefetcher(
+            self._ref_iter(), self._ctx.prefetch_blocks, self._stats)
+        try:
+            yield from prefetcher
+        finally:
+            prefetcher.close()
+
+    def close(self) -> None:
+        """Stop the split's SHARED execution (this iterator AND its
+        siblings): the pump thread exits, staged refs drop, and the
+        upstream submission chain closes so no further block tasks are
+        submitted. WorkerGroup.shutdown calls this so a gang restart
+        does not leak the previous attempt's pump thread, prefetchers,
+        or in-flight blocks."""
+        self._split.close()
+
     def iter_batches(self, batch_size: int, *, drop_last: bool = False) -> Iterator[Block]:
+        """Same default as Dataset.iter_batches (keep the partial tail).
+        Gang-feed paths pass drop_last=True explicitly (iter_jax_batches
+        defaults to it) so data-parallel ranks always agree on step
+        counts — a ragged last step deadlocks a multihost gang
+        mid-collective."""
         return batches_from_blocks(self.iter_blocks(), batch_size, drop_last=drop_last)
+
+    def iter_jax_batches(
+        self,
+        batch_size: int,
+        *,
+        drop_last: bool = True,
+        sharding=None,
+        columns: Optional[List[str]] = None,
+    ) -> Iterator[Dict[str, Any]]:
+        """Per-rank device-prefetched batches (see Dataset.iter_jax_batches);
+        pass this rank's `sharding=` for multihost per-rank placement."""
+        return _jax_batch_stream(
+            self.iter_batches(batch_size, drop_last=drop_last),
+            self._ctx.target_batch_prefetch, sharding, columns,
+        )
 
     def iter_rows(self) -> Iterator[Any]:
         for block in self.iter_blocks():
             yield from block_to_items(block)
+
+    def stats(self) -> Optional[Dict[str, Any]]:
+        return self._stats.snapshot() if self._stats else None
 
 
 # ------------------------------------------------------------------- read API

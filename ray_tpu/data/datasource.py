@@ -23,6 +23,13 @@ class Datasource:
     def estimated_num_rows(self) -> Optional[int]:
         return None
 
+    def estimated_block_nbytes(self) -> Optional[int]:
+        """Declared per-block output size, if this source knows it
+        cheaply (no reads). Seeds the byte-budgeted window's in-flight
+        estimate so it binds before the first block seals; None means
+        the window is count-limited until then."""
+        return None
+
 
 class RangeSource(Datasource):
     def __init__(self, n: int, num_blocks: int = 8):
@@ -39,6 +46,12 @@ class RangeSource(Datasource):
 
     def estimated_num_rows(self) -> Optional[int]:
         return self.n
+
+    def estimated_block_nbytes(self) -> Optional[int]:
+        if not self.n:
+            return None
+        rows = -(-self.n // self.num_blocks)  # ceil: the largest block
+        return rows * np.dtype(np.int64).itemsize
 
 
 class ItemsSource(Datasource):
@@ -75,6 +88,16 @@ class NumpySource(Datasource):
 
         return [make(int(lo), int(hi)) for lo, hi in zip(edges[:-1], edges[1:]) if hi > lo]
 
+    def estimated_num_rows(self) -> Optional[int]:
+        return len(next(iter(self.arrays.values())))
+
+    def estimated_block_nbytes(self) -> Optional[int]:
+        n = len(next(iter(self.arrays.values())))
+        if not n:
+            return None
+        total = sum(v.nbytes for v in self.arrays.values())
+        return -(-total // self.num_blocks)  # ceil: the largest block
+
 
 class TextSource(Datasource):
     """One block per file; column 'text' of lines."""
@@ -106,6 +129,13 @@ class NpyFileSource(Datasource):
             return lambda: {self.column: np.load(path)}
 
         return [make(p) for p in self.paths]
+
+    def estimated_block_nbytes(self) -> Optional[int]:
+        # file size ≈ array nbytes (the .npy header is ~128 bytes)
+        try:
+            return max(os.path.getsize(p) for p in self.paths)
+        except OSError:
+            return None
 
 
 class ParquetSource(Datasource):

@@ -12,7 +12,7 @@ from typing import Any, Dict, Iterator, Optional
 import numpy as np
 
 from .block import Block, block_num_rows
-from .dataset import Dataset
+from .dataset import DataContext, Dataset, _jax_batch_stream
 
 
 def pack_tokens(
@@ -61,12 +61,12 @@ def lm_batch_iterator(
     sharding=None,
 ) -> Iterator[Dict[str, Any]]:
     """Device-ready LM batches from a Dataset or a streaming_split
-    DataIterator — feed straight into LMTrainer.train()."""
-    import jax
-
-    blocks = dataset_or_iterator.iter_blocks()
-    for batch in pack_tokens(blocks, seq_len, batch_size, column=column):
-        if sharding is not None:
-            yield {"tokens": jax.device_put(batch["tokens"], sharding)}
-        else:
-            yield {"tokens": jax.numpy.asarray(batch["tokens"])}
+    DataIterator — feed straight into LMTrainer.train(). Batches ride a
+    device-prefetch window (the first yields as soon as it is enqueued;
+    the window tops up behind the consumer's step), and `sharding=`
+    places each batch per-rank for multihost gangs."""
+    packed = pack_tokens(
+        dataset_or_iterator.iter_blocks(), seq_len, batch_size, column=column
+    )
+    prefetch = DataContext.get_current().target_batch_prefetch
+    return _jax_batch_stream(packed, prefetch, sharding, None)

@@ -25,6 +25,7 @@ from .lm import (  # noqa: F401
 from .session import (  # noqa: F401
     get_checkpoint,
     get_context,
+    get_dataset_shard,
     get_session,
     is_preempted,
     list_checkpoints,

@@ -92,11 +92,15 @@ class TrainController:
         poll_interval: float = 0.05,
         group_factory: Optional[Callable[[], Any]] = None,
         restart_backoff_s: float = 1.0,
+        datasets: Optional[Dict[str, Any]] = None,
     ):
         self.train_fn = train_fn
         self.scaling = scaling
         self.run_config = run_config
         self.train_config = train_config
+        # name -> data.Dataset for the gang feed: each (re)start attempt
+        # re-splits, so a restarted gang re-streams from block lineage
+        self.datasets = datasets
         self.poll_interval = poll_interval
         # pause between restart attempts: a gang that died with its node
         # usually needs the cluster to DECLARE the death (heartbeat
@@ -234,6 +238,7 @@ class TrainController:
                     # the step this attempt resumes from must survive
                     # worker-side pruning until a newer one lands
                     protect_step=self.latest_checkpoint_step,
+                    datasets=self.datasets,
                 )
             from ..util.events import emit
 

@@ -71,6 +71,11 @@ class Session:
         # cross-process sessions (multihost) read the controller's flags
         # through a probe instead of the in-memory fields
         self._flag_probe: Optional[Callable[[], Dict[str, Any]]] = None
+        # streaming-data gang feed: name -> DataIterator for THIS rank's
+        # split of each Dataset passed to the trainer (populated by
+        # WorkerGroup.start via streaming_split; read by
+        # train.get_dataset_shard inside the loop)
+        self.dataset_shards: Dict[str, Any] = {}
 
     def _keep(self) -> int:
         if self.checkpoint_keep is not None:
@@ -397,3 +402,21 @@ def load_trial_checkpoint(trial_dir: Optional[str]) -> Any:
 
 def get_context() -> TrainContext:
     return get_session().context
+
+
+def get_dataset_shard(name: str = "train"):
+    """ray.train.get_dataset_shard equivalent: this rank's DataIterator
+    over its streaming_split of the Dataset passed as
+    `Trainer(datasets={name: ds})`. The split is strict round-robin with
+    equal=True, so every rank receives the same number of blocks; fetch
+    is local per rank (no driver materialization). Iterate with
+    `iter_jax_batches` (drop_last=True default) or
+    `iter_batches(batch_size, drop_last=True)` so every dp rank agrees
+    on step counts — a ragged last step deadlocks a multihost gang."""
+    shards = get_session().dataset_shards
+    if name not in shards:
+        raise KeyError(
+            f"no dataset shard named {name!r} — pass datasets={{{name!r}: ds}} "
+            f"to the trainer (available: {sorted(shards)})"
+        )
+    return shards[name]

@@ -36,15 +36,20 @@ class Trainer:
         scaling_config: Optional[ScalingConfig] = None,
         run_config: Optional[RunConfig] = None,
         train_loop_config: Optional[Dict[str, Any]] = None,
+        datasets: Optional[Dict[str, Any]] = None,
     ):
         self.train_fn = train_loop_per_worker
         self.scaling = scaling_config or ScalingConfig()
         self.run_config = run_config or RunConfig()
         self.train_config = train_loop_config
+        # name -> data.Dataset: streaming_split across the gang at start;
+        # workers read their per-rank split via train.get_dataset_shard
+        self.datasets = datasets
 
     def fit(self) -> Result:
         controller = TrainController(
-            self.train_fn, self.scaling, self.run_config, self.train_config
+            self.train_fn, self.scaling, self.run_config, self.train_config,
+            datasets=self.datasets,
         )
         return controller.run()
 

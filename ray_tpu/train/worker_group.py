@@ -23,6 +23,7 @@ class TrainWorker:
         trial_dir: "Optional[str]" = None,
         checkpoint_keep: "Optional[int]" = None,
         protect_step: "Optional[int]" = None,
+        dataset_shards: "Optional[Dict[str, Any]]" = None,
     ):
         self._context = TrainContext(
             world_rank=rank, world_size=world_size, run_name=run_name,
@@ -31,6 +32,9 @@ class TrainWorker:
         self._session = Session(self._context, checkpoint_keep=checkpoint_keep)
         # the step the controller will resume from: pruning spares it
         self._session.protect_step = protect_step
+        # this rank's streaming_split DataIterators (in-process actors
+        # receive them zero-copy; train.get_dataset_shard reads them)
+        self._session.dataset_shards = dict(dataset_shards or {})
         self._done = False
         self._error: Optional[str] = None
 
@@ -84,10 +88,14 @@ class WorkerGroup:
         pg: Optional[PlacementGroup] = None,
         checkpoint_keep: Optional[int] = None,
         protect_step: Optional[int] = None,
+        datasets: Optional[Dict[str, Any]] = None,
     ):
         self.num_workers = num_workers
         self.resources_per_worker = resources_per_worker
         self.run_name = run_name
+        # name -> Dataset: streaming_split(num_workers) at start(); each
+        # worker's Session receives its own per-rank DataIterator
+        self.datasets = datasets or {}
         # session checkpoint retention + the pending-restore step pruning
         # must spare (plumbed into every worker's Session)
         self.checkpoint_keep = checkpoint_keep
@@ -105,6 +113,10 @@ class WorkerGroup:
         self.pg: Optional[PlacementGroup] = pg
         self._owns_pg = pg is None
         self.workers: List[Any] = []
+        # the DataIterators handed to this gang's workers: shutdown()
+        # closes them so a restart attempt's fresh streaming_split does
+        # not race a leaked pump thread from the previous attempt
+        self._split_iters: List[Any] = []
         # telemetry: wall timestamp of each worker's newest report,
         # updated by poll() — the stall watchdog's straggler ranking and
         # `ray_tpu status` read gang progress from here
@@ -129,6 +141,20 @@ class WorkerGroup:
         actor_cls = api.remote(TrainWorker)
         from ..core.scheduler import PlacementGroupSchedulingStrategy
 
+        # gang feed: one streaming execution per dataset, split into
+        # per-rank ref-passing iterators (rank i fetches its own blocks).
+        # equal=True: strict round-robin delivery of complete rounds
+        # only, so every rank receives the same number of blocks and dp
+        # ranks cannot disagree on step counts
+        shards_by_rank: List[Dict[str, Any]] = [
+            {} for _ in range(self.num_workers)
+        ]
+        for ds_name, ds in self.datasets.items():
+            splits = ds.streaming_split(self.num_workers, equal=True)
+            self._split_iters.extend(splits)
+            for i, it in enumerate(splits):
+                shards_by_rank[i][ds_name] = it
+
         self.workers = [
             actor_cls.options(
                 max_concurrency=2,
@@ -139,7 +165,8 @@ class WorkerGroup:
                 ),
                 name=f"{self.run_name}-worker-{i}",
             ).remote(i, self.num_workers, self.run_name, self.trial_dir,
-                     self.checkpoint_keep, self.protect_step)
+                     self.checkpoint_keep, self.protect_step,
+                     shards_by_rank[i])
             for i in range(self.num_workers)
         ]
         api.get([w.ping.remote() for w in self.workers], timeout=30)
@@ -173,6 +200,15 @@ class WorkerGroup:
         return api.get(result_refs, timeout=timeout)
 
     def shutdown(self) -> None:
+        # stop this gang's ingest before killing its consumers: the
+        # split pump exits, upstream submission stops, and staged block
+        # refs drop (a restart attempt re-splits the same Datasets)
+        for it in self._split_iters:
+            try:
+                it.close()
+            except Exception:
+                pass
+        self._split_iters = []
         for w in self.workers:
             try:
                 api.kill(w)

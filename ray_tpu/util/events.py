@@ -90,6 +90,12 @@ EVENT_KINDS: Dict[str, str] = {
     "serve.scaled": "a deployment scaled its replica count",
     "serve.drain": "a serve replica began draining",
     "serve.autoscale": "the serve autoscaler changed a replica target",
+    # streaming data plane
+    "data.stage_start": "a streaming dataset stage began submitting tasks",
+    "data.stage_finish": "a streaming dataset stage drained its last block",
+    "data.backpressure": "the data executor stalled on its byte budget",
+    "data.spill": "a data-plane run pushed blocks through the spill path",
+    "data.reexec": "a lost block was re-executed via lineage mid-ingest",
     # chaos
     "chaos.injected": "a chaos injection fired (delay/failure/kill/preempt)",
     # watchdogs

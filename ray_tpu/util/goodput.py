@@ -144,6 +144,11 @@ class GoodputAccountant:
             "goodput_s": round(goodput_s, 6),
             "badput_s": round(max(0.0, wall - goodput_s), 6),
             "goodput_fraction": round(goodput_s / wall, 6) if wall > 0 else 0.0,
+            # the streaming-data acceptance number: share of wall time
+            # the gang spent waiting on its input pipeline
+            "input_wait_fraction": (
+                round(buckets["input_wait"] / wall, 6) if wall > 0 else 0.0
+            ),
         }
         if publish:
             self._publish(out)

@@ -91,3 +91,76 @@ def test_actor_pool_udf_on_cluster(cluster):
     )
     vals = sorted(int(r) for r in ds.take(200))
     assert vals == [i + 1000 for i in range(100)]
+
+
+@pytest.fixture
+def pinned_cluster():
+    """Like `cluster`, but with the remote-inline cutoff forced tiny so
+    task results STAY in their producer node's store (the default 512 KiB
+    cutoff would ship these small test blocks back inline and leave
+    nothing to lose when a node dies)."""
+    sysconf = {"node_heartbeat_s": 0.2, "remote_inline_max_bytes": 64}
+    c = Cluster(
+        head_node_args={
+            "num_cpus": 1,
+            "_system_config": {"node_stale_s": 5.0, **sysconf},
+        }
+    )
+    c.add_node(num_cpus=2, system_config=sysconf)
+    c.add_node(num_cpus=2, system_config=sysconf)
+    c.wait_for_nodes(3)
+    yield c
+    c.shutdown()
+    from ray_tpu.core.config import cfg
+
+    cfg.reset()
+
+
+def test_kill_node_mid_ingest_exactly_once(pinned_cluster):
+    """PR 12 chaos drill: blocks live in their producer node's store;
+    SIGKILL that node before the consumer fetches and the lost blocks
+    must re-execute via lineage — the consumer still sees every row
+    exactly once, and the store counts the reconstructions."""
+    cluster = pinned_cluster
+    ctx = rdata.DataContext.get_current()
+    old_prefetch = ctx.prefetch_blocks
+    ctx.prefetch_blocks = 16  # submit the whole 12-block plan up front
+    try:
+        ds = rdata.range(600, num_blocks=12).map_batches(
+            lambda b: {"item": b["item"] * 2}
+        )
+        refs = list(ds.iter_block_refs())
+        assert len(refs) == 12
+        # wait for every block to seal WITHOUT fetching any — the values
+        # must still be sitting in the agents' stores when one dies
+        ready, pending = ray_tpu.wait(refs, num_returns=12, timeout=120)
+        assert not pending
+        victim = cluster._nodes[0]
+        cluster.remove_node(victim, allow_graceful=False)
+        deadline = time.monotonic() + 30
+        while (len(cluster.runtime.scheduler.nodes()) > 2
+               and time.monotonic() < deadline):
+            time.sleep(0.1)
+
+        blocks = ray_tpu.get(refs, timeout=120)
+        rows = sorted(int(r) for b in blocks for r in b["item"])
+        assert rows == [i * 2 for i in range(600)], "rows not exactly-once"
+        assert cluster.runtime.object_store.stats["reconstructions"] > 0, (
+            "killing a producer node should have forced lineage re-execution"
+        )
+    finally:
+        ctx.prefetch_blocks = old_prefetch
+
+
+def test_cluster_ingest_locality_routing(cluster):
+    """Map tasks carry a locality hint for the node holding their input
+    block; on an idle cluster most should land there (soft preference —
+    feasibility still wins, so the bar here is majority, not 100%)."""
+    ds = rdata.range(400, num_blocks=8).map_batches(
+        lambda b: {"item": b["item"] + 1}
+    )
+    total = sum(int(r) for r in ds.take(500))
+    assert total == sum(i + 1 for i in range(400))
+    stats = ds.stats()
+    assert stats["locality_total"] > 0
+    assert stats["locality_hit_rate"] >= 0.5, stats

@@ -153,15 +153,21 @@ def test_events_federate_into_gcs_table_and_state_query():
         my_hex = ctx.node_id.hex()
         events().emit("WARNING", "test", "flight recorder drill",
                       kind="chaos.injected", mode="drill")
-        # force one federation pass (normally rides the stats piggyback)
-        ctx._last_stats_ts = 0.0
-        ctx._report_stats()
-        tail = ctx.gcs.kv_get(my_hex, namespace=EVENT_NS)
+        # force federation passes (normally they ride the stats
+        # piggyback) until the cursor has drained the whole ring — the
+        # process-global event log may hold a backlog from earlier tests
+        # larger than one bounded federate batch
+        prev, tail = -1, []
+        while len(tail) != prev:
+            prev = len(tail)
+            ctx._last_stats_ts = 0.0
+            ctx._report_stats()
+            tail = ctx.gcs.kv_get(my_hex, namespace=EVENT_NS) or []
         assert tail, "no events federated into the _events table"
         assert any(e.get("kind") == "chaos.injected" for e in tail)
         # every federated event carries node attribution
         assert all(e.get("node") for e in tail)
-        # cursor advanced: a second pass without new events is a no-op
+        # cursor advanced: another pass without new events is a no-op
         before = len(tail)
         ctx._last_stats_ts = 0.0
         ctx._report_stats()
