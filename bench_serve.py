@@ -22,7 +22,10 @@ passes when every request still completes.
 from __future__ import annotations
 
 import argparse
+import glob
 import json
+import os
+import sys
 import threading
 import time
 
@@ -49,9 +52,53 @@ _PROFILES = {
                 pages=768, max_seq=512, slots=16),
 }
 
+# The speculative drill is decode-bound (speculation only pays during
+# decode), so it flips the workload shape: short prompts, long
+# generations, prefix cache off in every phase.
+_SPEC_PROFILES = {
+    "tpu": dict(model="gpt2-small", requests=64, rate=24.0,
+                prompt_len=64, max_tokens=48, system_len=32,
+                page_size=64, chunk_pages=2, decode_block_steps=8,
+                pages=512, max_seq=0, slots=8),
+    "cpu": dict(model="llama-tiny", requests=32, rate=200.0,
+                prompt_len=48, max_tokens=24, system_len=32,
+                page_size=16, chunk_pages=1, decode_block_steps=2,
+                pages=256, max_seq=0, slots=8),
+}
+
+
+def _emit_result(payload: dict, rc: int = 0) -> None:
+    """Print the ONE result line and self-capture it as the next
+    BENCH_SERVE_r<NN>.json round file (same {n, cmd, rc, tail, parsed}
+    shape the driver writes for bench.py), anchored to the repo root so
+    the round history survives whatever cwd the bench ran from."""
+    line = json.dumps(payload)
+    print(line)
+    root = os.path.dirname(os.path.abspath(__file__))
+    rounds = [
+        int(os.path.basename(p)[len("BENCH_SERVE_r"):-len(".json")])
+        for p in glob.glob(os.path.join(root, "BENCH_SERVE_r*.json"))
+        if os.path.basename(p)[len("BENCH_SERVE_r"):-len(".json")].isdigit()
+    ]
+    n = max(rounds, default=0) + 1
+    path = os.path.join(root, f"BENCH_SERVE_r{n:02d}.json")
+    with open(path, "w") as f:
+        json.dump(
+            {
+                "n": n,
+                "cmd": "python " + " ".join(sys.argv),
+                "rc": rc,
+                "tail": line + "\n",
+                "parsed": payload,
+            },
+            f,
+        )
+        f.write("\n")
+
 
 def _resolve_profile(args) -> None:
-    prof = _PROFILES["tpu" if jax.default_backend() == "tpu" else "cpu"]
+    table = _SPEC_PROFILES if args.speculative else _PROFILES
+    prof = table["tpu" if jax.default_backend() == "tpu" else "cpu"]
     for key, value in prof.items():
         if getattr(args, key) is None:
             setattr(args, key, value)
@@ -102,14 +149,18 @@ def _build_workload(args, vocab: int):
 
 
 def _drain(stream, rec):
-    """Collector: stream tokens, recording first/last token wall time."""
+    """Collector: stream tokens, recording first/last token wall time and
+    the token ids themselves (the speculative drill replays them as
+    drafts and cross-checks spec-on output exactness)."""
     n = 0
+    toks = rec["toks"] = []
     try:
-        for _tok in stream:
+        for tok in stream:
             now = time.perf_counter()
             if n == 0:
                 rec["first"] = now
             rec["last"] = now
+            toks.append(tok)
             n += 1
     except Exception as exc:  # noqa: BLE001 - report, don't kill the bench
         rec["error"] = repr(exc)
@@ -117,7 +168,8 @@ def _drain(stream, rec):
     rec["ttft_engine"] = stream.ttft_s
 
 
-def _run_open_loop(args, config, params, mesh, prefix_cache: bool):
+def _run_open_loop(args, config, params, mesh, prefix_cache: bool,
+                   spec_tokens: int = 0, proposer=None):
     from ray_tpu.serve.llm.paged import PagedConfig
     from ray_tpu.serve.llm.paged_engine import PagedEngineConfig, PagedLLMEngine
 
@@ -126,6 +178,8 @@ def _run_open_loop(args, config, params, mesh, prefix_cache: bool):
         PagedEngineConfig(
             max_slots=args.slots,
             decode_block_steps=args.decode_block_steps,
+            speculative_tokens=spec_tokens,
+            speculative_proposer=proposer,
             precompile=True,  # no XLA compile ever lands inside a request
             paged=PagedConfig(
                 page_size=args.page_size, num_pages=args.pages,
@@ -181,8 +235,98 @@ def _run_open_loop(args, config, params, mesh, prefix_cache: bool):
         "prefix_hit_rate": stats.get("prefix_cache_hit_rate", 0.0),
         "prefix_cache_pages": stats.get("prefix_cache_pages", 0.0),
         "mixed_ticks": stats.get("mixed_ticks", 0.0),
+        "decode_steps": stats.get("decode_steps", 0.0),
+        "decode_tokens": stats.get("decode_tokens", 0.0),
+        "spec_proposed": stats.get("spec_proposed", 0.0),
+        "spec_acceptance_rate": stats.get("spec_acceptance_rate", 0.0),
+        "spec_rollback_pages": stats.get("spec_rollback_pages", 0.0),
+        "outputs": [r["toks"] for r in recs],
         "elapsed_s": elapsed,
     }
+
+
+class _RingProposer:
+    """Adversarial drill proposer: drafts a +1 token ring the greedy
+    chain almost never follows, pinning acceptance near zero so every
+    verify round pays rejection + rollback (the speculation-can't-stall
+    worst case)."""
+
+    def __init__(self, vocab: int):
+        self.vocab = vocab
+
+    def propose(self, context, k):
+        return [(context[-1] + 1 + i) % self.vocab for i in range(k)]
+
+
+def bench_speculative(args, config, params, mesh) -> None:
+    """Three phases on the IDENTICAL open-loop workload, prefix cache
+    off throughout (speculation is the only variable):
+
+    1. spec OFF — records every request's greedy output;
+    2. spec ON, replay drill — a ReplayProposer drafts from phase 1's
+       recorded outputs, pinning acceptance ~1 (the templated/agentic
+       upper bound) and shrinking verify launches per generated token;
+    3. spec ON, adversarial drill — always-wrong drafts, acceptance ~0:
+       output must STILL be exact and decode must not stall.
+
+    Both spec phases are cross-checked token-for-token against phase 1
+    (exactness is part of the bench, not just the test suite)."""
+    from ray_tpu.serve.llm.speculative import ReplayProposer
+
+    base = _run_open_loop(args, config, params, mesh, prefix_cache=False)
+    requests, _ = _build_workload(args, config.vocab_size)
+    replay = ReplayProposer({
+        tuple(prompt): toks
+        for (_, prompt), toks in zip(requests, base["outputs"])
+    })
+    spec = _run_open_loop(
+        args, config, params, mesh, prefix_cache=False,
+        spec_tokens=args.spec_tokens, proposer=replay,
+    )
+    adv = _run_open_loop(
+        args, config, params, mesh, prefix_cache=False,
+        spec_tokens=args.spec_tokens,
+        proposer=_RingProposer(config.vocab_size),
+    )
+    assert spec["outputs"] == base["outputs"], "replay drill diverged"
+    assert adv["outputs"] == base["outputs"], "adversarial drill diverged"
+
+    def launches_per_token(run):
+        return run["decode_steps"] / max(1.0, run["decode_tokens"])
+
+    launch_reduction = launches_per_token(base) / max(
+        1e-9, launches_per_token(spec)
+    )
+    assert spec["spec_acceptance_rate"] >= 0.6, spec["spec_acceptance_rate"]
+    assert launch_reduction >= 1.8, launch_reduction
+    n_chips = max(1, args.tp)
+    _emit_result({
+        "metric": "serve_speculative_tokens_per_s_per_chip",
+        "value": round(spec["tokens_per_s"] / n_chips, 1),
+        "unit": "tok/s/chip",
+        # speculation speedup at replay (high-acceptance) drafts
+        "vs_baseline": round(
+            spec["tokens_per_s"] / max(1e-9, base["tokens_per_s"]), 3
+        ),
+        "spec_tokens": args.spec_tokens,
+        "acceptance_rate": round(spec["spec_acceptance_rate"], 3),
+        "launches_per_token": round(launches_per_token(spec), 4),
+        "baseline_launches_per_token": round(launches_per_token(base), 4),
+        "launch_reduction": round(launch_reduction, 3),
+        "p50_tpot_s": round(spec["p50_tpot_s"], 5),
+        "baseline_p50_tpot_s": round(base["p50_tpot_s"], 5),
+        "adversarial_acceptance_rate": round(adv["spec_acceptance_rate"], 3),
+        "adversarial_p50_tpot_s": round(adv["p50_tpot_s"], 5),
+        "adversarial_rollback_pages": adv["spec_rollback_pages"],
+        "outputs_exact": True,
+        "requests": args.requests,
+        "arrival_rate_req_s": args.rate,
+        "prompt_len": args.prompt_len,
+        "max_tokens": args.max_tokens,
+        "page_size": args.page_size,
+        "device_kind": getattr(jax.devices()[0], "device_kind", "unknown"),
+        "tp": args.tp,
+    })
 
 
 def main() -> None:
@@ -221,6 +365,13 @@ def main() -> None:
                          "need this to extend the position table; 0 keeps "
                          "the model default). CPU default 512 so the tiny "
                          "model fits a production-length system prompt.")
+    ap.add_argument("--speculative", action="store_true",
+                    help="run the speculative-decoding drill: spec off vs "
+                         "replay (high-acceptance) vs adversarial "
+                         "(all-reject) on one decode-bound workload")
+    ap.add_argument("--spec-tokens", type=int, default=3,
+                    help="draft tokens per verify round in the "
+                         "--speculative drill")
     ap.add_argument("--openai", action="store_true",
                     help="drive the workload through the OpenAI-compatible "
                          "HTTP endpoint (/v1/completions) instead of the "
@@ -255,43 +406,41 @@ def main() -> None:
         )
     params = init_params(config, jax.random.PRNGKey(0))
 
+    if args.speculative:
+        bench_speculative(args, config, params, mesh)
+        return
+
     base = _run_open_loop(args, config, params, mesh, prefix_cache=False)
     cached = _run_open_loop(args, config, params, mesh, prefix_cache=True)
     n_chips = max(1, args.tp)
-    print(
-        json.dumps(
-            {
-                "metric": "serve_open_loop_tokens_per_s_per_chip",
-                "value": round(cached["tokens_per_s"] / n_chips, 1),
-                "unit": "tok/s/chip",
-                # prefix-cache speedup on the shared-prefix mix
-                "vs_baseline": round(
-                    cached["tokens_per_s"] / max(1e-9, base["tokens_per_s"]), 3
-                ),
-                "p50_ttft_s": round(cached["p50_ttft_s"], 4),
-                "p99_ttft_s": round(cached["p99_ttft_s"], 4),
-                "p50_tpot_s": round(cached["p50_tpot_s"], 5),
-                "prefix_hit_rate": round(cached["prefix_hit_rate"], 3),
-                "mixed_ticks": cached["mixed_ticks"],
-                "baseline_mixed_ticks": base["mixed_ticks"],
-                "baseline_tokens_per_s": round(base["tokens_per_s"], 1),
-                "baseline_p50_ttft_s": round(base["p50_ttft_s"], 4),
-                "baseline_p99_ttft_s": round(base["p99_ttft_s"], 4),
-                "requests": args.requests,
-                "arrival_rate_req_s": args.rate,
-                "shared_frac": args.shared_frac,
-                "prompt_len": args.prompt_len,
-                "system_len": args.system_len,
-                "max_tokens": args.max_tokens,
-                "page_size": args.page_size,
-                "chunk_pages": args.chunk_pages,
-                "device_kind": getattr(
-                    jax.devices()[0], "device_kind", "unknown"
-                ),
-                "tp": args.tp,
-            }
-        )
-    )
+    _emit_result({
+        "metric": "serve_open_loop_tokens_per_s_per_chip",
+        "value": round(cached["tokens_per_s"] / n_chips, 1),
+        "unit": "tok/s/chip",
+        # prefix-cache speedup on the shared-prefix mix
+        "vs_baseline": round(
+            cached["tokens_per_s"] / max(1e-9, base["tokens_per_s"]), 3
+        ),
+        "p50_ttft_s": round(cached["p50_ttft_s"], 4),
+        "p99_ttft_s": round(cached["p99_ttft_s"], 4),
+        "p50_tpot_s": round(cached["p50_tpot_s"], 5),
+        "prefix_hit_rate": round(cached["prefix_hit_rate"], 3),
+        "mixed_ticks": cached["mixed_ticks"],
+        "baseline_mixed_ticks": base["mixed_ticks"],
+        "baseline_tokens_per_s": round(base["tokens_per_s"], 1),
+        "baseline_p50_ttft_s": round(base["p50_ttft_s"], 4),
+        "baseline_p99_ttft_s": round(base["p99_ttft_s"], 4),
+        "requests": args.requests,
+        "arrival_rate_req_s": args.rate,
+        "shared_frac": args.shared_frac,
+        "prompt_len": args.prompt_len,
+        "system_len": args.system_len,
+        "max_tokens": args.max_tokens,
+        "page_size": args.page_size,
+        "chunk_pages": args.chunk_pages,
+        "device_kind": getattr(jax.devices()[0], "device_kind", "unknown"),
+        "tp": args.tp,
+    })
 
 
 def bench_chaos(args) -> None:
@@ -345,7 +494,7 @@ def bench_chaos(args) -> None:
             t.join(timeout=900)
         elapsed = time.perf_counter() - t0
         completed = [v for v in results.values() if isinstance(v, int)]
-        print(json.dumps({
+        _emit_result({
             "metric": "serve_chaos_open_loop_req_per_s",
             "value": round(len(requests) / elapsed, 2),
             "unit": "req/s",
@@ -354,7 +503,7 @@ def bench_chaos(args) -> None:
             "failed": len(results) - len(completed),
             "replica_killed": True,
             "device_kind": getattr(jax.devices()[0], "device_kind", "unknown"),
-        }))
+        })
     finally:
         serve_mod.shutdown()
         ray_tpu.shutdown()
@@ -413,7 +562,7 @@ def bench_openai(args) -> None:
         assert all(
             r["usage"]["completion_tokens"] == args.max_tokens for r in done
         )
-        print(json.dumps({
+        _emit_result({
             "metric": "serve_openai_http_req_per_s",
             "value": round(len(requests) / elapsed, 2),
             "unit": "req/s",
@@ -423,7 +572,7 @@ def bench_openai(args) -> None:
             ),
             "device_kind": getattr(jax.devices()[0], "device_kind", "unknown"),
             "tp": args.tp,
-        }))
+        })
     finally:
         frontend.stop()
         serve_mod.shutdown()

@@ -269,6 +269,10 @@ define_flag("serve_ragged_kernel", True,
             "TPU backends (one launch for mixed prefill+decode batches, "
             "shard_map-wrapped under a tp mesh); False pins the XLA "
             "gather/reference path everywhere.")
+define_flag("serve_speculative_tokens", 0,
+            "Default draft length for speculative decoding in the paged "
+            "engine: tokens drafted per verify round (0 disables). "
+            "PagedEngineConfig.speculative_tokens overrides per engine.")
 define_flag("autoscale_burn_windows", 1,
             "New SLO-violating windows (ServeSLOMonitor attainment "
             "ledger) since the last autoscale pass that trigger a "

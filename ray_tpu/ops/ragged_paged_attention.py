@@ -312,6 +312,15 @@ def ragged_paged_attention(
 ) -> jax.Array:
     """Causal ragged paged attention over a page pool; returns (Hq, T, D).
 
+    Region semantics: query row r of a region sits at absolute position
+    kv_len - q_len + r, so the SAME descriptor covers every region shape
+    the engine dispatches — prefill chunks (q_len = chunk fill), plain
+    decode lanes (q_len = 1), and speculative VERIFY regions (q_len = K:
+    the pending token plus K-1 drafts scored causally in one launch, each
+    draft row attending to the drafts before it plus the lane's whole
+    paged history). Nothing kernel-side distinguishes a verify region
+    from a short prefill chunk — speculation rides the existing grid.
+
     Dispatch: Pallas kernel on TPU when the Mosaic tiling rules hold
     (D % 128 == 0, page_size % 8 == 0, block_q % 8 == 0); the
     schedule-replaying gather reference otherwise. `interpret=True` forces
@@ -323,6 +332,11 @@ def ragged_paged_attention(
     """
     hq, t, d = q.shape
     ps = k_pages.shape[2]
+    if t % block_q:
+        raise ValueError(
+            f"token rows ({t}) must divide by block_q ({block_q}): regions "
+            "are dispatched in block_q-row units"
+        )
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(d)
     if max_q_blocks is None:

@@ -207,6 +207,33 @@ def _ensure_engine_gauges() -> None:
         fn=_engine_metric_sampler("prefix_cache_hit_rate"),
     )
 
+    get_or_create_gauge(
+        "raytpu_engine_spec_proposed",
+        "Cumulative draft tokens proposed for speculative verify rounds "
+        "(zero when speculation is off).",
+        tag_keys=("engine",), fn=_engine_metric_sampler("spec_proposed"),
+    )
+    get_or_create_gauge(
+        "raytpu_engine_spec_accepted",
+        "Cumulative draft tokens accepted by the exact verify step "
+        "(each one is a decode launch the lane did not pay).",
+        tag_keys=("engine",), fn=_engine_metric_sampler("spec_accepted"),
+    )
+    get_or_create_gauge(
+        "raytpu_engine_spec_acceptance_rate",
+        "Lifetime fraction of proposed draft tokens accepted — the knob "
+        "that decides whether speculation is paying for its verify rows.",
+        tag_keys=("engine",),
+        fn=_engine_metric_sampler("spec_acceptance_rate"),
+    )
+    get_or_create_gauge(
+        "raytpu_engine_spec_rollback_pages",
+        "Cumulative KV pages freed by post-rejection rollback (pages "
+        "allocated for speculated positions past the accepted frontier).",
+        tag_keys=("engine",),
+        fn=_engine_metric_sampler("spec_rollback_pages"),
+    )
+
     def token_mix():
         out = []
         for label, e in list(_ENGINES.items()):

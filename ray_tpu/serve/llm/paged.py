@@ -481,9 +481,9 @@ def ragged_mixed_step(
     prefill_tokens: jax.Array,  # (P, C) chunks, right-padded
     offsets: jax.Array,         # (P,) tokens already ingested (page-aligned)
     totals: jax.Array,          # (P,) offset + real tokens (0 = inactive)
-    dec_tokens: jax.Array,      # (B,) decode input tokens
-    dec_positions: jax.Array,   # (B,) decode write positions
-    dec_active: jax.Array,      # (B,) int32 1 = lane decodes this tick
+    dec_tokens: jax.Array,      # (B,) or (B, Kd) decode input tokens
+    dec_positions: jax.Array,   # (B,) decode write positions (first token)
+    dec_active: jax.Array,      # (B,) int32 real tokens this tick (0..Kd)
     config: TransformerConfig,
     *,
     page_size: int,
@@ -499,37 +499,51 @@ def ragged_mixed_step(
     dispatch: a tick with both kinds of work used to pay two compiled
     programs and two passes over the page pool.
 
-    Token-major layout: T = P*C + B*block_q rows. Prefill lane p owns rows
-    [p*C, (p+1)*C) (C = chunk tokens, a multiple of block_q); decode lane
-    b owns the block_q-row region at P*C + b*block_q with its single real
-    token at row 0. The ragged descriptor (q_lens = chunk fill / 1 / 0,
-    kv_lens = totals / position+1 / 0) masks everything else off, so
-    inactive lanes burn pad-row FLOPs but write only to the scratch page.
+    Token-major layout: T = P*C + B*R rows (R = ceil(Kd/block_q)*block_q).
+    Prefill lane p owns rows [p*C, (p+1)*C) (C = chunk tokens, a multiple
+    of block_q); decode lane b owns the R-row region at P*C + b*R with its
+    dec_active[b] real tokens at rows 0.. — ONE token for plain decode,
+    1 + drafts for a speculative verify round (the pending token plus the
+    drafted continuation, scored causally in the same launch exactly like
+    a prefill chunk). The ragged descriptor (q_lens = chunk fill / count /
+    0, kv_lens = totals / position+count / 0) masks everything else off,
+    so inactive lanes and pad rows burn FLOPs but write only to the
+    scratch page (a pad row near capacity must NOT clamp its page-table
+    gather onto the lane's own live page — it is explicitly routed to
+    page 0).
 
-    Returns (prefill last-token logits (P, V), decode logits (B, V),
-    updated cache).
+    Returns (prefill last-token logits (P, V), decode logits — (B, V) for
+    1-D dec_tokens, else (B, Kd, V) with row j scoring the token after
+    input row j — and the updated cache).
     """
     c = config
     dt = c.dtype
     p_lanes, chunk = prefill_tokens.shape
-    b_lanes = dec_tokens.shape[0]
+    squeeze_dec = dec_tokens.ndim == 1
+    if squeeze_dec:
+        dec_tokens = dec_tokens[:, None]
+    b_lanes, dec_width = dec_tokens.shape
     chunk_pages = chunk // page_size
     if chunk % block_q:
         raise ValueError(f"chunk tokens ({chunk}) must divide by block_q "
                          f"({block_q})")
-    t_tokens = p_lanes * chunk + b_lanes * block_q
+    dec_blocks = -(-dec_width // block_q)
+    dec_region = dec_blocks * block_q  # rows per decode lane
+    dec_counts = dec_active.astype(jnp.int32)
+    t_tokens = p_lanes * chunk + b_lanes * dec_region
 
     # ---- token-major embedding -------------------------------------------
     pre_pos = offsets[:, None] + jnp.arange(chunk)[None, :]     # (P, C)
-    dec_region_pos = jnp.zeros((b_lanes, block_q), jnp.int32).at[:, 0].set(
-        dec_positions
-    )
+    dec_pos_grid = dec_positions[:, None] + jnp.arange(dec_width)[None, :]
+    dec_region_pos = jnp.zeros((b_lanes, dec_region), jnp.int32).at[
+        :, :dec_width
+    ].set(dec_pos_grid)
     positions = jnp.concatenate(
         [pre_pos.reshape(-1), dec_region_pos.reshape(-1)]
     )  # (T,)
-    dec_region_tok = jnp.zeros((b_lanes, block_q), jnp.int32).at[:, 0].set(
-        dec_tokens
-    )
+    dec_region_tok = jnp.zeros((b_lanes, dec_region), jnp.int32).at[
+        :, :dec_width
+    ].set(dec_tokens)
     tokens = jnp.concatenate(
         [prefill_tokens.reshape(-1), dec_region_tok.reshape(-1)]
     )  # (T,)
@@ -544,25 +558,33 @@ def ragged_mixed_step(
     cb = chunk // block_q
     starts = jnp.concatenate([
         jnp.arange(p_lanes, dtype=jnp.int32) * cb,
-        p_lanes * cb + jnp.arange(b_lanes, dtype=jnp.int32),
+        p_lanes * cb + jnp.arange(b_lanes, dtype=jnp.int32) * dec_blocks,
     ])
     counts = jnp.concatenate([
         jnp.full((p_lanes,), cb, jnp.int32),
-        jnp.ones((b_lanes,), jnp.int32),
+        jnp.full((b_lanes,), dec_blocks, jnp.int32),
     ])
     q_lens = jnp.concatenate([
         (totals - offsets).astype(jnp.int32),
-        dec_active.astype(jnp.int32),
+        dec_counts,
     ])
     kv_lens = jnp.concatenate([
         totals.astype(jnp.int32),
-        (dec_positions + 1) * dec_active.astype(jnp.int32),
+        (dec_positions + dec_counts) * (dec_counts > 0),
     ])
 
     flat_ids = chunk_page_ids.reshape(-1)                 # (P*cp,)
-    dec_page_idx = jnp.arange(b_lanes)
-    dec_pages = page_rows[p_lanes + dec_page_idx, dec_positions // page_size]
-    dec_rows = dec_positions % page_size
+    # per-(lane, token) page/row targets: token j of lane b lands at
+    # position dec_positions[b] + j. Rows past dec_counts[b] (pad rows,
+    # shrunken verify rounds) go to the scratch page — the gather index
+    # is clamped so a lane near max_pages can't wrap, and the page is
+    # forced to 0 so a clamped gather can't alias the lane's live KV.
+    maxp = page_rows.shape[1]
+    valid_tok = jnp.arange(dec_width)[None, :] < dec_counts[:, None]
+    page_idx = jnp.clip(dec_pos_grid // page_size, 0, maxp - 1)
+    gathered = page_rows[p_lanes + jnp.arange(b_lanes)[:, None], page_idx]
+    dec_pages = jnp.where(valid_tok, gathered, 0)          # (B, Kd)
+    dec_rows = jnp.where(valid_tok, dec_pos_grid % page_size, 0)
 
     k_full, v_full = cache["k"], cache["v"]
     num_pages = k_full.shape[1] // c.n_layers
@@ -599,19 +621,22 @@ def ragged_mixed_step(
             start = (zero, layer_flat[j], zero, zero)
             k_full = jax.lax.dynamic_update_slice(k_full, kp[:, j][:, None], start)
             v_full = jax.lax.dynamic_update_slice(v_full, vp[:, j][:, None], start)
-        # decode KV: per-lane row DUS at (page, row), as in paged_decode_step
+        # decode KV: per-(lane, token) row DUS at (page, row), as in
+        # paged_decode_step; 2*B*Kd DUS per layer (Kd=1 for plain decode)
         for lane in range(b_lanes):
-            row_idx = p_lanes * chunk + lane * block_q
-            upd_k = k[:, row_idx].astype(c.dtype)[:, None, None, :]
-            upd_v = v[:, row_idx].astype(c.dtype)[:, None, None, :]
-            start = (zero, dec_pages[lane] + i * num_pages, dec_rows[lane], zero)
-            k_full = jax.lax.dynamic_update_slice(k_full, upd_k, start)
-            v_full = jax.lax.dynamic_update_slice(v_full, upd_v, start)
+            for j in range(dec_width):
+                row_idx = p_lanes * chunk + lane * dec_region + j
+                upd_k = k[:, row_idx].astype(c.dtype)[:, None, None, :]
+                upd_v = v[:, row_idx].astype(c.dtype)[:, None, None, :]
+                start = (zero, dec_pages[lane, j] + i * num_pages,
+                         dec_rows[lane, j], zero)
+                k_full = jax.lax.dynamic_update_slice(k_full, upd_k, start)
+                v_full = jax.lax.dynamic_update_slice(v_full, upd_v, start)
         # ONE ragged attention launch for every lane, prefill and decode
         attn = ragged_paged_attention(
             q, k_full, v_full, starts, counts, q_lens, kv_lens,
             page_rows + i * num_pages,
-            block_q=block_q, max_q_blocks=cb,
+            block_q=block_q, max_q_blocks=max(cb, dec_blocks),
             use_kernel=use_kernel, mesh=mesh, interpret=interpret,
         )  # (Hq, T, D)
         out = jnp.einsum("htd,hde->te", attn.astype(dt), lp["wo"].astype(dt))
@@ -639,13 +664,21 @@ def ragged_mixed_step(
     if head is None:
         head = params["wte"].T
     # vocab projection ONLY for sample rows: each prefill lane's last real
-    # token and each decode lane's region row 0
+    # token and each decode lane's Kd token rows (all of them — a verify
+    # round needs every row's logits to score the drafted continuation)
     last = jnp.clip(totals - offsets - 1, 0, chunk - 1)
     pre_rows = jnp.arange(p_lanes) * chunk + last
-    dec_rows_x = p_lanes * chunk + jnp.arange(b_lanes) * block_q
-    x_sample = x[jnp.concatenate([pre_rows, dec_rows_x])]  # (P+B, E)
+    dec_rows_x = (
+        p_lanes * chunk
+        + (jnp.arange(b_lanes) * dec_region)[:, None]
+        + jnp.arange(dec_width)[None, :]
+    ).reshape(-1)
+    x_sample = x[jnp.concatenate([pre_rows, dec_rows_x])]  # (P+B*Kd, E)
     logits = jnp.einsum("be,ev->bv", x_sample, head.astype(dt))
-    return logits[:p_lanes], logits[p_lanes:], {"k": k_full, "v": v_full}
+    dec_logits = logits[p_lanes:].reshape(b_lanes, dec_width, -1)
+    if squeeze_dec:
+        dec_logits = dec_logits[:, 0]
+    return logits[:p_lanes], dec_logits, {"k": k_full, "v": v_full}
 
 
 def copy_page(

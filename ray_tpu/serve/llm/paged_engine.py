@@ -68,6 +68,7 @@ from .paged import (
     paged_decode_step,
     ragged_mixed_step,
 )
+from .speculative import NgramProposer, accept_speculative, filtered_scores
 
 
 @dataclasses.dataclass
@@ -85,6 +86,18 @@ class PagedEngineConfig:
     # default: tests build many engines; serving/bench wants it on so the
     # first burst never pays a 20-40s XLA compile mid-request.
     precompile: bool = False
+    # Speculative decoding: tokens drafted per verify round. None reads
+    # the cfg.serve_speculative_tokens flag; 0 disables. When enabled the
+    # decode path becomes draft-and-verify: each ready lane's pending
+    # token plus up to this many drafts are scored in ONE ragged launch
+    # (a q_len=K region, exactly a prefill chunk's shape), with exact
+    # greedy acceptance at temperature 0 and exact rejection sampling
+    # otherwise, and page rollback on rejection.
+    speculative_tokens: Optional[int] = None
+    speculative_ngram: int = 3  # default proposer's max n-gram
+    # Optional DraftProposer (speculative.py protocol); None = n-gram
+    # prompt-lookup self-drafting.
+    speculative_proposer: Optional[Any] = None
     paged: PagedConfig = dataclasses.field(default_factory=PagedConfig)
 
 
@@ -103,29 +116,12 @@ def _sample_plain(logits, key, temps):
 
 def _sample_filtered(logits, key, temps, top_ks, top_ps):
     """Per-lane temperature + top-k + top-p (nucleus) sampling —
-    vLLM SamplingParams parity. POSITIONAL filtering over one
-    argsort: exactly top_k tokens survive even under logit ties,
-    and the nucleus keep-mask scatters back through the sort
-    order (disabled lanes use k=V / p=1.0, which keep all)."""
-    b, vocab = logits.shape
+    vLLM SamplingParams parity. The filtering body lives in
+    speculative.filtered_scores (the verify step scores drafts against
+    the SAME filtered distribution, which is what makes speculative
+    output exactly match plain sampling)."""
     greedy = jnp.argmax(logits, axis=-1)
-    scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
-    order = jnp.argsort(scaled, axis=-1)[:, ::-1]  # desc indices
-    desc = jnp.take_along_axis(scaled, order, axis=-1)
-    k_idx = jnp.where(top_ks > 0, top_ks, vocab)
-    positions = jnp.arange(vocab)[None, :]
-    in_topk = positions < k_idx[:, None]
-    p_desc = jax.nn.softmax(
-        jnp.where(in_topk, desc, -jnp.inf), axis=-1
-    )
-    cum = jnp.cumsum(p_desc, axis=-1)
-    # keep a token if the cumulative mass BEFORE it is < top_p
-    # (the top token always survives: cum - p == 0 there)
-    keep_sorted = in_topk & ((cum - p_desc) < top_ps[:, None])
-    keep = jnp.zeros_like(keep_sorted).at[
-        jnp.arange(b)[:, None], order
-    ].set(keep_sorted)
-    final = jnp.where(keep, scaled, -jnp.inf)
+    final = filtered_scores(logits, temps, top_ks, top_ps)
     sampled = jax.random.categorical(key, final, axis=-1)
     return jnp.where(temps <= 0.0, greedy, sampled).astype(jnp.int32)
 
@@ -255,6 +251,12 @@ class _PagedSlot:
     # emission-side bookkeeping
     emit_remaining: int = 0
     finished_emit: bool = False
+    # speculative decoding: the host-side token context the proposer
+    # drafts from (prompt + every emitted token; seeded by the "first"
+    # fetch), and the one-round-in-flight latch — a lane never has two
+    # verify rounds outstanding, so rollback math stays race-free.
+    spec_ctx: Optional[List[int]] = None
+    spec_inflight: bool = False
     # observability: admit wall time, so the per-request engine.prefill
     # span covers chunked ingest end to end (chunks batch across lanes)
     prefill_t0: float = 0.0
@@ -376,6 +378,19 @@ class PagedLLMEngine:
         from ...core.config import cfg
 
         use_kernel = None if cfg.serve_ragged_kernel else False
+        spec = self.config.speculative_tokens
+        if spec is None:
+            spec = int(cfg.serve_speculative_tokens)
+        self.spec_tokens = max(0, int(spec))
+        # verify width: the pending token + the drafts (row 0 of a verify
+        # region re-scores the token whose KV write was deferred)
+        self._spec_width = self.spec_tokens + 1
+        self._proposer = None
+        if self.spec_tokens:
+            self._proposer = (
+                self.config.speculative_proposer
+                or NgramProposer(self.config.speculative_ngram)
+            )
         bq = mixed_block_q(pc.chunk_tokens)
         self._block_q = bq
         dec_plain = build_decode_block(mc, ps, K, _sample_plain, use_kernel,
@@ -416,7 +431,17 @@ class PagedLLMEngine:
             self._mixed = jax.jit(mixed, donate_argnums=(1,))
             self._copy_page = jax.jit(_copy, donate_argnums=(0,))
             self._tokens_dev = jnp.zeros((self.config.max_slots,), jnp.int32)
+        def _spec_accept_pack(dec_logits, toks, counts, key, temps, tks, tps):
+            """Accept/resample a verify round and pack the result for ONE
+            small fetch: columns [:W] the emit-ordered tokens, column W the
+            per-lane emitted count. Logits never cross to the host."""
+            out, n = accept_speculative(
+                dec_logits, toks, counts, key, temps, tks, tps
+            )
+            return jnp.concatenate([out, n[:, None]], axis=1)
+
         self._sample = jax.jit(_sample_filtered)
+        self._spec_accept = jax.jit(_spec_accept_pack)
         self._scatter_tokens = jax.jit(_scatter_tokens, donate_argnums=(0,))
         self._take = jax.jit(_take)
         self._merge_tokens = jax.jit(_merge_tokens, donate_argnums=(0,))
@@ -453,6 +478,12 @@ class PagedLLMEngine:
             "prefix_cache_hit_rate": 0.0,
             "prefix_cache_cow": 0.0,
             "mixed_ticks": 0.0,
+            # speculative-decoding counters (engine.py gauge registry
+            # mirrors these as raytpu_engine_spec_*); zero when disabled
+            "spec_proposed": 0.0,
+            "spec_accepted": 0.0,
+            "spec_acceptance_rate": 0.0,
+            "spec_rollback_pages": 0.0,
         }
         self._tick_cost = None  # decode-block cost, set at first dispatch
         self.metrics_label = _register_engine_metrics(self, "paged")
@@ -476,6 +507,11 @@ class PagedLLMEngine:
         pc = self.paged
         ms = self.config.max_slots
         ct, cp = pc.chunk_tokens, pc.chunk_pages
+        spec = self.spec_tokens > 0
+        dec_toks = (
+            jnp.zeros((ms, self._spec_width), jnp.int32)
+            if spec else self._tokens_dev
+        )
         b = 1
         while True:
             logits, dec_logits, self.cache = self._mixed(
@@ -486,7 +522,7 @@ class PagedLLMEngine:
                 jnp.zeros((b, ct), jnp.int32),
                 jnp.zeros((b,), jnp.int32),
                 jnp.zeros((b,), jnp.int32),        # totals 0: inactive
-                self._tokens_dev,
+                dec_toks,
                 jnp.zeros((ms,), jnp.int32),
                 jnp.zeros((ms,), jnp.int32),       # no decode ride-alongs
             )
@@ -497,29 +533,44 @@ class PagedLLMEngine:
             )
             if b == 1:
                 self._key, sub = jax.random.split(self._key)
-                self._sample(
-                    dec_logits, sub, jnp.zeros((ms,), jnp.float32),
-                    jnp.zeros((ms,), jnp.int32), jnp.ones((ms,), jnp.float32),
-                )
-                self._dec_pack(
-                    self._tokens_dev, jnp.zeros((ms,), jnp.int32),
-                    jnp.zeros((ms,), bool),
-                )
+                if spec:
+                    self._spec_accept(
+                        dec_logits, dec_toks, jnp.zeros((ms,), jnp.int32),
+                        sub, jnp.zeros((ms,), jnp.float32),
+                        jnp.zeros((ms,), jnp.int32),
+                        jnp.ones((ms,), jnp.float32),
+                    )
+                    self._take(self._tokens_dev, 0)  # every first token
+                else:
+                    self._sample(
+                        dec_logits, sub, jnp.zeros((ms,), jnp.float32),
+                        jnp.zeros((ms,), jnp.int32),
+                        jnp.ones((ms,), jnp.float32),
+                    )
+                    self._dec_pack(
+                        self._tokens_dev, jnp.zeros((ms,), jnp.int32),
+                        jnp.zeros((ms,), bool),
+                    )
             if b >= ms:
                 break
             b = min(b * 2, ms)
-        zeros_bt = jnp.zeros((ms, pc.max_pages_per_slot), jnp.int32)
-        pos = jnp.zeros((ms,), jnp.int32)
-        temps = jnp.zeros((ms,), jnp.float32)
-        self._key, sub = jax.random.split(self._key)
-        _, _, self.cache = self._decode_block_plain(
-            self.params, self.cache, zeros_bt, self._tokens_dev, pos, sub, temps
-        )
-        self._key, sub = jax.random.split(self._key)
-        _, _, self.cache = self._decode_block_filtered(
-            self.params, self.cache, zeros_bt, self._tokens_dev, pos, sub,
-            temps, jnp.zeros((ms,), jnp.int32), jnp.ones((ms,), jnp.float32),
-        )
+        if not spec:
+            # spec mode never launches the fused decode blocks: the verify
+            # tick (self._mixed, compiled above) IS its decode path
+            zeros_bt = jnp.zeros((ms, pc.max_pages_per_slot), jnp.int32)
+            pos = jnp.zeros((ms,), jnp.int32)
+            temps = jnp.zeros((ms,), jnp.float32)
+            self._key, sub = jax.random.split(self._key)
+            _, _, self.cache = self._decode_block_plain(
+                self.params, self.cache, zeros_bt, self._tokens_dev, pos,
+                sub, temps
+            )
+            self._key, sub = jax.random.split(self._key)
+            _, _, self.cache = self._decode_block_filtered(
+                self.params, self.cache, zeros_bt, self._tokens_dev, pos,
+                sub, temps, jnp.zeros((ms,), jnp.int32),
+                jnp.ones((ms,), jnp.float32),
+            )
         jax.block_until_ready(self.cache["k"])
 
     # ------------------------------------------------------------------- API
@@ -674,6 +725,8 @@ class PagedLLMEngine:
             slot.awaiting_first = False
             slot.emit_remaining = request.max_tokens
             slot.finished_emit = False
+            slot.spec_ctx = None
+            slot.spec_inflight = False
             self.block_tables[idx, :] = 0
             self.block_tables[idx, : len(slot.pages)] = slot.pages
 
@@ -771,43 +824,59 @@ class PagedLLMEngine:
             offsets[lane] = offset
             totals[lane] = offset + n_real
         # ---- decode ride-along: every decodable lane advances one step
-        # in the same launch (gated like a decode block: its fetch entry
-        # occupies an inflight slot)
+        # (or, in speculative mode, one drafted verify round) in the same
+        # launch (gated like a decode block: its fetch entry occupies an
+        # inflight slot)
+        spec = self.spec_tokens > 0
         dec_positions = np.zeros((ms,), dtype=np.int32)
         dec_active = np.zeros((ms,), dtype=np.int32)
         dec_temps = np.zeros((ms,), dtype=np.float32)
         dec_ks = np.zeros((ms,), dtype=np.int32)
         dec_ps = np.ones((ms,), dtype=np.float32)
+        dec_tokens_np = (
+            np.zeros((ms, self._spec_width), dtype=np.int32) if spec else None
+        )
         dec_lanes: List[Tuple[int, _Request, bool]] = []
+        spec_lanes: List[Tuple[int, _Request, int, int, int]] = []
         if self._inflight < self.config.max_inflight_blocks:
-            cap = self.paged.max_slot_tokens
-            for i, slot in enumerate(self.slots):
-                if not slot.decodable:
-                    continue
-                if slot.position + 1 > cap:
-                    slot.done_dispatching = True
-                    continue
-                pages_needed = slot.position // ps + 1
-                if pages_needed > len(slot.pages):
-                    extra = self._alloc_pages(pages_needed - len(slot.pages))
-                    if extra is None:
-                        if not slot.stalled:
-                            slot.stalled = True
-                            self.metrics["page_stalls"] += 1
+            if spec:
+                spec_lanes = self._gather_spec_rounds(
+                    page_rows, b, dec_tokens_np, dec_positions, dec_active,
+                    dec_temps, dec_ks, dec_ps,
+                )
+            else:
+                cap = self.paged.max_slot_tokens
+                for i, slot in enumerate(self.slots):
+                    if not slot.decodable:
                         continue
-                    slot.pages.extend(extra)
-                    self.block_tables[i, : len(slot.pages)] = slot.pages
-                if not self._ensure_private_page(i, slot, slot.position // ps):
-                    continue
-                slot.stalled = False
-                page_rows[b + i] = self.block_tables[i]
-                dec_positions[i] = slot.position
-                dec_active[i] = 1
-                dec_temps[i] = slot.request.temperature
-                dec_ks[i] = slot.request.top_k
-                dec_ps[i] = slot.request.top_p
-                dec_lanes.append((i, slot.request, slot.awaiting_first))
-                slot.awaiting_first = False
+                    if slot.position + 1 > cap:
+                        slot.done_dispatching = True
+                        continue
+                    pages_needed = slot.position // ps + 1
+                    if pages_needed > len(slot.pages):
+                        extra = self._alloc_pages(
+                            pages_needed - len(slot.pages)
+                        )
+                        if extra is None:
+                            if not slot.stalled:
+                                slot.stalled = True
+                                self.metrics["page_stalls"] += 1
+                            continue
+                        slot.pages.extend(extra)
+                        self.block_tables[i, : len(slot.pages)] = slot.pages
+                    if not self._ensure_private_page(
+                        i, slot, slot.position // ps
+                    ):
+                        continue
+                    slot.stalled = False
+                    page_rows[b + i] = self.block_tables[i]
+                    dec_positions[i] = slot.position
+                    dec_active[i] = 1
+                    dec_temps[i] = slot.request.temperature
+                    dec_ks[i] = slot.request.top_k
+                    dec_ps[i] = slot.request.top_p
+                    dec_lanes.append((i, slot.request, slot.awaiting_first))
+                    slot.awaiting_first = False
         logits, dec_logits, self.cache = self._mixed(
             self.params,
             self.cache,
@@ -816,11 +885,16 @@ class PagedLLMEngine:
             jnp.asarray(tokens),
             jnp.asarray(offsets),
             jnp.asarray(totals),
-            self._tokens_dev,
+            jnp.asarray(dec_tokens_np) if spec else self._tokens_dev,
             jnp.asarray(dec_positions),
             jnp.asarray(dec_active),
         )
         self.metrics["mixed_ticks"] += 1
+        if spec_lanes:
+            self._finish_spec_dispatch(
+                dec_logits, spec_lanes, dec_tokens_np, dec_active,
+                dec_temps, dec_ks, dec_ps,
+            )
         # ---- decode bookkeeping: sample, merge, and ship the pair of
         # token rows exactly like a K=1 decode block
         if dec_lanes:
@@ -891,9 +965,14 @@ class PagedLLMEngine:
                 request = slot.request
                 slot.dispatch_remaining = request.max_tokens - 1
                 if slot.dispatch_remaining <= 0:
-                    # no decode block will ever carry this lane's first
-                    # token: fetch it directly (rare max_tokens=1 path)
                     slot.done_dispatching = True
+                if self.spec_tokens or slot.dispatch_remaining <= 0:
+                    # spec mode drafts on the HOST, so the first token's
+                    # value must round-trip before the first verify round
+                    # can be proposed — fetch it now through the async
+                    # pipeline ("first" seeds spec_ctx). Also the rare
+                    # max_tokens=1 path, where no decode block will ever
+                    # carry this lane's first token.
                     first_dev = self._take(self._tokens_dev, idx)
                     _async_fetch(first_dev)
                     self._inflight += 1
@@ -1007,6 +1086,156 @@ class PagedLLMEngine:
         self.metrics["decode_steps"] += K
         return True
 
+    # ---------------------------------------------------- speculative decode
+
+    def _gather_spec_rounds(
+        self,
+        page_rows: np.ndarray,
+        base: int,
+        dec_tokens: np.ndarray,
+        dec_positions: np.ndarray,
+        dec_active: np.ndarray,
+        dec_temps: np.ndarray,
+        dec_ks: np.ndarray,
+        dec_ps: np.ndarray,
+    ) -> List[Tuple[int, _Request, int, int, int]]:
+        """Fill one verify round per ready lane into the mixed-tick decode
+        arrays: row 0 the lane's pending token (its KV write was deferred
+        to this round), rows 1.. the proposer's drafts, dispatched as a
+        q_len=count ragged region at positions position..position+count-1.
+        Pages are grown to cover the whole round up front (COW-guarded);
+        the drain side rolls back whatever rejection leaves unused. A lane
+        needs spec_ctx (seeded by its "first" fetch) and at most one round
+        in flight. Returns the dispatched (idx, request, dispatch_position,
+        count) list."""
+        ps = self.paged.page_size
+        cap = self.paged.max_slot_tokens
+        lanes: List[Tuple[int, _Request, int, int, int]] = []
+        for i, slot in enumerate(self.slots):
+            if (
+                not slot.decodable
+                or slot.spec_inflight
+                or slot.spec_ctx is None
+            ):
+                continue
+            # a round with c inputs emits at most c tokens and writes c KV
+            # rows: cap the width by both budgets
+            width = min(
+                self._spec_width, cap - slot.position,
+                slot.dispatch_remaining,
+            )
+            if width <= 0:
+                slot.done_dispatching = True
+                continue
+            drafts: List[int] = []
+            if width > 1 and self._proposer is not None:
+                try:
+                    drafts = list(
+                        self._proposer.propose(slot.spec_ctx, width - 1)
+                    )[: width - 1]
+                except Exception:
+                    drafts = []  # a broken proposer degrades to plain decode
+            count = 1 + len(drafts)
+            pre_pages = len(slot.pages)  # rollback floor: only pages this
+            # round grows are ever trimmed back (admit-time spares stay)
+            pages_needed = (slot.position + count - 1) // ps + 1
+            if pages_needed > len(slot.pages):
+                extra = self._alloc_pages(pages_needed - len(slot.pages))
+                if extra is None:
+                    if not slot.stalled:
+                        slot.stalled = True
+                        self.metrics["page_stalls"] += 1
+                    continue
+                slot.pages.extend(extra)
+                self.block_tables[i, : len(slot.pages)] = slot.pages
+            # COW: every page this round may write must be privately held
+            if not all(
+                self._ensure_private_page(i, slot, pi)
+                for pi in range(slot.position // ps, pages_needed)
+            ):
+                continue
+            slot.stalled = False
+            page_rows[base + i] = self.block_tables[i]
+            dec_tokens[i, 0] = slot.spec_ctx[-1]
+            if drafts:
+                dec_tokens[i, 1:count] = drafts
+            dec_positions[i] = slot.position
+            dec_active[i] = count
+            dec_temps[i] = slot.request.temperature
+            dec_ks[i] = slot.request.top_k
+            dec_ps[i] = slot.request.top_p
+            slot.spec_inflight = True
+            slot.blocks_in_flight += 1
+            self.metrics["spec_proposed"] += float(len(drafts))
+            lanes.append((i, slot.request, slot.position, count, pre_pages))
+        return lanes
+
+    def _finish_spec_dispatch(
+        self,
+        dec_logits: jax.Array,
+        spec_lanes: List[Tuple[int, _Request, int, int, int]],
+        dec_tokens: np.ndarray,
+        dec_active: np.ndarray,
+        dec_temps: np.ndarray,
+        dec_ks: np.ndarray,
+        dec_ps: np.ndarray,
+    ) -> None:
+        """Score the dispatched rounds on device (exact accept/resample)
+        and ship ONE packed (tokens + counts) array through the async
+        fetch pipeline — verify logits never cross to the host and the
+        dispatch thread never blocks on a device read."""
+        self._key, sub = jax.random.split(self._key)
+        packed = self._spec_accept(
+            dec_logits, jnp.asarray(dec_tokens), jnp.asarray(dec_active),
+            sub, jnp.asarray(dec_temps), jnp.asarray(dec_ks),
+            jnp.asarray(dec_ps),
+        )
+        _async_fetch(packed)
+        self._inflight += 1
+        self._fetchq.put(("spec", spec_lanes, packed))
+        self.metrics["decode_blocks"] += 1
+        self.metrics["decode_steps"] += 1  # one launch, however many tokens
+
+    def _dispatch_spec_verify(self) -> bool:
+        """Decode-only verify tick — the speculative steady state. One
+        ragged launch scores every ready lane's drafted round; the single
+        prefill lane is inactive (zero totals, scratch-mapped) so the call
+        reuses the b=1 compiled bucket of the mixed step."""
+        pc = self.paged
+        ms = self.config.max_slots
+        if self._inflight >= self.config.max_inflight_blocks:
+            return False
+        page_rows = np.zeros((1 + ms, pc.max_pages_per_slot), dtype=np.int32)
+        dec_tokens = np.zeros((ms, self._spec_width), dtype=np.int32)
+        dec_positions = np.zeros((ms,), dtype=np.int32)
+        dec_active = np.zeros((ms,), dtype=np.int32)
+        dec_temps = np.zeros((ms,), dtype=np.float32)
+        dec_ks = np.zeros((ms,), dtype=np.int32)
+        dec_ps = np.ones((ms,), dtype=np.float32)
+        spec_lanes = self._gather_spec_rounds(
+            page_rows, 1, dec_tokens, dec_positions, dec_active,
+            dec_temps, dec_ks, dec_ps,
+        )
+        if not spec_lanes:
+            return False
+        _, dec_logits, self.cache = self._mixed(
+            self.params,
+            self.cache,
+            jnp.asarray(page_rows),
+            jnp.zeros((1, pc.chunk_pages), jnp.int32),
+            jnp.zeros((1, pc.chunk_tokens), jnp.int32),
+            jnp.zeros((1,), jnp.int32),
+            jnp.zeros((1,), jnp.int32),
+            jnp.asarray(dec_tokens),
+            jnp.asarray(dec_positions),
+            jnp.asarray(dec_active),
+        )
+        self._finish_spec_dispatch(
+            dec_logits, spec_lanes, dec_tokens, dec_active,
+            dec_temps, dec_ks, dec_ps,
+        )
+        return True
+
     # -------------------------------------------------------------- emission
 
     def _drain_worker(self) -> None:
@@ -1081,8 +1310,20 @@ class PagedLLMEngine:
             drained = True
             if kind == "first":
                 idx, request = meta
-                self._emit(idx, request, int(vals[0]), first=True)
+                token = int(vals[0])
+                slot = self.slots[idx]
+                if (
+                    self.spec_tokens
+                    and slot.request is request
+                    and not slot.finished_emit
+                ):
+                    # seed the host-side draft context: everything the
+                    # proposer may condition on (prompt + first token)
+                    slot.spec_ctx = list(request.prompt) + [token]
+                self._emit(idx, request, token, first=True)
                 self._maybe_retire(idx, request)
+            elif kind == "spec":
+                self._complete_spec_round(meta, vals)
             else:
                 # vals is (K+1, B): row 0 = the block's input tokens —
                 # emitted only for lanes whose first token rides this block
@@ -1096,6 +1337,56 @@ class PagedLLMEngine:
                     if slot.request is request:
                         slot.blocks_in_flight -= 1
                     self._maybe_retire(idx, request)
+
+    def _complete_spec_round(
+        self, meta: List[Tuple[int, _Request, int, int, int]], vals: np.ndarray
+    ) -> None:
+        """Drain one verify round: emit the accepted prefix + the
+        corrected/bonus token, advance the lane to the accepted frontier,
+        and ROLL BACK pages speculated past it. vals is the packed
+        (max_slots, W+1) array — columns [:W] emit-ordered tokens, column
+        W the emitted count m (1 <= m <= count for live lanes).
+
+        Rollback safety: the trimmed pages can never be shared. The round
+        wrote positions >= dispatch_pos >= len(prompt) + 1, so the kept
+        frontier keep = (new_pos-1)//ps + 1 strictly exceeds both the
+        prefix-cache hit count (lookup caps at (len(prompt)-1)//ps pages)
+        and everything register() publishes (len(prompt)//ps fully-covered
+        pages) — trimmed indices are all fresh allocations this engine
+        grew for speculated tokens, refcount 1, and free() returns them to
+        the pool. Stale KV left in kept pages at rows [new_pos,
+        dispatch_pos+count) is masked by every future launch's kv_len
+        until the lane's forward writes overwrite it."""
+        ps = self.paged.page_size
+        for idx, request, dpos, count, pre_pages in meta:
+            slot = self.slots[idx]
+            m = int(vals[idx, -1])
+            self.metrics["spec_accepted"] += float(max(0, m - 1))
+            if slot.request is not request:
+                continue  # retired mid-flight (deadline/EOS): pages freed
+            slot.spec_inflight = False
+            slot.blocks_in_flight -= 1
+            new_pos = dpos + m
+            slot.position = new_pos
+            # free only pages THIS round grew past the accepted frontier
+            # (admit-time spares below pre_pages stay mapped — trimming
+            # them would churn the allocator every round on short prompts)
+            keep = max((new_pos - 1) // ps + 1, pre_pages)
+            if keep < len(slot.pages):
+                trimmed = slot.pages[keep:]
+                slot.pages = slot.pages[:keep]
+                self.allocator.free(trimmed)
+                self.block_tables[idx, keep:] = 0
+                self.metrics["spec_rollback_pages"] += float(len(trimmed))
+            slot.dispatch_remaining -= m
+            if slot.dispatch_remaining <= 0:
+                slot.done_dispatching = True
+            emitted = [int(vals[idx, j]) for j in range(m)]
+            if slot.spec_ctx is not None:
+                slot.spec_ctx.extend(emitted)
+            for tok in emitted:
+                self._emit(idx, request, tok)
+            self._maybe_retire(idx, request)
 
     def _emit(self, idx: int, request: _Request, token: int, first: bool = False) -> None:
         slot = self.slots[idx]
@@ -1137,6 +1428,8 @@ class PagedLLMEngine:
         slot.dispatch_remaining = 0
         slot.blocks_in_flight = 0
         slot.finished_emit = False
+        slot.spec_ctx = None
+        slot.spec_inflight = False
         self.block_tables[idx, :] = 0
 
     # ------------------------------------------------------------------ loop
@@ -1195,10 +1488,29 @@ class PagedLLMEngine:
             # into ONE joint block minimizes fetch round trips (each block
             # materialization costs a full RTT on tunneled TPUs).
             if not progressed and self._inflight < self.config.max_inflight_blocks:
-                progressed |= self._dispatch_decode_block()
-            dispatchable = any(
-                s.decodable or s.prefilling for s in self.slots
-            )
+                progressed |= (
+                    self._dispatch_spec_verify()
+                    if self.spec_tokens
+                    else self._dispatch_decode_block()
+                )
+            if self.spec_tokens:
+                # a spec lane is only dispatchable once its "first" fetch
+                # has seeded the draft context and its previous round has
+                # drained — otherwise the loop must WAIT on the drain
+                # queue, not spin
+                dispatchable = any(
+                    s.prefilling
+                    or (
+                        s.decodable
+                        and not s.spec_inflight
+                        and s.spec_ctx is not None
+                    )
+                    for s in self.slots
+                )
+            else:
+                dispatchable = any(
+                    s.decodable or s.prefilling for s in self.slots
+                )
             gated = self._inflight >= self.config.max_inflight_blocks
             progressed |= self._pump_completed(
                 wait=self._inflight > 0 and (gated or not dispatchable)
@@ -1223,6 +1535,11 @@ class PagedLLMEngine:
                 self.metrics["prefix_cache_evictions"] = pcs["evictions"]
                 self.metrics["prefix_cache_pages"] = pcs["pages"]
                 self.metrics["prefix_cache_hit_rate"] = pcs["hit_rate"]
+            if self.spec_tokens:
+                prop = self.metrics["spec_proposed"]
+                self.metrics["spec_acceptance_rate"] = (
+                    self.metrics["spec_accepted"] / prop if prop else 0.0
+                )
             if progressed:
                 _observe_tick(self, time.perf_counter() - tick_t0)
             if occupied == 0 and not self._inflight:

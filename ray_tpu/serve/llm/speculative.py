@@ -1,0 +1,242 @@
+"""Speculative decoding for the paged engine: draft, verify, accept.
+
+Decode is latency-bound — one token per sequential model pass per lane —
+while the ragged paged-attention launch already scores MULTI-token
+regions (prefill chunks) at near-decode cost. Speculative decoding spends
+that slack: a cheap DRAFT proposer guesses the next K tokens, the engine
+verifies all K in one ragged launch (q_len = K region per lane, the same
+descriptor a prefill chunk uses), and an exact accept/resample step keeps
+the output distribution identical to plain autoregressive decoding:
+
+- temperature 0: accept drafts while they match the verified argmax;
+  the first mismatch emits the argmax instead (token-for-token parity
+  with the non-speculative engine).
+- temperature > 0: rejection sampling against the verified (temperature/
+  top-k/top-p filtered) distribution. The default proposers are
+  deterministic (point-mass q), so draft t is accepted with probability
+  p(t) and a rejection resamples from p with t masked out and
+  renormalized — the textbook residual, exact by the standard
+  speculative-sampling argument.
+
+Every round emits between 1 (all drafts rejected — the corrected token)
+and K+1 (all accepted plus the bonus token sampled from the last verified
+row) tokens, so speculation can only add tokens per launch, never stall.
+
+Proposers are pluggable (`DraftProposer`): the default is n-gram
+prompt-lookup self-drafting (no extra model, great on repetitive/
+templated continuations), with an optional small draft model sharing the
+mesh, and a replay proposer used by benches/tests to pin acceptance
+deterministically.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Protocol, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class DraftProposer(Protocol):
+    """Propose up to `k` draft tokens continuing `context` (prompt plus
+    every token emitted so far). Returning fewer than `k` (or none) is
+    always legal — the verify round shrinks to what was proposed."""
+
+    def propose(self, context: Sequence[int], k: int) -> List[int]:
+        ...
+
+
+class NgramProposer:
+    """Prompt-lookup self-drafting: find the longest recent n-gram suffix
+    of the context earlier in the context and propose the tokens that
+    followed it. Free (no model, no device), and strong exactly where
+    speculation pays — templated continuations, quoted spans, code — while
+    degrading to empty proposals (a plain 1-token round) on novel text."""
+
+    def __init__(self, max_ngram: int = 3, min_ngram: int = 1):
+        if max_ngram < min_ngram or min_ngram < 1:
+            raise ValueError(f"bad ngram range [{min_ngram}, {max_ngram}]")
+        self.max_ngram = max_ngram
+        self.min_ngram = min_ngram
+
+    def propose(self, context: Sequence[int], k: int) -> List[int]:
+        if k <= 0:
+            return []
+        ctx = list(context)
+        for n in range(min(self.max_ngram, len(ctx) - 1), self.min_ngram - 1, -1):
+            needle = ctx[-n:]
+            # newest match first: recent repetition predicts best
+            for i in range(len(ctx) - n - 1, -1, -1):
+                if ctx[i:i + n] == needle:
+                    cont = ctx[i + n:i + n + k]
+                    if cont:
+                        return cont
+        return []
+
+
+class ReplayProposer:
+    """Drill proposer: replays known continuations keyed by prompt.
+
+    Benches and tests use it to pin the acceptance rate — replaying a
+    previous greedy run's outputs makes every draft accept (the
+    high-acceptance drill); replaying corrupted outputs makes every draft
+    reject (the rollback/adversarial drill)."""
+
+    def __init__(self, continuations: Dict[Tuple[int, ...], Sequence[int]]):
+        self._cont = {tuple(p): list(c) for p, c in continuations.items()}
+        self._lens = sorted({len(p) for p in self._cont}, reverse=True)
+
+    def propose(self, context: Sequence[int], k: int) -> List[int]:
+        ctx = list(context)
+        for plen in self._lens:
+            cont = self._cont.get(tuple(ctx[:plen]))
+            if cont is None:
+                continue
+            done = len(ctx) - plen  # tokens already emitted
+            if done < 0 or ctx[plen:] != cont[:done]:
+                continue  # diverged from the recorded run: stop drafting
+            return cont[done:done + k]
+        return []
+
+
+class DraftModelProposer:
+    """Greedy K-token draft from a small dense model sharing the device.
+
+    Recomputes the full window per drafted token (K forwards over a
+    fixed `window`-token tail) — fine for the tiny draft models this is
+    meant for; the verify launch amortizes the real model regardless.
+    """
+
+    def __init__(self, model_config: Any, params: Any, window: int = 64):
+        from ...models.transformer import init_cache, prefill
+
+        self.window = int(window)
+        mc = model_config
+
+        def _draft(params, buf, length, k_steps):
+            def body(carry, _):
+                buf, n = carry
+                cache = init_cache(mc, 1, buf.shape[1])
+                logits, _ = prefill(params, buf, n[None], cache, mc)
+                nxt = jnp.argmax(logits[0]).astype(jnp.int32)
+                buf = jax.lax.dynamic_update_slice(
+                    buf, nxt[None, None], (0, n)
+                )
+                return (buf, n + 1), nxt
+
+            (_, _), toks = jax.lax.scan(
+                body, (buf, length), None, length=k_steps
+            )
+            return toks
+
+        self._params = params
+        self._draft = jax.jit(_draft, static_argnums=(3,))
+
+    def propose(self, context: Sequence[int], k: int) -> List[int]:
+        if k <= 0:
+            return []
+        tail = list(context)[-(self.window - k):]
+        buf = np.zeros((1, self.window), dtype=np.int32)
+        buf[0, : len(tail)] = tail
+        toks = self._draft(
+            self._params, jnp.asarray(buf),
+            jnp.asarray(len(tail), jnp.int32), int(k),
+        )
+        # Opt-in proposer: this host read is the draft model's output and
+        # the engine budgets a full round trip per verify round anyway.
+        return [int(t) for t in np.asarray(toks)]  # raylint: disable=jax-hot-path
+
+
+# ------------------------------------------------------------ accept step
+
+
+def filtered_scores(logits, temps, top_ks, top_ps):
+    """Per-lane temperature + top-k + top-p filtered scores (log-space;
+    filtered-out tokens at -inf). POSITIONAL filtering over one argsort:
+    exactly top_k tokens survive even under logit ties, and the nucleus
+    keep-mask scatters back through the sort order (disabled lanes use
+    k=V / p=1.0, which keep all). softmax of the result is the exact
+    distribution `_sample_filtered` draws from — the accept step scores
+    drafts against it so speculative output matches plain sampling."""
+    b, vocab = logits.shape
+    scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+    order = jnp.argsort(scaled, axis=-1)[:, ::-1]  # desc indices
+    desc = jnp.take_along_axis(scaled, order, axis=-1)
+    k_idx = jnp.where(top_ks > 0, top_ks, vocab)
+    positions = jnp.arange(vocab)[None, :]
+    in_topk = positions < k_idx[:, None]
+    p_desc = jax.nn.softmax(jnp.where(in_topk, desc, -jnp.inf), axis=-1)
+    cum = jnp.cumsum(p_desc, axis=-1)
+    # keep a token if the cumulative mass BEFORE it is < top_p
+    # (the top token always survives: cum - p == 0 there)
+    keep_sorted = in_topk & ((cum - p_desc) < top_ps[:, None])
+    keep = jnp.zeros_like(keep_sorted).at[
+        jnp.arange(b)[:, None], order
+    ].set(keep_sorted)
+    return jnp.where(keep, scaled, -jnp.inf)
+
+
+def accept_speculative(logits, tokens, counts, key, temps, top_ks, top_ps):
+    """Exact accept/resample over one verify round.
+
+    logits: (B, K, V) verified logits; row j scores the token AFTER
+        input row j (inputs are `tokens`: row 0 the pending token, rows
+        1..K-1 the drafts).
+    tokens: (B, K) int32 verify inputs.
+    counts: (B,) int32 real input rows per lane (0 = inactive).
+    Returns (out_tokens (B, K), n_out (B,)): lane b emits
+    out_tokens[b, :n_out[b]] — its accepted drafts followed by the
+    corrected (on rejection) or bonus (all accepted) token. n_out is
+    always >= 1 for active lanes: a round can only add tokens.
+    """
+    b, kd, vocab = logits.shape
+    flat = filtered_scores(
+        logits.reshape(b * kd, vocab),
+        jnp.repeat(temps, kd), jnp.repeat(top_ks, kd), jnp.repeat(top_ps, kd),
+    )
+    scores = flat.reshape(b, kd, vocab)
+    probs = jax.nn.softmax(scores, axis=-1)
+    greedy = jnp.argmax(logits, axis=-1)  # (B, K) — matches _sample_* at t=0
+
+    drafts = tokens[:, 1:]  # (B, K-1): draft j+1 is scored by logits row j
+    k_u, k_r = jax.random.split(key)
+    if kd > 1:
+        p_draft = jnp.take_along_axis(
+            probs[:, :-1, :], drafts[..., None], axis=-1
+        )[..., 0]  # (B, K-1)
+        accept_greedy = drafts == greedy[:, :-1]
+        u = jax.random.uniform(k_u, (b, kd - 1))
+        accept = jnp.where(temps[:, None] <= 0.0, accept_greedy, u < p_draft)
+        # draft j+1 only exists (and only verifies) inside the real rows
+        accept &= jnp.arange(kd - 1)[None, :] < (counts[:, None] - 1)
+        run = jnp.cumprod(accept.astype(jnp.int32), axis=1)
+        a = jnp.sum(run, axis=1)  # accepted draft count per lane
+    else:
+        a = jnp.zeros((b,), jnp.int32)
+    lane = jnp.arange(b)
+    # correction/bonus comes from verified row a: on rejection the first
+    # rejected draft is masked out of row a's distribution (the exact
+    # point-mass residual); when every draft accepted, row a == counts-1
+    # and the full distribution yields the bonus token.
+    row_scores = scores[lane, a]  # (B, V)
+    rejected = tokens[lane, jnp.minimum(a + 1, kd - 1)]
+    bonus = a >= (counts - 1)
+    resid = jnp.where(
+        (jax.nn.one_hot(rejected, vocab, dtype=bool)) & (~bonus)[:, None],
+        -jnp.inf, row_scores,
+    )
+    next_sampled = jax.random.categorical(k_r, resid, axis=-1)
+    next_tok = jnp.where(
+        temps <= 0.0, greedy[lane, a], next_sampled
+    ).astype(jnp.int32)
+    idx = jnp.arange(kd)[None, :]
+    draft_shift = jnp.concatenate(
+        [drafts, jnp.zeros((b, 1), tokens.dtype)], axis=1
+    ) if kd > 1 else jnp.zeros((b, kd), tokens.dtype)
+    out = jnp.where(
+        idx < a[:, None], draft_shift,
+        jnp.where(idx == a[:, None], next_tok[:, None], 0),
+    ).astype(jnp.int32)
+    n_out = jnp.where(counts > 0, a + 1, 0).astype(jnp.int32)
+    return out, n_out

@@ -36,6 +36,7 @@ HOT_PATH_PREFIXES = (
     "ray_tpu/train/",
     "ray_tpu/ops/",
     "ray_tpu/parallel/",
+    "ray_tpu/serve/llm/",
 )
 
 _FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)

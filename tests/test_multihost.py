@@ -9,6 +9,7 @@ changes (reference gang bootstrap: train/_internal/backend_executor.py:230).
 import os
 import time
 
+import jax
 import pytest
 
 from ray_tpu.train.multihost import MultihostWorkerGroup
@@ -77,6 +78,11 @@ def _tiny_train_fn(config):
     return losses
 
 
+@pytest.mark.skipif(
+    jax.default_backend() == "cpu",
+    reason="XLA rejects the 2-process gang on CPU: 'Multiprocess computations "
+    "aren't implemented on the CPU backend' (pre-existing since seed)",
+)
 def test_two_process_distributed_matches_single_process():
     # baseline: same SPMD program on 2 devices of THIS process
     baseline = _tiny_train_fn({"n_devices": 2})
