@@ -1,0 +1,311 @@
+"""Spot-fleet cluster drill: the capacity plane closing the loop.
+
+A 2-worker training gang and a serve deployment share ONE autoscaled
+spot cluster: every worker node exists because the CapacityAutoscaler
+aggregated demand (gang bundles, replica actors) and launched it.
+Scheduled preemptions with warning windows then reclaim BOTH fleets'
+nodes, one after the other:
+
+- the training gang emergency-checkpoints inside the warning window and
+  re-meshes onto replacement capacity that was pre-provisioned BEFORE
+  the old node died, finishing with `max_failures=0` (only the
+  preemption budget is consumed);
+- serve rides its node's reclaim through replica restarts on the
+  replacement, surfacing only TYPED errors to the open client loop;
+- the whole episode reconstructs from one `state.postmortem()` bundle:
+  `preempt.announced` -> `autoscaler.replace` -> `node.dead` per victim,
+  and the run's wall time fully attributed to goodput buckets.
+
+One JSON line reports the episode; it is also self-captured as the next
+BENCH_CLUSTER_r<NN>.json round file.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+
+def _emit_result(payload: dict, rc: int = 0) -> None:
+    """Print the ONE result line and self-capture it as the next
+    BENCH_CLUSTER_r<NN>.json round file (same {n, cmd, rc, tail, parsed}
+    shape the driver writes for bench.py), anchored to the repo root so
+    the round history survives whatever cwd the bench ran from."""
+    line = json.dumps(payload)
+    print(line)
+    root = os.path.dirname(os.path.abspath(__file__))
+    rounds = [
+        int(os.path.basename(p)[len("BENCH_CLUSTER_r"):-len(".json")])
+        for p in glob.glob(os.path.join(root, "BENCH_CLUSTER_r*.json"))
+        if os.path.basename(p)[len("BENCH_CLUSTER_r"):-len(".json")].isdigit()
+    ]
+    n = max(rounds, default=0) + 1
+    path = os.path.join(root, f"BENCH_CLUSTER_r{n:02d}.json")
+    with open(path, "w") as f:
+        json.dump(
+            {
+                "n": n,
+                "cmd": "python " + " ".join(sys.argv),
+                "rc": rc,
+                "tail": line + "\n",
+                "parsed": payload,
+            },
+            f,
+        )
+        f.write("\n")
+
+
+def _first_ts(evs, kind, **match):
+    for e in evs:
+        if e.get("kind") != kind:
+            continue
+        extra = e.get("extra") or {}
+        if all(extra.get(k) == v for k, v in match.items()):
+            return e["ts"]
+    return None
+
+
+def _ordered(evs, victim_hex):
+    """preempt.announced -> autoscaler.replace -> node.dead for one
+    reclaimed node, on the bundle's shared wall clock."""
+    announced = _first_ts(
+        [e for e in evs if e.get("node") == victim_hex], "preempt.announced"
+    )
+    replace = _first_ts(evs, "autoscaler.replace", replaces=victim_hex)
+    dead = _first_ts(
+        [e for e in evs if e.get("node") == victim_hex], "node.dead"
+    )
+    if None in (announced, replace, dead):
+        return False
+    return announced <= replace <= dead
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=60,
+                    help="training steps per run")
+    ap.add_argument("--workers", type=int, default=2,
+                    help="training gang size (one spot node per worker)")
+    ap.add_argument("--replicas", type=int, default=2,
+                    help="serve replicas (they share one spot node)")
+    ap.add_argument("--warning-s", type=float, default=2.0,
+                    help="preemption warning window")
+    args = ap.parse_args()
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.core.capacity import (
+        CapacityAutoscaler, FakeNodeProvider, NodeType, SpotNodeProvider,
+    )
+    from ray_tpu.core.exceptions import RayTpuError
+    from ray_tpu.train import (
+        FailureConfig, RunConfig, RunStatus, ScalingConfig, TrainController,
+    )
+    from ray_tpu.util import state
+    from ray_tpu.util.events import events
+    from ray_tpu.util.postmortem import load_bundle
+
+    workdir = tempfile.mkdtemp(prefix="bench_cluster_")
+    rt = ray_tpu.init(num_cpus=1, detect_accelerators=False)
+    scaler = None
+    rc = 1
+    try:
+        events().clear()
+        provider = SpotNodeProvider(FakeNodeProvider(rt.scheduler),
+                                    warning_s=args.warning_s)
+        scaler = CapacityAutoscaler(
+            rt.scheduler, provider,
+            [
+                NodeType("spot-train", {"CPU": 1.0, "trainer": 1.0},
+                         capacity_class="spot"),
+                NodeType("spot-serve",
+                         {"CPU": float(args.replicas),
+                          "serve_slot": float(args.replicas)},
+                         capacity_class="spot"),
+            ],
+            poll_interval_s=0.05, idle_timeout_s=60.0, runtime=rt,
+        )
+        scaler.start()
+
+        @serve.deployment(num_replicas=args.replicas,
+                          resources_per_replica={"CPU": 1.0,
+                                                 "serve_slot": 1.0})
+        class Echo:
+            def __call__(self, x):
+                return f"ok-{x}"
+
+        handle = serve.run(Echo.bind(), name="fleet-echo")
+        assert ray_tpu.get(handle.remote(0), timeout=60) == "ok-0"
+
+        total_steps = args.steps
+
+        def train_fn(config):
+            from ray_tpu import train
+
+            ctx = train.get_context()
+            ckpt = train.get_checkpoint()
+            start = int(ckpt["step"]) + 1 if ckpt is not None else 0
+            for step in range(start, total_steps):
+                time.sleep(0.02)
+                if ctx.world_rank != 0:
+                    if train.is_preempted():
+                        return "preempted"
+                    continue
+                if train.should_checkpoint():
+                    train.report({"step": step}, checkpoint={"step": step},
+                                 checkpoint_step=step)
+                elif train.is_preempted():
+                    return "preempted"
+                elif step % 10 == 9:
+                    train.report({"step": step}, checkpoint={"step": step},
+                                 checkpoint_step=step)
+                else:
+                    train.report({"step": step})
+            return "done"
+
+        controller = TrainController(
+            train_fn,
+            ScalingConfig(num_workers=args.workers,
+                          resources_per_worker={"CPU": 1.0, "trainer": 1.0}),
+            RunConfig(name="fleet-train",
+                      storage_path=os.path.join(workdir, "trial"),
+                      failure=FailureConfig(max_failures=0)),
+            train_config={},
+            restart_backoff_s=0.0,
+        )
+        box = {}
+        thread = threading.Thread(
+            target=lambda: box.update(result=controller.run()), daemon=True
+        )
+        thread.start()
+
+        serve_ok = [0]
+        serve_errors: list = []
+        stop_serving = threading.Event()
+
+        def client_loop():
+            i = 1
+            while not stop_serving.is_set():
+                try:
+                    out = ray_tpu.get(handle.remote(i), timeout=30)
+                    assert out == f"ok-{i}"
+                    serve_ok[0] += 1
+                except Exception as exc:  # noqa: BLE001 - tallied, typedness checked below
+                    serve_errors.append(exc)
+                i += 1
+                time.sleep(0.05)
+
+        client = threading.Thread(target=client_loop, daemon=True)
+        client.start()
+
+        deadline = time.monotonic() + 60
+        while not controller.metrics_history and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert controller.metrics_history, "gang never started reporting"
+
+        # ---- preemption 1: a gang-hosting train node
+        train_victim = next(
+            n for n in rt.scheduler.nodes()
+            if n.labels.get("node_type") == "spot-train"
+            and rt.scheduler.resident_bundles(n.node_id.hex())
+        )
+        provider.preempt_after(train_victim, 0.01, warning_s=args.warning_s)
+
+        thread.join(timeout=180)
+        assert not thread.is_alive(), "controller never finished"
+        result = box["result"]
+
+        # ---- preemption 2: the serve node; replicas must come back
+        serve_victim = next(
+            n for n in rt.scheduler.nodes()
+            if n.labels.get("node_type") == "spot-serve" and n.alive
+        )
+        provider.preempt_after(serve_victim, 0.01, warning_s=args.warning_s)
+        deadline = time.monotonic() + 30
+        while serve_victim.alive and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert not serve_victim.alive, "serve node never reclaimed"
+        # recovered = replicas live again AND a fresh request round-trips
+        recovered = False
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            status = serve.status().get("fleet-echo", {})
+            if status.get("live_replicas", 0) >= args.replicas:
+                try:
+                    if ray_tpu.get(handle.remote("post"),
+                                   timeout=10) == "ok-post":
+                        recovered = True
+                        break
+                except RayTpuError:
+                    pass
+            time.sleep(0.1)
+        stop_serving.set()
+        client.join(timeout=30)
+
+        # the train victim's reclaim also has to land before we bundle
+        deadline = time.monotonic() + 30
+        while train_victim.alive and time.monotonic() < deadline:
+            time.sleep(0.05)
+
+        untyped = [e for e in serve_errors if not isinstance(e, RayTpuError)]
+
+        # ---- one bundle reconstructs the whole episode
+        bundle_path = os.path.join(workdir, "episode.tgz")
+        state.postmortem(bundle_path, note="spot-fleet bench drill")
+        evs = load_bundle(bundle_path)["events.jsonl"]
+        train_order_ok = _ordered(evs, train_victim.node_id.hex())
+        serve_order_ok = _ordered(evs, serve_victim.node_id.hex())
+
+        goodput = result.goodput or {}
+        buckets = goodput.get("buckets", {})
+        ok = (
+            result.status == RunStatus.FINISHED
+            and result.num_preempt_restarts == 1
+            and scaler.stats["replacements"] >= 2
+            and train_order_ok and serve_order_ok
+            and recovered and not untyped
+        )
+        rc = 0 if ok else 1
+        _emit_result({
+            "metric": "cluster_spot_fleet_goodput_fraction",
+            "value": round(goodput.get("goodput_fraction", 0.0), 3),
+            "unit": "fraction",
+            "vs_baseline": 0.0,
+            "passed": ok,
+            "train_status": str(result.status),
+            "steps": total_steps,
+            "workers": args.workers,
+            "num_preempt_restarts": result.num_preempt_restarts,
+            "max_failures_burned": 0 if result.status == RunStatus.FINISHED
+            else 1,
+            "preemptions": provider.num_preemptions(),
+            "warning_s": args.warning_s,
+            "scale_ups": scaler.stats["scale_ups"],
+            "scale_downs": scaler.stats["scale_downs"],
+            "replacements": scaler.stats["replacements"],
+            "train_event_order_ok": train_order_ok,
+            "serve_event_order_ok": serve_order_ok,
+            "serve_recovered": recovered,
+            "serve_requests_ok": serve_ok[0],
+            "serve_typed_errors": len(serve_errors) - len(untyped),
+            "serve_untyped_errors": len(untyped),
+            "wall_time_s": round(goodput.get("wall_time_s", 0.0), 3),
+            "goodput_buckets": {k: round(v, 3) for k, v in buckets.items()},
+            "postmortem_bundle": bundle_path,
+        }, rc)
+        serve.shutdown()
+    finally:
+        if scaler is not None:
+            scaler.stop()
+        ray_tpu.shutdown()
+    sys.exit(rc)
+
+
+if __name__ == "__main__":
+    main()

@@ -119,7 +119,14 @@ def _cmd_status(args) -> int:
         time.sleep(1.0)  # let the cluster view + node table populate
     else:
         ray_tpu.init(detect_accelerators=not args.no_tpu)
-    if args.json:
+    if getattr(args, "autoscaler", False):
+        # capacity-plane view only: managed nodes by type/class, pending
+        # demand by origin, scale/replace/blocked counters
+        scaler = state.autoscaler_summary()
+        print(json.dumps(scaler if scaler is not None
+                         else {"autoscaler": "not running"},
+                         indent=2, default=str))
+    elif args.json:
         print(json.dumps(state.summary(), indent=2, default=str))
     else:
         print(state.status_report(verbose=args.verbose))
@@ -366,6 +373,9 @@ def build_parser() -> argparse.ArgumentParser:
                     help="also show per-node log tails")
     sp.add_argument("--json", action="store_true",
                     help="emit state.summary() JSON instead of the report")
+    sp.add_argument("--autoscaler", action="store_true",
+                    help="emit only the capacity-plane (autoscaler) "
+                         "status as JSON")
 
     st = sub.add_parser("start", help="start a cluster head or join one")
     st.add_argument("--head", action="store_true",

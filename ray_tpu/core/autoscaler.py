@@ -1,287 +1,41 @@
-"""Autoscaler: demand-driven node add/remove over a NodeProvider.
+"""Back-compat facade for the capacity plane.
 
-Reference parity: autoscaler/_private/autoscaler.py:172 StandardAutoscaler
-(bin-packing demand → node types, resource_demand_scheduler.py) with the
-FakeMultiNodeProvider testing pattern (fake_multi_node/node_provider.py:236
-— scale logic exercised with in-process nodes, no cloud credentials).
-
-The provider here creates *logical* nodes in the in-process scheduler; on
-real deployments a provider would drive GKE/GCE TPU pod APIs with the same
-interface.
+The policy core moved to :mod:`ray_tpu.core.capacity` (demand ledger,
+spot-aware provisioning, drain-path lifecycle). This module keeps the
+historical import surface alive: ``Autoscaler`` is the
+:class:`~ray_tpu.core.capacity.CapacityAutoscaler`, and the providers /
+``NodeType`` re-export unchanged.
 """
 
 from __future__ import annotations
 
-import dataclasses
-import threading
-import time
-from typing import Dict, List, Optional
+from .capacity import (  # noqa: F401
+    CapacityAutoscaler,
+    Demand,
+    DemandLedger,
+    FakeNodeProvider,
+    LocalProcessNodeProvider,
+    NodeProvider,
+    NodeType,
+    SpotNodeProvider,
+    active_autoscaler,
+    register_demand_source,
+    unregister_demand_source,
+)
 
-from .ids import NodeID
-from .resources import ResourceDict, ResourceSet
-from .scheduler import ClusterScheduler, Node
+Autoscaler = CapacityAutoscaler
 
-
-@dataclasses.dataclass
-class NodeType:
-    name: str
-    resources: ResourceDict
-    max_workers: int = 10
-
-
-class NodeProvider:
-    """Create/terminate nodes. The fake provider materializes logical nodes
-    directly in the scheduler; cloud providers would call infra APIs."""
-
-    def create_node(self, node_type: NodeType) -> Node:
-        raise NotImplementedError
-
-    def terminate_node(self, node: Node) -> None:
-        raise NotImplementedError
-
-
-class LocalProcessNodeProvider(NodeProvider):
-    """Autoscale with REAL nodes: each create_node spawns a worker-agent
-    OS process (`ray_tpu start --address=...`) that joins the cluster,
-    and terminate_node shuts it down gracefully. This is the reference's
-    FakeMultiNodeProvider pattern (fake_multi_node/node_provider.py:236)
-    upgraded from logical nodes to real processes; a cloud provider
-    would call GKE/GCE TPU APIs behind the same two methods."""
-
-    def __init__(self, runtime, startup_timeout_s: float = 60.0):
-        if runtime.cluster is None:
-            raise ValueError(
-                "LocalProcessNodeProvider needs a cluster runtime "
-                "(init(head=True)) — agents must have a GCS to join"
-            )
-        self.runtime = runtime
-        self.startup_timeout_s = startup_timeout_s
-        self._procs: Dict[str, object] = {}  # node id hex -> Popen
-
-    def create_node(self, node_type: NodeType) -> Node:
-        import json
-        import subprocess
-        import sys
-
-        ctx = self.runtime.cluster
-        res = dict(node_type.resources)
-        num_cpus = int(res.pop("CPU", 1))
-        labels = {"node_type": node_type.name, "autoscaled": "1"}
-        before = {n.node_id.hex() for n in self.runtime.scheduler.nodes()}
-        cmd = [
-            sys.executable, "-m", "ray_tpu", "--no-tpu", "start",
-            "--address", ctx.gcs_address, "--num-cpus", str(num_cpus),
-            "--labels", json.dumps(labels),
-        ]
-        if res:
-            cmd += ["--resources", json.dumps(res)]
-        if ctx.token:
-            cmd += ["--token", ctx.token]
-        proc = subprocess.Popen(
-            cmd, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL
-        )
-        deadline = time.monotonic() + self.startup_timeout_s
-        while time.monotonic() < deadline:
-            for node in self.runtime.scheduler.nodes():
-                hex_id = node.node_id.hex()
-                if hex_id not in before and node.labels.get("autoscaled") == "1":
-                    self._procs[hex_id] = proc
-                    return node
-            if proc.poll() is not None:
-                raise RuntimeError(
-                    f"autoscaled agent exited rc={proc.returncode} before joining"
-                )
-            time.sleep(0.05)
-        proc.kill()
-        raise TimeoutError("autoscaled agent did not join in time")
-
-    def terminate_node(self, node: Node) -> None:
-        proc = self._procs.pop(node.node_id.hex(), None)
-        try:
-            node.client.call("shutdown_node")  # graceful: agent deregisters
-        except Exception:
-            pass
-        if proc is not None:
-            try:
-                proc.wait(timeout=10)
-            except Exception:
-                proc.kill()
-                proc.wait()
-        self.runtime.scheduler.remove_node(node.node_id)
-
-    def shutdown(self) -> None:
-        for proc in self._procs.values():
-            try:
-                proc.kill()
-                proc.wait()
-            except Exception:
-                pass
-        self._procs.clear()
-
-
-class FakeNodeProvider(NodeProvider):
-    def __init__(self, scheduler: ClusterScheduler):
-        self.scheduler = scheduler
-        self.created: List[Node] = []
-
-    def create_node(self, node_type: NodeType) -> Node:
-        node = Node(
-            NodeID.from_random(),
-            dict(node_type.resources),
-            is_head=False,
-            labels={"node_type": node_type.name, "autoscaled": "1"},
-        )
-        self.scheduler.add_node(node)
-        self.created.append(node)
-        return node
-
-    def terminate_node(self, node: Node) -> None:
-        self.scheduler.remove_node(node.node_id)
-
-
-class Autoscaler:
-    """Poll loop: unsatisfiable pending demand → scale up; idle autoscaled
-    nodes → scale down after idle_timeout."""
-
-    def __init__(
-        self,
-        scheduler: ClusterScheduler,
-        provider: NodeProvider,
-        node_types: List[NodeType],
-        *,
-        poll_interval_s: float = 0.1,
-        idle_timeout_s: float = 5.0,
-    ):
-        self.scheduler = scheduler
-        self.provider = provider
-        self.node_types = node_types
-        self.poll_interval_s = poll_interval_s
-        self.idle_timeout_s = idle_timeout_s
-        self._managed: Dict[str, Node] = {}  # node id hex -> node
-        self._idle_since: Dict[str, float] = {}
-        self._per_type_count: Dict[str, int] = {t.name: 0 for t in node_types}
-        self._stop = threading.Event()
-        self._thread: Optional[threading.Thread] = None
-        self.stats = {"scale_ups": 0, "scale_downs": 0}
-
-    # ------------------------------------------------------------------ loop
-
-    def start(self) -> None:
-        if self._thread is None or not self._thread.is_alive():
-            # infeasible demand now means "provision", not "error"
-            self.scheduler.fail_fast_infeasible = False
-            self._stop.clear()
-            self._thread = threading.Thread(
-                target=self._loop, daemon=True, name="autoscaler"
-            )
-            self._thread.start()
-
-    def stop(self) -> None:
-        self._stop.set()
-        if self._thread:
-            self._thread.join(timeout=5)
-        self.scheduler.fail_fast_infeasible = True
-
-    def _loop(self) -> None:
-        while not self._stop.wait(self.poll_interval_s):
-            try:
-                self.step()
-            except Exception:
-                pass
-
-    # ---------------------------------------------------------------- policy
-
-    def step(self) -> None:
-        self._scale_up()
-        self._scale_down()
-        # demand that NO node and NO node type can ever cover must fail
-        # loudly, not queue forever (fail_fast_infeasible is off while we
-        # run, so the scheduler defers that judgment to us)
-        self.scheduler.fail_unprovisionable(self._can_ever_provision)
-
-    def _can_ever_provision(self, demand: ResourceDict) -> bool:
-        if self._fits_on_some_node(demand):
-            return True
-        return any(
-            all(t.resources.get(k, 0.0) >= v for k, v in demand.items())
-            for t in self.node_types  # max_workers ignored: slots free up
-        )
-
-    def _fits_on_some_node(self, demand: ResourceDict) -> bool:
-        for node in self.scheduler.nodes():
-            if not node.alive:
-                continue
-            total = node.resources.total
-            if all(total.get(k, 0.0) >= v for k, v in demand.items()):
-                return True
-        return False
-
-    def _pick_type(self, demand: ResourceDict) -> Optional[NodeType]:
-        for t in self.node_types:
-            if self._per_type_count[t.name] >= t.max_workers:
-                continue
-            if all(t.resources.get(k, 0.0) >= v for k, v in demand.items()):
-                return t
-        return None
-
-    def _scale_up(self) -> None:
-        # simple bin-pack: walk unsatisfiable demands, launch nodes whose
-        # type covers them, packing multiple demands per planned node
-        demands = self.scheduler.pending_demand()
-        unmet = [d for d in demands if not self._fits_on_some_node(d)]
-        planned: List[ResourceSet] = []
-        for demand in unmet:
-            placed = False
-            for pool in planned:
-                if pool.try_acquire(demand):
-                    placed = True
-                    break
-            if placed:
-                continue
-            node_type = self._pick_type(demand)
-            if node_type is None:
-                continue
-            node = self.provider.create_node(node_type)
-            self._managed[node.node_id.hex()] = node
-            self._per_type_count[node_type.name] += 1
-            self.stats["scale_ups"] += 1
-            pool = ResourceSet(dict(node_type.resources))
-            pool.try_acquire(demand)
-            planned.append(pool)
-
-    def _node_is_idle(self, node: Node) -> bool:
-        with node._lock:
-            busy = bool(node.running_tasks)
-        avail = node.resources.available()
-        total = node.resources.total
-        fully_free = all(abs(avail.get(k, 0.0) - v) < 1e-9 for k, v in total.items())
-        return not busy and fully_free
-
-    def _scale_down(self) -> None:
-        now = time.monotonic()
-        for hex_id, node in list(self._managed.items()):
-            if self._node_is_idle(node):
-                since = self._idle_since.setdefault(hex_id, now)
-                if now - since >= self.idle_timeout_s:
-                    from ..util.events import emit
-
-                    emit("INFO", "autoscaler",
-                         f"terminated idle node {node.node_id.hex()[:12]}",
-                         kind="autoscaler.scaled",
-                         node=node.node_id.hex(), direction="down")
-                    self.provider.terminate_node(node)
-                    node_type = node.labels.get("node_type")
-                    if node_type in self._per_type_count:
-                        self._per_type_count[node_type] -= 1
-                    del self._managed[hex_id]
-                    self._idle_since.pop(hex_id, None)
-                    self.stats["scale_downs"] += 1
-            else:
-                self._idle_since.pop(hex_id, None)
-
-    def status(self) -> Dict[str, object]:
-        return {
-            "managed_nodes": len(self._managed),
-            "per_type": dict(self._per_type_count),
-            **self.stats,
-        }
+__all__ = [
+    "Autoscaler",
+    "CapacityAutoscaler",
+    "Demand",
+    "DemandLedger",
+    "FakeNodeProvider",
+    "LocalProcessNodeProvider",
+    "NodeProvider",
+    "NodeType",
+    "SpotNodeProvider",
+    "active_autoscaler",
+    "register_demand_source",
+    "unregister_demand_source",
+]

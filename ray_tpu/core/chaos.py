@@ -108,6 +108,27 @@ def set_preemption_hook(hook) -> None:
     _state.preempt_hook = hook
 
 
+def trigger_preemption(node, warning_s: float, reason: str,
+                       mode: str = "spot_preempt") -> bool:
+    """Pull the announced-preemption trigger OUTSIDE the task-boundary
+    injection path — SpotNodeProvider schedules and drills call this.
+    Emits the chaos.injected breadcrumb, then runs the registered hook
+    (the runtime's drain→announce→kill path). Returns False when no
+    hook is installed (runtime already shut down)."""
+    hook = _state.preempt_hook
+    if hook is None:
+        return False
+    node_id = getattr(node, "node_id", None)
+    from ..util.events import emit
+
+    emit("WARNING", "chaos",
+         f"chaos injected {mode}: {reason}",
+         kind="chaos.injected", mode=mode,
+         node=node_id.hex() if node_id is not None else None)
+    hook(node, warning_s, reason)
+    return True
+
+
 def clear_chaos() -> None:
     with _state.lock:
         _state.config = None

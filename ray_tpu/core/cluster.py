@@ -930,12 +930,16 @@ class ClusterContext:
                 continue  # our own announcement: begin_preemption handled it
             with self._lock:
                 node = self._remote_nodes.get(node_hex)
-            if node is None or node.draining:
-                continue
-            self.runtime.scheduler.mark_node_draining(
-                node_hex, msg.get("reason", "preempted"),
-                msg.get("deadline", 0.0),
-            )
+            if node is not None and node.draining:
+                continue  # already drained + relayed
+            if node is not None:
+                self.runtime.scheduler.mark_node_draining(
+                    node_hex, msg.get("reason", "preempted"),
+                    msg.get("deadline", 0.0),
+                )
+            # relay even when the local node table hasn't caught up yet:
+            # in-process subscribers (train controllers, the capacity
+            # plane) must hear cluster-wide announcements regardless
             self.runtime.gcs.pubsub.publish(PREEMPT_CHANNEL, msg)
 
     def nodes(self) -> List[Dict[str, Any]]:

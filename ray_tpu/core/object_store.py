@@ -1079,3 +1079,17 @@ class ObjectStore:
                 "capacity_bytes": self._capacity,
                 "num_objects": len(self._entries),
             }
+
+    def has_primary_copy_at(self, address: str) -> bool:
+        """Whether any object's primary copy lives in the remote store
+        at `address`. The capacity plane refuses to retire a node whose
+        store still owns primary copies — terminating it would destroy
+        the only durable replica."""
+        if not address:
+            return False
+        with self._lock:
+            entries = list(self._entries.values())
+        return any(
+            entry.tier == Tier.REMOTE and entry.remote_addr == address
+            for entry in entries
+        )

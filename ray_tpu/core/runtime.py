@@ -999,6 +999,12 @@ class Runtime:
              f"preempted node {node_hex[:12]} died after its warning "
              f"window", kind="node.preempt_expired", node=node_hex,
              reason=reason)
+        # the logical node IS dead now — record it as such so in-process
+        # drills share the cluster-path timeline (announce → replace →
+        # dead), not just the preempt-specific breadcrumb above
+        emit("ERROR", "cluster",
+             f"node {node_hex[:12]} is dead (preempted: {reason})",
+             kind="node.dead", node=node_hex, reason=reason)
         self.scheduler.remove_node(node.node_id)
         with self._lock:
             doomed = [
@@ -1010,6 +1016,23 @@ class Runtime:
                 reason=f"node {node_hex[:12]} preempted: {reason}",
             )
         self.scheduler.handle_node_death(node_hex, f"preempted: {reason}")
+
+    def node_pinned(self, node: Node) -> bool:
+        """Whether retiring `node` would destroy live state: an actor
+        hosted there that is not DEAD, or (remote nodes) an object whose
+        primary copy lives in that node's store. The capacity plane
+        consults this before selecting a node for scale-down."""
+        from .actors import ActorState
+
+        with self._lock:
+            actors = list(self._actors.values())
+        for ar in actors:
+            if ar._node is node and ar.state != ActorState.DEAD:
+                return True
+        agent_addr = getattr(node, "agent_addr", None)
+        if agent_addr:
+            return self.object_store.has_primary_copy_at(agent_addr)
+        return False
 
     def shutdown(self) -> None:
         from . import chaos as _chaos

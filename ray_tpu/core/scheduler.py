@@ -465,10 +465,63 @@ class ClusterScheduler:
             return list(self._nodes.values())
 
     def pending_demand(self) -> List[ResourceDict]:
-        """Resource requests of queued-but-unschedulable tasks (the
-        autoscaler's input; reference resource_demand_scheduler.py)."""
+        """Every pending resource demand: queued-but-unschedulable tasks
+        PLUS unplaceable placement-group bundles (initially-unplaceable
+        groups queued behind an autoscaler, and dead bundles of
+        RESCHEDULING groups). `ray_tpu status` and the capacity plane
+        read the same list (reference resource_demand_scheduler.py)."""
+        out = self.pending_task_demand()
+        for gang in self.pending_gang_demand():
+            out.extend(dict(r) for r in gang["bundles"])
+        return out
+
+    def pending_task_demand(self) -> List[ResourceDict]:
+        """Resource requests of queued-but-unschedulable tasks only."""
         with self._lock:
             return [dict(spec.resources) for spec in self._pending]
+
+    def pending_gang_demand(self) -> List[Dict[str, Any]]:
+        """Unplaceable placement-group bundles, gang-atomic: one entry
+        per group awaiting capacity (PENDING) or rescheduling after a
+        bundle-host death, with the bundles that still need a node. The
+        capacity plane must plan each entry onto co-launched capacity,
+        never satisfy it piecemeal."""
+        with self._lock:
+            pgs = list(self._placement_groups.values())
+        out: List[Dict[str, Any]] = []
+        for pg in pgs:
+            if pg.removed or pg.state in ("RESERVED", "FAILED", "REMOVED"):
+                continue
+            unplaced = [
+                dict(b.resources) for b in pg.bundles
+                if b.node is None or not b.node.alive
+            ]
+            if unplaced:
+                out.append({
+                    "pg": pg.id.hex(),
+                    "name": pg.name,
+                    "state": pg.state,
+                    "bundles": unplaced,
+                })
+        return out
+
+    def resident_bundles(self, node_hex: str) -> List[List[ResourceDict]]:
+        """Bundle resources of placement groups with a reservation on
+        `node_hex`, one gang per group. The capacity plane pre-provisions
+        these first when that node announces a preemption."""
+        with self._lock:
+            pgs = list(self._placement_groups.values())
+        out: List[List[ResourceDict]] = []
+        for pg in pgs:
+            if pg.removed or pg.state in ("FAILED", "REMOVED"):
+                continue
+            on_node = [
+                dict(b.resources) for b in pg.bundles
+                if b.node is not None and b.node.node_id.hex() == node_hex
+            ]
+            if on_node:
+                out.append(on_node)
+        return out
 
     def fail_unprovisionable(self, can_provision) -> int:
         """Fail queued tasks whose demand `can_provision(resources)`
@@ -499,7 +552,29 @@ class ClusterScheduler:
                     f"current node or provisionable node type can satisfy"
                 ),
             )
-        return len(removed)
+        # Placement groups waiting for capacity are judged the same way:
+        # a gang with a bundle no node type could EVER cover must fail
+        # loudly instead of parking in RESCHEDULING forever.
+        with self._lock:
+            waiting = [
+                pg for pg in self._placement_groups.values()
+                if not pg.removed and pg.state in ("PENDING", "RESCHEDULING")
+            ]
+        failed_pgs = 0
+        for pg in waiting:
+            impossible = [
+                dict(b.resources) for b in pg.bundles
+                if (b.node is None or not b.node.alive)
+                and not can_provision(dict(b.resources))
+            ]
+            if impossible:
+                pg.failure_reason = (
+                    f"bundle(s) {impossible} exceed every current node and "
+                    f"provisionable node type"
+                )
+                self._pg_transition(pg, "FAILED", pg.failure_reason)
+                failed_pgs += 1
+        return len(removed) + failed_pgs
 
     def head_node(self) -> Node:
         with self._lock:
@@ -606,14 +681,21 @@ class ClusterScheduler:
             acquired: List[Tuple[Node, ResourceDict]] = []
             with self._lock:
                 placement = self._plan_placement_locked(pg)
-                if placement is None:
+                if placement is None and self.fail_fast_infeasible:
                     raise PlacementGroupUnschedulableError(
                         f"Cannot fit bundles {list(bundles)} with strategy "
                         f"{strategy} on nodes "
                         f"{[n.resources.total for n in self._nodes.values()]}"
                     )
+                if placement is None:
+                    # An autoscaler is attached: an unplaceable gang is
+                    # PROVISIONING demand, not an error. Queue the group —
+                    # it surfaces gang-atomically via pending_gang_demand()
+                    # and the rescheduler re-plans it once capacity lands
+                    # (capacity-wait attempts don't burn the budget).
+                    self._placement_groups[pg.id] = pg
                 retry = False
-                for bundle, node in zip(pg.bundles, placement):
+                for bundle, node in zip(pg.bundles, placement or ()):
                     if not node.resources.try_acquire(bundle.resources):
                         for prev_node, prev_res in acquired:
                             prev_node.resources.release(prev_res)
@@ -626,6 +708,12 @@ class ClusterScheduler:
                 if retry:
                     last_err = "concurrent reservation lost"
                     continue
+            if placement is None:
+                self._kick_reschedule(
+                    pg, "awaiting capacity (autoscaler attached)",
+                    [b.index for b in pg.bundles],
+                )
+                return pg
             # Phase 2 (outside the lock: these are RPCs): prepare remote
             # bundles at their agents. The hook reserves in order and
             # rolls back its own partial progress on failure.
@@ -732,6 +820,10 @@ class ClusterScheduler:
             pg._reserved_event.clear()
         else:
             pg._reserved_event.set()
+        if state == "RESERVED":
+            # groups queued behind the autoscaler (created unplaceable)
+            # become ready the moment their first reservation lands
+            pg.created.set()
         from ..util.events import emit
         from ..util.metrics import get_or_create_counter
 
@@ -807,8 +899,8 @@ class ClusterScheduler:
         attempt = 0
         try:
             while True:
-                if pg.removed:
-                    return
+                if pg.removed or pg.state in ("FAILED", "REMOVED"):
+                    return  # fail_unprovisionable may have judged us doomed
                 if pg.reschedules_used >= budget:
                     self._fail_pg(pg, budget)
                     return
@@ -822,18 +914,32 @@ class ClusterScheduler:
                         reschedules_used=pg.reschedules_used,
                     )
                     return
-                from ..util.events import emit
+                # With an autoscaler attached, a capacity shortfall is a
+                # provisioning WAIT, not a failed attempt: refund the
+                # budget unit and retry at the base backoff (the scaler's
+                # fail_unprovisionable covers truly impossible gangs).
+                waiting_capacity = (
+                    not self.fail_fast_infeasible
+                    and err.startswith("no surviving node")
+                )
+                if waiting_capacity:
+                    pg.reschedules_used -= 1
+                if not waiting_capacity or attempt == 1:
+                    from ..util.events import emit
 
-                emit("WARNING", "placement_groups",
-                     f"placement group {pg.id.hex()[:12]} reschedule "
-                     f"attempt {attempt} failed: {err}",
-                     kind="pg.reschedule_failed", pg=pg.id.hex())
-                logger.warning("PG %s reschedule attempt %d failed: %s",
-                               pg.id.hex()[:12], attempt, err)
+                    emit("WARNING", "placement_groups",
+                         f"placement group {pg.id.hex()[:12]} reschedule "
+                         f"attempt {attempt} failed: {err}",
+                         kind="pg.reschedule_failed", pg=pg.id.hex())
+                    logger.warning("PG %s reschedule attempt %d failed: %s",
+                                   pg.id.hex()[:12], attempt, err)
                 if pg.reschedules_used >= budget:
                     self._fail_pg(pg, budget)
                     return
-                time.sleep(min(backoff * (2 ** (attempt - 1)), 8.0))
+                if waiting_capacity:
+                    time.sleep(backoff)
+                else:
+                    time.sleep(min(backoff * (2 ** (attempt - 1)), 8.0))
         finally:
             with self._lock:
                 pg._rescheduler_running = False

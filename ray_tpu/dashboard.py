@@ -30,6 +30,7 @@ th{background:#eee} code{background:#eee;padding:0 .3em}
 <h1>ray_tpu dashboard</h1>
 <div id="err"></div>
 <h2>Cluster</h2><table id="summary"></table>
+<h2>Autoscaler</h2><table id="autoscaler"></table>
 <h2>Nodes</h2><table id="nodes"></table>
 <h2>Node telemetry</h2><table id="telemetry"></table>
 <h2>Actors</h2><table id="actors"></table>
@@ -53,6 +54,8 @@ async function refresh() {
   try {
     const s = await (await fetch("/api/summary")).json();
     fill("summary", [s]);
+    const sc = await (await fetch("/api/autoscaler")).json();
+    fill("autoscaler", Object.keys(sc).length ? [sc] : []);
     fill("nodes", await (await fetch("/api/nodes")).json());
     const ns = await (await fetch("/api/node_stats")).json();
     fill("telemetry", Object.entries(ns).map(([node, t]) => ({
@@ -230,6 +233,10 @@ class _Handler(BaseHTTPRequestHandler):
             return state.node_stats()
         if name == "cluster_metrics":
             return state.cluster_metrics(raw=True)
+        if name == "autoscaler":
+            # capacity-plane status: managed nodes by type/class, pending
+            # demand by origin, scale/replace/blocked counters
+            return state.autoscaler_summary() or {}
         if name == "status":
             return {"report": state.status_report()}
         if name == "actors":

@@ -137,6 +137,51 @@ class ServeController:
         self._interval = reconcile_interval_s
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # advertise replica targets with no placeable host to the
+        # capacity plane (origin=serve); unregistered in shutdown()
+        from ..core.capacity import register_demand_source
+
+        self._demand_source_name = f"serve:{id(self):x}"
+        register_demand_source(
+            self._demand_source_name, self._pending_capacity_demand
+        )
+
+    def _pending_capacity_demand(self) -> List[Dict[str, Any]]:
+        """DemandLedger source: per-deployment replica deficits whose
+        resources_per_replica fit on NO placeable node — reconcile can
+        retry forever, only new capacity unblocks those."""
+        from ..core import runtime as rt
+
+        if not rt.is_initialized():
+            return []
+        nodes = [
+            n for n in rt.get_runtime().scheduler.nodes() if n.placeable()
+        ]
+        with self._lock:
+            states = list(self._states.values())
+        out: List[Dict[str, Any]] = []
+        for state in states:
+            deficit = state.target_replicas - len(state.replicas)
+            if deficit <= 0:
+                continue
+            res = dict(
+                state.deployment.config.resources_per_replica
+                or {"CPU": 1.0}
+            )
+            placeable = any(
+                all(n.resources.total.get(k, 0.0) >= v
+                    for k, v in res.items())
+                for n in nodes
+            )
+            if placeable:
+                continue  # a live node can host it once load drains
+            out.append({
+                "bundles": [dict(res) for _ in range(deficit)],
+                "origin": "serve",
+                "detail": f"{deficit} replica(s) of "
+                          f"{state.deployment.name}",
+            })
+        return out
 
     # ------------------------------------------------------------- lifecycle
 
@@ -213,6 +258,9 @@ class ServeController:
         state.replica_set.set_replicas([])
 
     def shutdown(self) -> None:
+        from ..core.capacity import unregister_demand_source
+
+        unregister_demand_source(self._demand_source_name)
         self._stop.set()
         if self._thread:
             self._thread.join(timeout=5)
@@ -342,10 +390,14 @@ class ServeController:
         # scale up
         started = 0
         while len(state.replicas) < state.target_replicas:
+            # an EXPLICIT resources_per_replica charges exactly what it
+            # says (num_cpus would clobber its CPU entry otherwise); the
+            # default keeps replicas CPU-free as before
+            explicit = dep.config.resources_per_replica
             actor_cls = api.remote(_ReplicaWrapper).options(
                 max_concurrency=dep.config.max_ongoing_requests,
-                resources=dep.config.resources_per_replica or {"CPU": 1.0},
-                num_cpus=0,
+                resources=explicit or {"CPU": 1.0},
+                num_cpus=float(explicit.get("CPU", 0.0)) if explicit else 0,
                 name=f"serve:{dep.name}#{len(state.replicas)}-{time.monotonic_ns()}",
             )
             replica = actor_cls.remote(dep.cls, state.app.init_args, state.app.init_kwargs)

@@ -162,13 +162,39 @@ class TrainController:
         self.goodput = GoodputAccountant(self.run_config.name)
         self.goodput.begin("init")
         unsubscribe = self._subscribe_preemption()
+        # advertise gang restarts to the capacity plane: while the run is
+        # RESTARTING its next gang is pending demand even before the new
+        # placement group is queued (the ledger dedupes against the PG
+        # once it exists)
+        from ..core.capacity import (
+            register_demand_source, unregister_demand_source,
+        )
+
+        source_name = f"train:{self.run_config.name}"
+        register_demand_source(source_name, self._pending_capacity_demand)
         try:
             with tracing.span("train.run", run=self.run_config.name) as run_span:
                 result = self._run_traced(run_span)
         finally:
             self.goodput.finish()
+            unregister_demand_source(source_name)
             unsubscribe()
         return result
+
+    def _pending_capacity_demand(self) -> List[Dict[str, Any]]:
+        """DemandLedger source: the next gang's bundles while a restart
+        is pending, tagged origin=train. Empty whenever the gang is
+        running, finished, or errored."""
+        if self.status != RunStatus.RESTARTING:
+            return []
+        per_worker = self.scaling.worker_resources()
+        num_workers = self.decide_num_workers()
+        return [{
+            "bundles": [dict(per_worker) for _ in range(num_workers)],
+            "origin": "train",
+            "detail": f"gang restart of run {self.run_config.name}",
+            "gang": True,
+        }]
 
     # ------------------------------------------------------------- preemption
 

@@ -109,6 +109,12 @@ EVENT_KINDS: Dict[str, str] = {
     "health.oom": "the OOM policy killed a worker",
     "metrics.sampler_error": "a gauge callback raised (first failure)",
     "autoscaler.scaled": "the autoscaler launched or released a node",
+    # capacity plane (core/capacity.py)
+    "autoscaler.scale_up": "the capacity plane launched node(s) for pending demand",
+    "autoscaler.scale_down": "the capacity plane retired a node through the drain path",
+    "autoscaler.replace": "replacement capacity pre-provisioned for a preempting node",
+    "autoscaler.blocked": "pending demand cannot be provisioned (limits/budget)",
+    "autoscaler.error": "the autoscaler loop raised (first per exception type)",
 }
 
 

@@ -103,9 +103,20 @@ def summary() -> Dict[str, Any]:
         "tasks_failed": sum(1 for e in events if not e["ok"]),
         "object_store": runtime.object_store.usage(),
         "scheduler": dict(runtime.scheduler.stats),
-        "pending_tasks": len(runtime.scheduler.pending_demand()),
+        "pending_tasks": len(runtime.scheduler.pending_task_demand()),
+        "pending_demand": len(runtime.scheduler.pending_demand()),
+        "autoscaler": autoscaler_summary(),
         "node_stats": node_stats(),
     }
+
+
+def autoscaler_summary() -> Optional[Dict[str, Any]]:
+    """status() of the active capacity-plane autoscaler, or None when
+    no autoscaler is running in this process."""
+    from ..core.capacity import active_autoscaler
+
+    scaler = active_autoscaler()
+    return scaler.status() if scaler is not None else None
 
 
 def cluster_metrics(raw: bool = False):
@@ -216,13 +227,36 @@ def status_report(verbose: bool = False) -> str:
                         f"{_fmt_bytes(last.get('bytes', 0))}"
                     )
                 lines.append("    " + "; ".join(parts))
-    demand = runtime.scheduler.pending_demand()
+    task_demand = runtime.scheduler.pending_task_demand()
+    gang_demand = runtime.scheduler.pending_gang_demand()
     lines.append("")
-    if demand:
-        lines.append(f"Pending tasks: {len(demand)} "
-                     f"(demand: {demand[:8]}{'...' if len(demand) > 8 else ''})")
+    if task_demand:
+        lines.append(
+            f"Pending tasks: {len(task_demand)} (demand: {task_demand[:8]}"
+            f"{'...' if len(task_demand) > 8 else ''})"
+        )
     else:
         lines.append("Pending tasks: 0")
+    if gang_demand:
+        lines.append(f"Pending gang demand: {len(gang_demand)} group(s)")
+        for gang in gang_demand[:4]:
+            lines.append(
+                f"  pg {gang['pg'][:12]} [{gang['state']}] "
+                f"{gang['name'] or ''}: {len(gang['bundles'])} bundle(s) "
+                f"unplaced"
+            )
+    scaler = autoscaler_summary()
+    if scaler is not None:
+        lines.append(
+            "Autoscaler: "
+            f"{scaler['managed_nodes']} managed node(s) "
+            f"({', '.join(f'{k}={v}' for k, v in sorted(scaler['per_class'].items())) or 'none'}), "
+            f"{scaler['retiring']} retiring, "
+            f"{scaler['pending_demands']} pending demand(s), "
+            f"ups={scaler['scale_ups']} downs={scaler['scale_downs']} "
+            f"replacements={scaler['replacements']} "
+            f"blocked={scaler['blocked']}"
+        )
     actors = runtime.list_actors()
     actor_states: Dict[str, int] = {}
     for a in actors:

@@ -90,6 +90,234 @@ def test_local_process_provider_spawns_real_agents():
         cfg.reset()
 
 
+def test_scale_down_skips_actor_hosting_and_preempting_nodes():
+    """Lifecycle discipline: a node hosting a live (even zero-resource)
+    actor is pinned, and a PREEMPTING node belongs to the preemption
+    path — neither is ever selected for scale-down."""
+    rt = ray_tpu.init(num_cpus=1, detect_accelerators=False)
+    try:
+        provider = FakeNodeProvider(rt.scheduler)
+        scaler = Autoscaler(
+            rt.scheduler, provider, [NodeType("cpu4", {"CPU": 4.0})],
+            poll_interval_s=0.05, idle_timeout_s=0.2, drain_grace_s=0.5,
+            runtime=rt,
+        )
+        scaler.start()
+
+        @ray_tpu.remote(num_cpus=4)
+        def big():
+            return "ran"
+
+        assert ray_tpu.get(big.remote(), timeout=60) == "ran"
+        node = provider.created[0]
+
+        # zero-resource actor on the scaled node: the node LOOKS idle
+        # (resources fully free) but hosts live state — only the pin
+        # check keeps it alive
+        @ray_tpu.remote(num_cpus=0)
+        class Pin:
+            def ping(self):
+                return "pong"
+
+        pin = Pin.options(
+            scheduling_strategy=ray_tpu.NodeAffinitySchedulingStrategy(
+                node.node_id
+            )
+        ).remote()
+        assert ray_tpu.get(pin.ping.remote(), timeout=30) == "pong"
+        time.sleep(1.0)  # several idle timeouts
+        assert scaler.stats["scale_downs"] == 0
+        assert node.alive and not node.draining
+
+        # now simulate an announced preemption: still never selected
+        # (and never terminated) by the scaler — the preemption path
+        # owns the node's fate
+        ray_tpu.kill(pin)
+        rt.scheduler.mark_node_draining(
+            node.node_id.hex(), "test preemption",
+            deadline=time.time() + 60,
+        )
+        time.sleep(1.0)
+        assert scaler.stats["scale_downs"] == 0
+        assert node.alive
+        scaler.stop()
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_drain_grace_expiry_forces_termination():
+    """Retirement goes through the drain path: the node is marked
+    draining first; if in-flight work pins its resources past the grace
+    deadline, termination is forced."""
+    rt = ray_tpu.init(num_cpus=1, detect_accelerators=False)
+    try:
+        provider = FakeNodeProvider(rt.scheduler)
+        scaler = Autoscaler(
+            rt.scheduler, provider, [NodeType("cpu4", {"CPU": 4.0})],
+            poll_interval_s=0.05, idle_timeout_s=30.0, drain_grace_s=0.4,
+            runtime=rt,
+        )
+        # drive step() manually: deterministic, no loop races
+        rt.scheduler.fail_fast_infeasible = False
+
+        @ray_tpu.remote(num_cpus=4)
+        def big():
+            return "ran"
+
+        ref = big.remote()
+        scaler.step()  # launches the node for the queued demand
+        assert ray_tpu.get(ref, timeout=30) == "ran"
+        node = provider.created[0]
+        hex_id = node.node_id.hex()
+        # in-flight work pins the node while retirement begins
+        assert node.resources.try_acquire({"CPU": 1.0})
+        scaler._begin_retirement(hex_id, node, "test retirement")
+        assert node.draining and node.alive, "drain path, not a kill"
+        scaler.step()
+        assert node.alive, "grace not expired: busy draining node survives"
+        time.sleep(0.5)
+        scaler.step()  # grace expired -> forced termination
+        assert not node.alive
+        assert scaler.stats["scale_downs"] == 1
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_bookkeeping_survives_node_dying_mid_drain():
+    """A managed node dying while draining is reconciled out of every
+    table (no dangling idle clocks, no phantom counts) and the scaler
+    keeps scaling afterwards."""
+    rt = ray_tpu.init(num_cpus=1, detect_accelerators=False)
+    try:
+        provider = FakeNodeProvider(rt.scheduler)
+        scaler = Autoscaler(
+            rt.scheduler, provider, [NodeType("cpu4", {"CPU": 4.0})],
+            poll_interval_s=0.05, idle_timeout_s=30.0, drain_grace_s=30.0,
+            runtime=rt,
+        )
+        rt.scheduler.fail_fast_infeasible = False
+
+        @ray_tpu.remote(num_cpus=4)
+        def big():
+            return "ran"
+
+        ref = big.remote()
+        scaler.step()
+        assert ray_tpu.get(ref, timeout=30) == "ran"
+        node = provider.created[0]
+        node.resources.try_acquire({"CPU": 1.0})  # keep the drain open
+        scaler._begin_retirement(node.node_id.hex(), node, "test retirement")
+        assert node.draining
+        assert scaler.status()["retiring"] == 1
+        # the node dies mid-drain (spot reclaim beat the grace period)
+        rt.scheduler.remove_node(node.node_id)
+        scaler.step()
+        status = scaler.status()
+        assert status["managed_nodes"] == 0
+        assert status["retiring"] == 0
+        assert status["per_type"].get("cpu4", 0) == 0
+        assert scaler.stats["scale_downs"] == 0  # not a policy retirement
+        # and fresh demand still scales up
+        ref2 = big.remote()
+        scaler.step()
+        assert ray_tpu.get(ref2, timeout=30) == "ran"
+        assert scaler.stats["scale_ups"] == 2
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_loop_error_is_loud_once_per_type():
+    """The loop must survive exceptions, but LOUDLY: every error counts,
+    and each exception type emits exactly one autoscaler.error event."""
+    from ray_tpu.util import state
+
+    rt = ray_tpu.init(num_cpus=1, detect_accelerators=False)
+    try:
+        provider = FakeNodeProvider(rt.scheduler)
+        scaler = Autoscaler(
+            rt.scheduler, provider, [NodeType("cpu4", {"CPU": 4.0})],
+            poll_interval_s=0.02, idle_timeout_s=5.0,
+        )
+
+        def boom():
+            raise ValueError("wedged control loop")
+
+        scaler.step = boom
+        scaler.start()
+        deadline = time.monotonic() + 10
+        while scaler.stats["loop_errors"] < 3 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        scaler.stop()
+        assert scaler.stats["loop_errors"] >= 3
+        errors = [
+            e for e in state.list_events(limit=500)
+            if e.get("kind") == "autoscaler.error"
+            and e.get("extra", {}).get("error_type") == "ValueError"
+        ]
+        assert len(errors) == 1, errors
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_spot_provider_schedule_and_class_limits():
+    """SpotNodeProvider labels nodes spot and reclaims them per its
+    schedule through the REAL announced-preemption path; per-class
+    limits cap how many spot nodes binpacking may plan."""
+    from ray_tpu.core.capacity import SpotNodeProvider
+
+    rt = ray_tpu.init(num_cpus=1, detect_accelerators=False)
+    try:
+        inner = FakeNodeProvider(rt.scheduler)
+        provider = SpotNodeProvider(
+            inner, schedule=[None], warning_s=0.2, seed=7
+        )
+        scaler = Autoscaler(
+            rt.scheduler, provider,
+            [NodeType("spot2", {"CPU": 2.0}, capacity_class="spot")],
+            poll_interval_s=0.05, idle_timeout_s=60.0, runtime=rt,
+            class_limits={"spot": 1},
+        )
+        scaler.start()
+
+        @ray_tpu.remote(num_cpus=2)
+        def work():
+            return "ran"
+
+        assert ray_tpu.get(work.remote(), timeout=30) == "ran"
+        node = inner.created[0]
+        assert node.labels["capacity_class"] == "spot"
+        assert scaler.status()["per_class"] == {"spot": 1}
+
+        # a gang needing TWO more spot nodes is blocked by the class
+        # limit (gang-atomic: no partial launch happens either)
+        pg = ray_tpu.api.placement_group(
+            [{"CPU": 2.0}, {"CPU": 2.0}], strategy="PACK"
+        )
+        deadline = time.monotonic() + 10
+        while scaler.stats["blocked"] == 0 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert scaler.stats["blocked"] >= 1
+        assert len(inner.created) == 1, "no partial gang launches"
+        # raising the limit unblocks the whole gang
+        scaler.class_limits["spot"] = 3
+        assert pg.wait_reserved(timeout=15), pg.state
+
+        # deterministic reclaim drives the real preemption path
+        provider.preempt_after(node, 0.01, warning_s=0.2)
+        deadline = time.monotonic() + 10
+        while not node.draining and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert node.draining, "reclaim must go through PREEMPTING"
+        deadline = time.monotonic() + 10
+        while node.alive and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert not node.alive
+        assert provider.num_preemptions() == 1
+        scaler.stop()
+    finally:
+        ray_tpu.shutdown()
+
+
 def test_unprovisionable_demand_fails_loudly():
     """With a scaler attached, demand NO node type can ever cover must
     raise OutOfResourcesError instead of queueing silently forever."""

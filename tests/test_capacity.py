@@ -1,0 +1,240 @@
+"""Capacity plane (ISSUE 14): the spot-fleet capstone drill.
+
+A training gang and a serve deployment share ONE autoscaled spot
+cluster whose worker nodes exist only because the CapacityAutoscaler
+aggregated their demand (gang bundles, replica actors) and launched
+them. Scheduled preemptions with warning windows then hit the fleet:
+the drill asserts that replacement capacity is pre-provisioned BEFORE
+the preempted node dies (`preempt.announced` → `autoscaler.replace` →
+`node.dead` in the postmortem timeline), that training finishes with
+`max_failures=0` (only `num_preempt_restarts` consumed), and that
+serve never surfaces an untyped error to callers during the episode.
+"""
+
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core.capacity import (
+    CapacityAutoscaler,
+    FakeNodeProvider,
+    NodeType,
+    SpotNodeProvider,
+)
+from ray_tpu.core.exceptions import RayTpuError
+from ray_tpu.util.events import events
+
+
+def _first(evs, kind, **match):
+    for e in evs:
+        if e.get("kind") != kind:
+            continue
+        extra = e.get("extra") or {}
+        if all(extra.get(k) == v for k, v in match.items()):
+            return e
+    raise AssertionError(
+        f"no {kind} event matching {match} in "
+        f"{[(e.get('kind'), e.get('extra')) for e in evs]}"
+    )
+
+
+def test_spot_fleet_capstone(tmp_path):
+    """Train + serve on an autoscaled spot fleet survive an announced
+    preemption: replacement first, death second, zero failure budget
+    burned, one reconstructable postmortem bundle."""
+    from ray_tpu import serve
+    from ray_tpu.train import (
+        FailureConfig, RunConfig, RunStatus, ScalingConfig, TrainController,
+    )
+    from ray_tpu.util import state
+    from ray_tpu.util.metrics import registry
+    from ray_tpu.util.postmortem import load_bundle
+
+    rt = ray_tpu.init(num_cpus=1, detect_accelerators=False)
+    scaler = None
+    try:
+        events().clear()
+        provider = SpotNodeProvider(FakeNodeProvider(rt.scheduler),
+                                    warning_s=3.0)
+        # distinct custom resources keep the two workloads on their own
+        # node types, so demand aggregation (not luck) decides the fleet
+        scaler = CapacityAutoscaler(
+            rt.scheduler, provider,
+            [
+                NodeType("spot-train", {"CPU": 1.0, "trainer": 1.0},
+                         capacity_class="spot"),
+                NodeType("spot-serve", {"CPU": 2.0, "serve_slot": 2.0},
+                         capacity_class="spot"),
+            ],
+            poll_interval_s=0.05, idle_timeout_s=60.0, runtime=rt,
+        )
+        scaler.start()
+
+        # ---- serve side: 2 replicas that only a scaled-up node can host
+        @serve.deployment(num_replicas=2,
+                          resources_per_replica={"CPU": 1.0,
+                                                 "serve_slot": 1.0})
+        class Echo:
+            def __call__(self, x):
+                return f"ok-{x}"
+
+        handle = serve.run(Echo.bind(), name="fleet-echo")
+        assert ray_tpu.get(handle.remote(0), timeout=60) == "ok-0"
+
+        # ---- train side: a 2-worker gang, one worker per spot node
+        def train_fn(config):
+            from ray_tpu import train
+
+            ctx = train.get_context()
+            ckpt = train.get_checkpoint()
+            start = int(ckpt["step"]) + 1 if ckpt is not None else 0
+            for step in range(start, 30):
+                time.sleep(0.02)
+                if ctx.world_rank != 0:
+                    if train.is_preempted():
+                        return "preempted"
+                    continue
+                if train.should_checkpoint():
+                    train.report({"step": step}, checkpoint={"step": step},
+                                 checkpoint_step=step)
+                elif train.is_preempted():
+                    return "preempted"
+                elif step % 10 == 9:
+                    train.report({"step": step}, checkpoint={"step": step},
+                                 checkpoint_step=step)
+                else:
+                    train.report({"step": step})
+            return "done"
+
+        controller = TrainController(
+            train_fn,
+            ScalingConfig(num_workers=2,
+                          resources_per_worker={"CPU": 1.0, "trainer": 1.0}),
+            RunConfig(name="spot-fleet",
+                      storage_path=str(tmp_path / "trial"),
+                      failure=FailureConfig(max_failures=0)),
+            train_config={},
+            restart_backoff_s=0.0,
+        )
+        box = {}
+        thread = threading.Thread(
+            target=lambda: box.update(result=controller.run()), daemon=True
+        )
+        thread.start()
+
+        # hammer serve for the whole episode; every surfaced error must
+        # be TYPED (a RayTpuError subclass), never a bare crash
+        serve_errors = []
+        stop_serving = threading.Event()
+
+        def client_loop():
+            i = 1
+            while not stop_serving.is_set():
+                try:
+                    out = ray_tpu.get(handle.remote(i), timeout=30)
+                    assert out == f"ok-{i}"
+                except Exception as exc:  # noqa: BLE001 - recorded for the typed-error assert
+                    serve_errors.append(exc)
+                i += 1
+                time.sleep(0.05)
+
+        client = threading.Thread(target=client_loop, daemon=True)
+        client.start()
+
+        deadline = time.monotonic() + 60
+        while not controller.metrics_history and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert controller.metrics_history, "gang never started reporting"
+
+        # the fleet exists because demand put it there
+        assert scaler.stats["scale_ups"] >= 3  # 2 train + 1 serve node
+
+        # ---- scheduled preemption of a gang-hosting spot node
+        victim = next(
+            n for n in rt.scheduler.nodes()
+            if n.labels.get("node_type") == "spot-train"
+            and rt.scheduler.resident_bundles(n.node_id.hex())
+        )
+        provider.preempt_after(victim, 0.01, warning_s=3.0)
+
+        thread.join(timeout=120)
+        stop_serving.set()
+        client.join(timeout=30)
+        assert not thread.is_alive(), "controller never finished"
+
+        result = box["result"]
+        assert result.status == RunStatus.FINISHED, result.error
+        # the announced-preemption budget absorbed the episode; the
+        # failure budget (0) stayed untouched
+        assert result.num_preempt_restarts == 1
+        assert provider.num_preemptions() == 1
+        assert scaler.stats["replacements"] >= 1
+
+        # the warning window outlives the (fast) drill run: wait for the
+        # reclaim to actually land so the bundle contains `node.dead`
+        deadline = time.monotonic() + 15
+        while victim.alive and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert not victim.alive, "preempted node never died"
+
+        untyped = [e for e in serve_errors if not isinstance(e, RayTpuError)]
+        assert untyped == [], untyped
+        # serve stayed (or got back) healthy
+        status = serve.status()["fleet-echo"]
+        assert status["live_replicas"] == 2, status
+
+        # ---- one bundle tells the whole story, in causal order
+        out = str(tmp_path / "fleet.tgz")
+        state.postmortem(out, note="spot-fleet capstone")
+        bundle = load_bundle(out)
+        evs = bundle["events.jsonl"]
+        vh = victim.node_id.hex()
+
+        announced = _first(evs, "preempt.announced")
+        assert announced["node"] == vh
+        replace = _first(evs, "autoscaler.replace", replaces=vh)
+        dead = _first(evs, "node.dead")
+        assert dead["node"] == vh
+        # replacement capacity was up BEFORE the preempted node died
+        assert announced["ts"] <= replace["ts"] <= dead["ts"], \
+            [announced, replace, dead]
+        # the replacement demand is origin-tagged and gang-shaped
+        assert replace["extra"]["origin"] == "replace"
+        assert replace["extra"]["node_type"] == "spot-train"
+        assert replace["extra"]["capacity_class"] == "spot"
+        # the original fleet scale-ups carry their demand origins too
+        origins = {
+            (e.get("extra") or {}).get("origin")
+            for e in evs if e.get("kind") == "autoscaler.scale_up"
+        }
+        assert "pg" in origins, origins      # the training gang's bundles
+        assert "task" in origins, origins    # the serve replica actors
+
+        # ---- goodput: the run's wall time fully bucketed, restart visible
+        goodput = result.goodput
+        assert goodput is not None and goodput["wall_time_s"] > 0
+        total = sum(goodput["buckets"].values())
+        assert abs(total - goodput["wall_time_s"]) \
+            <= 0.05 * goodput["wall_time_s"]
+        assert goodput["buckets"]["step_compute"] > 0
+        assert goodput["buckets"]["ckpt_save"] > 0
+        assert goodput["buckets"]["preempt_restart"] > 0
+
+        # ---- the autoscaler gauges saw the episode
+        text = registry().prometheus_text()
+        assert "raytpu_autoscaler_managed_nodes" in text
+        assert 'raytpu_autoscaler_scale_total{direction="up"}' in text
+        for line in text.splitlines():
+            if line.startswith("raytpu_autoscaler_preempt_replacements_total"):
+                assert float(line.rsplit(" ", 1)[1]) >= 1.0
+                break
+        else:
+            raise AssertionError("replacement counter missing:\n" + text)
+
+        serve.shutdown()
+    finally:
+        if scaler is not None:
+            scaler.stop()
+        ray_tpu.shutdown()

@@ -522,3 +522,64 @@ def test_preempt_postmortem_capstone(nodes4, tmp_path):
                 and 'run="preempt-pm"' in line):
             gauge_total += float(line.rsplit(" ", 1)[1])
     assert abs(gauge_total - total) < 1e-3, (gauge_total, total)
+
+
+def test_autoscaler_events_and_gauges(runtime):
+    """Capacity-plane actions land in the flight recorder as typed,
+    demand-origin-tagged events, and the autoscaler gauges expose the
+    same episode through /metrics."""
+    from ray_tpu.core.capacity import (
+        DEMAND_ORIGINS, CapacityAutoscaler, FakeNodeProvider, NodeType,
+    )
+    from ray_tpu.util.metrics import registry
+
+    rt = runtime
+    events().clear()
+    for kind in ("autoscaler.scale_up", "autoscaler.scale_down",
+                 "autoscaler.replace", "autoscaler.blocked",
+                 "autoscaler.error"):
+        assert kind in EVENT_KINDS, kind
+
+    scaler = CapacityAutoscaler(
+        rt.scheduler, FakeNodeProvider(rt.scheduler),
+        [NodeType("cpu4", {"CPU": 4.0})],
+        poll_interval_s=0.05, idle_timeout_s=0.3, drain_grace_s=5.0,
+    )
+    scaler.start()
+    try:
+        @ray_tpu.remote(num_cpus=4)
+        def big():
+            return "ran"
+
+        assert ray_tpu.get(big.remote(), timeout=60) == "ran"
+        deadline = time.monotonic() + 30
+        while scaler.stats["scale_downs"] == 0 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert scaler.stats["scale_downs"] >= 1
+    finally:
+        scaler.stop()
+
+    ups = events().list(kind="autoscaler.scale_up")
+    downs = events().list(kind="autoscaler.scale_down")
+    assert ups and downs
+    up, down = ups[0], downs[0]
+    # demand-origin tagging on the way up, drain reason on the way down
+    assert up["extra"]["origin"] in DEMAND_ORIGINS
+    assert up["extra"]["node_type"] == "cpu4"
+    assert up["extra"]["capacity_class"] == "on_demand"
+    assert down["extra"]["reason"]
+    assert down["extra"]["forced"] is False  # drain completed, not expired
+    assert down["node"] == up["node"]  # the same launched node retired
+    assert up["ts"] <= down["ts"]
+
+    text = registry().prometheus_text()
+    assert "raytpu_autoscaler_managed_nodes" in text
+    assert "raytpu_autoscaler_pending_demands" in text
+    up_n = down_n = None
+    for line in text.splitlines():
+        if line.startswith('raytpu_autoscaler_scale_total{direction="up"}'):
+            up_n = float(line.rsplit(" ", 1)[1])
+        if line.startswith('raytpu_autoscaler_scale_total{direction="down"}'):
+            down_n = float(line.rsplit(" ", 1)[1])
+    assert up_n is not None and up_n >= 1.0
+    assert down_n is not None and down_n >= 1.0
