@@ -174,6 +174,27 @@ define_flag("serve_reaper_max_tracked", 4096,
             "Cap on request refs the serve reaper tracks; overflow "
             "releases + drops the oldest entry and bumps a warning metric.")
 
+# multi-tenant serve (weighted-fair admission / quotas / preemption)
+define_flag("serve_tenant_default_weight", 1.0,
+            "Weighted-fair share for tenants without an explicit weight "
+            "(serve/tenancy.py set_tenant overrides per tenant).")
+define_flag("serve_tenant_quota_rps", 0.0,
+            "Default per-tenant token-bucket refill rate in requests/sec "
+            "applied at engine admission (0 = unlimited; per-tenant "
+            "overrides via tenancy.set_tenant(quota_rps=...)).")
+define_flag("serve_tenant_quota_burst", 0.0,
+            "Default token-bucket burst capacity in requests "
+            "(0 = auto: max(1, 2x the refill rate)).")
+define_flag("serve_lane_preemption", True,
+            "Let the paged engine preempt strictly-lower-priority decode "
+            "lanes under page-pool/slot pressure: the lane is trimmed to "
+            "its emitted frontier, its pages released (prefix-shared "
+            "pages only drop a refcount), and the request parked for a "
+            "token-exact resume.")
+define_flag("serve_tenant_header", "x-tenant",
+            "HTTP header carrying the tenant id on the OpenAI frontend "
+            "and the serve proxy ('x-priority' rides alongside).")
+
 # rpc client reconnect policy
 define_flag("rpc_reconnect_attempts", 4,
             "Max RpcClient connection attempts per call (connect/send-phase "

@@ -91,9 +91,26 @@ class RequestTimeoutError(RayTpuError, TimeoutError):
 
 class BackPressureError(RayTpuError):
     """Admission control shed this request: the deployment's queue bound
-    (`max_queued_requests`) or an engine's admit-queue bound was full.
-    Retryable by the CLIENT after backoff — HTTP layers map it to 429
-    with a Retry-After header."""
+    (`max_queued_requests`), an engine's admit-queue bound, or a tenant's
+    token-bucket quota was full. Retryable by the CLIENT after backoff —
+    HTTP layers map it to 429 with a Retry-After header.
+
+    ``retry_after_s`` carries the computed backoff when the shedder knows
+    it (the tenant bucket's refill time, the router's queue drain-rate
+    estimate); HTTP layers fall back to 1 second when it is None.
+    """
+
+    def __init__(
+        self,
+        message: str = "request shed by admission control",
+        retry_after_s: Optional[float] = None,
+    ):
+        self.retry_after_s = retry_after_s
+        super().__init__(message)
+
+    def __reduce__(self):
+        args = self.args[0] if self.args else "request shed by admission control"
+        return (BackPressureError, (args, self.retry_after_s))
 
 
 class ReplicaDrainingError(RayTpuError):

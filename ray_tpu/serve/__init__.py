@@ -19,7 +19,12 @@ from .api import (  # noqa: F401
     start_http,
     status,
 )
-from .context import get_request_deadline, remaining_s  # noqa: F401
+from .context import (  # noqa: F401
+    get_request_deadline,
+    get_request_priority,
+    get_request_tenant,
+    remaining_s,
+)
 from .deployment import (  # noqa: F401
     Application,
     AutoscalingConfig,
@@ -29,3 +34,4 @@ from .deployment import (  # noqa: F401
 )
 from .multiplex import get_multiplexed_model_id, multiplexed  # noqa: F401
 from .router import DeploymentHandle  # noqa: F401
+from .tenancy import TenantSpec, set_tenant  # noqa: F401

@@ -117,6 +117,24 @@ class _HttpProxy:
                     timeout_s = query.get("timeout_s", [None])[0]
                     if timeout_s:
                         handle = handle.options(timeout_s=float(timeout_s))
+                    # tenant/priority: query param wins, headers fall back
+                    # (same resolution the OpenAI front-end does)
+                    from . import tenancy
+
+                    tenant = query.get("tenant", [None])[0]
+                    priority = query.get("priority", [None])[0]
+                    if tenant is None and priority is None:
+                        tenant, h_priority = tenancy.resolve_http_tenant(
+                            self.headers
+                        )
+                        priority = h_priority
+                    if tenant is not None or priority is not None:
+                        handle = handle.options(
+                            tenant=tenant,
+                            priority=(
+                                int(priority) if priority is not None else None
+                            ),
+                        )
                     method = parts[1] if len(parts) > 1 else "__call__"
                     if query.get("stream", ["0"])[0] in ("1", "true"):
                         self._stream_response(handle, method, payload)
@@ -142,7 +160,16 @@ class _HttpProxy:
 
                     cause = unwrap_error(e)
                     if isinstance(cause, BackPressureError):
-                        code, retry_after = 429, 1
+                        # honest Retry-After: token-bucket refill or queue
+                        # drain-rate estimate when the shedder computed one
+                        import math
+
+                        retry = getattr(cause, "retry_after_s", None)
+                        code = 429
+                        retry_after = (
+                            max(1, int(math.ceil(float(retry))))
+                            if retry and retry > 0 else 1
+                        )
                     elif isinstance(
                         cause, (DeploymentUnavailableError, ReplicaDrainingError)
                     ):

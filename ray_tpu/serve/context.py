@@ -1,4 +1,4 @@
-"""Per-request serve context: the end-to-end deadline.
+"""Per-request serve context: the end-to-end deadline and the tenant.
 
 The router stamps each request with an absolute deadline (epoch seconds,
 ``_deadline_ts`` kwarg — the same kwargs channel tracing context rides).
@@ -7,13 +7,19 @@ code — and anything it calls, notably `LLMServer._submit` handing the
 deadline to an engine, or a downstream `DeploymentHandle` hop — inherits
 the remaining budget instead of starting a fresh clock per hop
 (reference parity: Serve's request-context deadline propagation).
+
+The tenant/priority pair rides the same channel (``_tenant`` /
+``_priority`` kwargs): the HTTP frontends resolve it from headers or API
+keys, ``DeploymentHandle.options(tenant=..., priority=...)`` overrides
+it per call, and engines read it here to drive weighted-fair admission,
+token-bucket quotas, and lane preemption (serve/tenancy.py).
 """
 
 from __future__ import annotations
 
 import contextvars
 import time
-from typing import Optional
+from typing import Optional, Tuple
 
 _deadline: contextvars.ContextVar[Optional[float]] = contextvars.ContextVar(
     "raytpu_serve_deadline", default=None
@@ -43,3 +49,35 @@ def _set_request_deadline(deadline_ts: Optional[float]):
 
 def _reset_request_deadline(token) -> None:
     _deadline.reset(token)
+
+
+_tenant: contextvars.ContextVar[Optional[Tuple[Optional[str], Optional[int]]]] = (
+    contextvars.ContextVar("raytpu_serve_tenant", default=None)
+)
+
+
+def get_request_tenant() -> Optional[str]:
+    """Tenant id of the serve request currently executing on this thread,
+    or None when the request carries no tenant (engines treat None as the
+    'default' tenant)."""
+    pair = _tenant.get()
+    return pair[0] if pair is not None else None
+
+
+def get_request_priority() -> Optional[int]:
+    """Priority of the executing serve request (higher = more important;
+    used only for lane preemption eligibility, never queue order), or
+    None when unset."""
+    pair = _tenant.get()
+    return pair[1] if pair is not None else None
+
+
+def _set_request_tenant(tenant: Optional[str], priority: Optional[int]):
+    """Internal: installs the tenant/priority pair for the executing
+    request; returns the reset token. Only `_ReplicaWrapper` should call
+    this (mirrors `_set_request_deadline`)."""
+    return _tenant.set((tenant, priority))
+
+
+def _reset_request_tenant(token) -> None:
+    _tenant.reset(token)

@@ -44,6 +44,8 @@ class _ReplicaWrapper:
 
         model_id = kwargs.pop("_multiplexed_model_id", None)
         deadline = kwargs.pop("_deadline_ts", None)
+        tenant = kwargs.pop("_tenant", None)
+        priority = kwargs.pop("_priority", None)
         if self._draining:
             # a call that raced the drain mark: bounce it so the router
             # fails over instead of queueing work behind a dying replica
@@ -56,15 +58,22 @@ class _ReplicaWrapper:
             )
         _set_model_id(model_id)
         token = serve_ctx._set_request_deadline(deadline)
+        tenant_token = serve_ctx._set_request_tenant(tenant, priority)
         try:
             result = getattr(self._instance, method)(*args, **kwargs)
-            if hasattr(result, "__next__") and (model_id or deadline is not None):
+            if hasattr(result, "__next__") and (
+                model_id or deadline is not None or tenant is not None
+            ):
                 # generator bodies run at iteration time (the streaming
                 # executor drains them after this returns): re-establish
-                # the model-id + deadline context around actual execution
-                return _with_request_context(result, model_id, deadline)
+                # the model-id + deadline + tenant context around actual
+                # execution
+                return _with_request_context(
+                    result, model_id, deadline, tenant, priority
+                )
             return result
         finally:
+            serve_ctx._reset_request_tenant(tenant_token)
             serve_ctx._reset_request_deadline(token)
             _set_model_id(None)
 
@@ -76,15 +85,19 @@ class _ReplicaWrapper:
 
 
 def _with_request_context(gen, model_id: Optional[str],
-                          deadline: Optional[float]):
+                          deadline: Optional[float],
+                          tenant: Optional[str] = None,
+                          priority: Optional[int] = None):
     from . import context as serve_ctx
     from .multiplex import _set_model_id
 
     _set_model_id(model_id)
     token = serve_ctx._set_request_deadline(deadline)
+    tenant_token = serve_ctx._set_request_tenant(tenant, priority)
     try:
         yield from gen
     finally:
+        serve_ctx._reset_request_tenant(tenant_token)
         serve_ctx._reset_request_deadline(token)
         _set_model_id(None)
 

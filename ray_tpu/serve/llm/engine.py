@@ -84,6 +84,16 @@ class _Request:
     # end-to-end deadline (epoch seconds): expired requests fail fast at
     # admit and are cancelled/evicted mid-generation
     deadline_ts: Optional[float] = None
+    # multi-tenant admission: tenant keys the fair-queue lane and quota
+    # bucket; priority (higher = more important) gates lane preemption
+    tenant: str = "default"
+    priority: int = 0
+    # tokens emitted since (re-)admission — a preempted lane folds these
+    # into its prompt so the parked request resumes token-exact
+    gen_tokens: list = dataclasses.field(default_factory=list)
+    # True while parked by lane preemption (waiting in the fair queue
+    # with its generated prefix folded into the prompt)
+    parked: bool = False
 
 
 def _start_request_span(request: "_Request", engine_kind: str) -> None:
@@ -301,21 +311,47 @@ def _queue_bound(config) -> int:
     return bound
 
 
-def _check_admission(engine, deadline_ts) -> None:
+def _check_admission(engine, deadline_ts, tenant: str = "default") -> None:
     """Shared submit-time gate for both engines: bound the queue (typed
-    BackPressureError on overflow) and fail already-expired deadlines
-    fast instead of queueing work nobody will wait for."""
+    BackPressureError on overflow), charge the tenant's token bucket
+    (typed shed carrying the bucket's refill time as Retry-After), and
+    fail already-expired deadlines fast instead of queueing work nobody
+    will wait for."""
     from ...core.exceptions import BackPressureError, RequestTimeoutError
+    from .. import tenancy
 
     bound = _queue_bound(engine.config)
-    if bound >= 0 and engine._queue.qsize() >= bound:
+    backlog = engine._queue.qsize() + len(getattr(engine, "_fair", ()))
+    if bound >= 0 and backlog >= bound:
         engine.metrics["shed"] = engine.metrics.get("shed", 0.0) + 1
+        tenancy.count_shed(tenant)
         raise BackPressureError(
             f"engine admit queue is full ({bound} waiting requests)"
+        )
+    retry_after_s = tenancy.quota_check(tenant)
+    if retry_after_s is not None:
+        engine.metrics["shed"] = engine.metrics.get("shed", 0.0) + 1
+        tenancy.count_shed(tenant, retry_after_s)
+        raise BackPressureError(
+            f"tenant {tenant!r} is over its token-bucket quota",
+            retry_after_s=retry_after_s,
         )
     if deadline_ts is not None and time.time() >= deadline_ts:
         engine.metrics["timeouts"] = engine.metrics.get("timeouts", 0.0) + 1
         raise RequestTimeoutError("request deadline expired before submit")
+    tenancy.count_request(tenant)
+
+
+def _observe_tenant_ttft(request: "_Request") -> None:
+    """First-token hook shared by both engines: report the request's
+    TTFT into the tenancy window ServeSLOMonitor drains for per-tenant
+    attainment."""
+    from .. import tenancy
+
+    if request.first_token_at is not None:
+        tenancy.observe_ttft(
+            request.tenant, request.first_token_at - request.submitted_at
+        )
 
 
 def _timeout_request(request: "_Request") -> None:
@@ -472,6 +508,8 @@ class LLMEngine:
         top_k: int = 0,
         top_p: float = 1.0,
         deadline_ts: Optional[float] = None,
+        tenant: Optional[str] = None,
+        priority: Optional[int] = None,
     ) -> ResponseStream:
         if len(prompt_tokens) + max_tokens > self.max_seq:
             raise ValueError(
@@ -483,7 +521,8 @@ class LLMEngine:
                 "top_k/top_p sampling lives in PagedLLMEngine (the dense "
                 "engine samples temperature-only); use PagedEngineConfig"
             )
-        _check_admission(self, deadline_ts)
+        tenant = tenant or "default"
+        _check_admission(self, deadline_ts, tenant)
         request = _Request(
             rid=next(self._rid),
             prompt=list(prompt_tokens),
@@ -493,6 +532,8 @@ class LLMEngine:
             stop_token_ids=tuple(stop_token_ids or ()),
             stop_sequences=_normalize_stop_sequences(stop_sequences),
             deadline_ts=deadline_ts,
+            tenant=tenant,
+            priority=int(priority or 0),
         )
         _start_request_span(request, "dense")
         self._queue.put(request)
@@ -573,6 +614,7 @@ class LLMEngine:
         temps = jnp.asarray([request.temperature], dtype=jnp.float32)
         first = int(self._sample(last_logits, sub, temps)[0])
         request.first_token_at = time.perf_counter()
+        _observe_tenant_ttft(request)
         prefill_span.end(bucket=bucket)
         self.metrics["prefill_tokens"] += float(len(prompt))
         request.generated += 1

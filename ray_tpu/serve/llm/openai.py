@@ -19,6 +19,7 @@ mirroring how build_openai_app routes by model id.
 from __future__ import annotations
 
 import json
+import math
 import threading
 import time
 import uuid
@@ -41,15 +42,26 @@ def _http_status_for(err: BaseException):
     """(status, error-type, retry_after | None) for a serve-layer typed
     error, or None when `err` is not an overload/availability/deadline
     condition. BackPressure → 429 (client should back off and retry),
-    unavailability/draining → 503, deadline expiry → 504."""
+    unavailability/draining → 503, deadline expiry → 504.
+
+    The 429 Retry-After is computed from the shed's own estimate when it
+    carries one (token-bucket refill time, router queue drain rate);
+    otherwise the historical 1-second default."""
     cause = unwrap_error(err)
     if isinstance(cause, BackPressureError):
-        return 429, "overloaded_error", 1
+        return 429, "overloaded_error", _retry_after_s(cause)
     if isinstance(cause, (DeploymentUnavailableError, ReplicaDrainingError)):
         return 503, "service_unavailable_error", 1
     if isinstance(cause, (RequestTimeoutError, GetTimeoutError)):
         return 504, "timeout_error", None
     return None
+
+
+def _retry_after_s(cause: BaseException) -> int:
+    retry = getattr(cause, "retry_after_s", None)
+    if not retry or retry <= 0:
+        return 1
+    return max(1, int(math.ceil(float(retry))))
 
 
 class ByteTokenizer:
@@ -255,6 +267,14 @@ class OpenAIFrontend:
         # applies when absent. Expiry surfaces as HTTP 504.
         if "timeout_s" in req:
             handle = handle.options(timeout_s=float(req["timeout_s"]))
+        # tenant context: the tenant header (cfg.serve_tenant_header) or
+        # a registered API key resolves the caller; it rides the handle
+        # into the engine's fair queue / quota bucket
+        from .. import tenancy
+
+        tenant, priority = tenancy.resolve_http_tenant(http.headers)
+        if tenant is not None or priority is not None:
+            handle = handle.options(tenant=tenant, priority=priority)
         payload = self._to_payload(req, chat)
         rid = ("chatcmpl-" if chat else "cmpl-") + uuid.uuid4().hex[:24]
         created = int(time.time())

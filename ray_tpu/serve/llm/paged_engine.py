@@ -35,7 +35,6 @@ import itertools
 import queue
 import threading
 import time
-from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
@@ -43,6 +42,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...models.transformer import TransformerConfig
+from ..tenancy import FairQueue
 from .engine import (
     ResponseStream,
     _Request,
@@ -51,6 +51,7 @@ from .engine import (
     _finish_request_span,
     _hit_stop_sequence,
     _normalize_stop_sequences,
+    _observe_tenant_ttft,
     _observe_tick,
     _register_engine_metrics,
     _reject_if_dead,
@@ -257,6 +258,10 @@ class _PagedSlot:
     # verify rounds outstanding, so rollback math stays race-free.
     spec_ctx: Optional[List[int]] = None
     spec_inflight: bool = False
+    # lane preemption: a marked lane stops dispatching new blocks and is
+    # parked (trimmed to its emitted frontier) once its in-flight blocks
+    # drain — an actively pipelined lane is never quiescent at mark time
+    preempt_pending: bool = False
     # observability: admit wall time, so the per-request engine.prefill
     # span covers chunked ingest end to end (chunks batch across lanes)
     prefill_t0: float = 0.0
@@ -278,6 +283,7 @@ class _PagedSlot:
             self.request is not None
             and not self.prefilling
             and not self.done_dispatching
+            and not self.preempt_pending
             and self.dispatch_remaining > 0
         )
 
@@ -451,9 +457,12 @@ class PagedLLMEngine:
             PrefixCache(self.allocator, ps, pc.prefix_cache_pages)
             if pc.prefix_cache else None
         )
-        # requests popped from the queue but not yet seated (admission hit
-        # pool exhaustion after the pop) — retried FIFO before the queue
-        self._pending: "deque[_Request]" = deque()
+        # weighted-fair admit queue (replaces the old FIFO pending deque):
+        # raw submits drain into per-(priority, tenant) SCFQ lanes; pops
+        # come out in virtual-time order. Deferred admissions (page
+        # stalls) and preempted lanes re-enter at the front of their lane
+        # without a fresh virtual-time charge.
+        self._fair = FairQueue()
         self.metrics: Dict[str, float] = {
             "generated_tokens": 0.0,
             "decode_steps": 0.0,
@@ -484,6 +493,10 @@ class PagedLLMEngine:
             "spec_accepted": 0.0,
             "spec_acceptance_rate": 0.0,
             "spec_rollback_pages": 0.0,
+            # lane-preemption counters (multi-tenant overload protection)
+            "lane_preemptions": 0.0,
+            "lane_resumes": 0.0,
+            "preempted_pages": 0.0,
         }
         self._tick_cost = None  # decode-block cost, set at first dispatch
         self.metrics_label = _register_engine_metrics(self, "paged")
@@ -586,6 +599,8 @@ class PagedLLMEngine:
         stop_token_ids: Optional[List[int]] = None,
         stop_sequences: Optional[List[List[int]]] = None,
         deadline_ts: Optional[float] = None,
+        tenant: Optional[str] = None,
+        priority: Optional[int] = None,
     ) -> ResponseStream:
         limit = self.paged.max_slot_tokens
         if len(prompt_tokens) + max_tokens > limit:
@@ -597,7 +612,8 @@ class PagedLLMEngine:
             raise ValueError("empty prompt")
         if not 0.0 < top_p <= 1.0:
             raise ValueError(f"top_p must be in (0, 1], got {top_p}")
-        _check_admission(self, deadline_ts)
+        tenant = tenant or "default"
+        _check_admission(self, deadline_ts, tenant)
         request = _Request(
             rid=next(self._rid),
             prompt=list(prompt_tokens),
@@ -609,6 +625,8 @@ class PagedLLMEngine:
             stop_token_ids=tuple(stop_token_ids or ()),
             stop_sequences=_normalize_stop_sequences(stop_sequences),
             deadline_ts=deadline_ts,
+            tenant=tenant,
+            priority=int(priority or 0),
         )
         _start_request_span(request, "paged")
         self._queue.put(request)
@@ -655,18 +673,25 @@ class PagedLLMEngine:
                 pages = self.allocator.alloc(n)
         return pages
 
-    def _next_request(self) -> Optional[_Request]:
-        """FIFO next admissible request: retries deferred admissions first
-        (popped last tick but stalled on pages), skipping anything whose
-        deadline expired while it waited."""
+    def _drain_submits(self) -> None:
+        """Move raw submits into the weighted-fair admit queue: one
+        per-(priority, tenant) SCFQ lane each (serve/tenancy.FairQueue),
+        so admission order is virtual-time fair rather than FIFO."""
         while True:
-            if self._pending:
-                candidate = self._pending.popleft()
-            else:
-                try:
-                    candidate = self._queue.get_nowait()
-                except queue.Empty:
-                    return None
+            try:
+                request = self._queue.get_nowait()
+            except queue.Empty:
+                return
+            self._fair.push(request, request.tenant, request.priority)
+
+    def _next_admissible(self) -> Optional[_Request]:
+        """Next admissible request in weighted-fair order, shedding
+        anything whose deadline expired while it queued — an expired
+        request never consumes an admission slot ahead of a live one."""
+        while True:
+            candidate = self._fair.pop()
+            if candidate is None:
+                return None
             if (
                 candidate.deadline_ts is not None
                 and time.time() >= candidate.deadline_ts
@@ -680,13 +705,169 @@ class PagedLLMEngine:
                 continue
             return candidate
 
+    def _preemption_enabled(self) -> bool:
+        from ...core.config import cfg
+
+        return bool(cfg.serve_lane_preemption)
+
+    def _pick_victim(self, min_priority: int) -> Optional[int]:
+        """Lowest-priority, largest-page-holding lane strictly below
+        `min_priority` that can be preempted: not mid-prefill, not
+        already finishing, not already marked. In-flight blocks do NOT
+        disqualify — marking stops further dispatch and the park happens
+        once the pipeline drains (``_sweep_pending_preemptions``)."""
+        best = None
+        for idx, slot in enumerate(self.slots):
+            request = slot.request
+            if (
+                request is None
+                or request.priority >= min_priority
+                or slot.prefilling
+                or slot.preempt_pending
+                or slot.done_dispatching
+                or slot.finished_emit
+            ):
+                continue
+            rank = (request.priority, -len(slot.pages))
+            if best is None or rank < best[0]:
+                best = (rank, idx)
+        return best[1] if best is not None else None
+
+    def _request_preempt(self, idx: int) -> bool:
+        """Preempt lane `idx`: park immediately when it is quiescent
+        (no in-flight blocks — its emitted tokens equal its drained
+        dispatch positions, so re-prefilling prompt+emitted reproduces
+        the KV exactly), else mark it pending so dispatch stops feeding
+        it and the drain sweep parks it. Returns True when the park
+        happened NOW (pages already released)."""
+        slot = self.slots[idx]
+        if slot.blocks_in_flight == 0 and not slot.spec_inflight:
+            self._park_lane(idx)
+            return True
+        slot.preempt_pending = True
+        return False
+
+    def _sweep_pending_preemptions(self) -> None:
+        """Park every marked lane whose in-flight blocks have drained.
+        A lane that finished (or dispatched its last block) while the
+        mark was pending just unmarks — it retires on its own."""
+        for idx, slot in enumerate(self.slots):
+            if not slot.preempt_pending:
+                continue
+            if (
+                slot.request is None
+                or slot.finished_emit
+                or slot.done_dispatching
+            ):
+                slot.preempt_pending = False
+                continue
+            if slot.blocks_in_flight == 0 and not slot.spec_inflight:
+                self._park_lane(idx)
+
+    def _park_lane(self, idx: int) -> int:
+        """Preempt a decode lane: trim it to its emitted frontier and
+        park the request back in the admit queue with the generated
+        prefix folded into its prompt (PR 13's rollback-to-frontier
+        guarantee taken to zero pages). Returns the pages released.
+
+        Freeing `slot.pages` only drops THIS slot's refs: prefix-shared
+        pages (refcount > 1 via the prefix cache or another lane) merely
+        lose one holder and are never written or zeroed — the shared KV
+        stays intact for everyone else. On re-admit the lane re-prefills
+        prompt+generated (prefix-cache assisted), so a greedy stream
+        resumes token-exact with its remaining emit budget; the consumer
+        keeps every token already emitted and sees no seam."""
+        from ...util.events import emit
+
+        slot = self.slots[idx]
+        request = slot.request
+        freed = len(slot.pages)
+        generated = list(request.gen_tokens)
+        request.prompt = list(request.prompt) + generated
+        request.max_tokens = slot.emit_remaining
+        request.gen_tokens = []
+        request.parked = True
+        self.allocator.free(slot.pages)
+        slot.pages = []
+        slot.request = None
+        slot.position = 0
+        slot.prefill_offset = 0
+        slot.stalled = False
+        slot.dispatch_remaining = 0
+        slot.done_dispatching = False
+        slot.blocks_in_flight = 0
+        slot.awaiting_first = False
+        slot.emit_remaining = 0
+        slot.finished_emit = False
+        slot.spec_ctx = None
+        slot.spec_inflight = False
+        slot.preempt_pending = False
+        self.block_tables[idx, :] = 0
+        # parked lanes keep their place: front of their (priority, tenant)
+        # lane, no fresh virtual-time charge
+        self._fair.requeue(request, request.tenant, request.priority)
+        self.metrics["lane_preemptions"] += 1
+        self.metrics["preempted_pages"] += float(freed)
+        emit(
+            "INFO",
+            "serve",
+            f"preempted decode lane slot={idx} rid={request.rid} "
+            f"tenant={request.tenant} pages={freed}",
+            kind="serve.lane_preempted",
+            rid=request.rid,
+            tenant=request.tenant,
+            pages=freed,
+        )
+        return freed
+
+    def _reclaim_pages(self, incoming: _Request, need: int) -> bool:
+        """Page-pressure preemption: preempt strictly lower-priority
+        lanes until the pages they hold (counting lanes already marked
+        pending) cover `need`. Quiescent victims release immediately;
+        pipelined ones release on the drain sweep a tick later — the
+        caller's requeue keeps the incoming request's place meanwhile.
+        Returns True when enough pages are free RIGHT NOW to retry."""
+        expected = self.allocator.available + sum(
+            len(s.pages) for s in self.slots if s.preempt_pending
+        )
+        while expected < need:
+            victim = self._pick_victim(incoming.priority)
+            if victim is None:
+                break
+            expected += len(self.slots[victim].pages)
+            self._request_preempt(victim)
+        return self.allocator.available >= need
+
+    def _preempt_for_head(self) -> None:
+        """High-priority admissions must not wedge behind low-priority
+        long decodes: when every slot is busy and the fair head outranks
+        an eligible lane, preempt one victim so the head seats as soon
+        as the victim's pipeline drains (same tick when quiescent). One
+        pending park at a time — never cascade victims for one head."""
+        if not len(self._fair) or any(s.free for s in self.slots):
+            return
+        if any(s.preempt_pending for s in self.slots):
+            return  # a park is already on the way for this wedge
+        head = self._fair.peek()
+        if head is None:
+            return
+        victim = self._pick_victim(head.priority)
+        if victim is not None:
+            self._request_preempt(victim)
+
     def _admit(self) -> None:
+        from ...util.events import emit
+
+        self._drain_submits()
+        if self._preemption_enabled():
+            self._sweep_pending_preemptions()
+            self._preempt_for_head()
         for idx, slot in enumerate(self.slots):
             if not slot.free:
                 continue
-            if not self._pending and self._queue.empty():
-                continue
-            request = self._next_request()
+            if not len(self._fair):
+                return
+            request = self._next_admissible()
             if request is None:
                 return
             # Prefix reuse: the longest cached page-aligned prefix of the
@@ -703,12 +884,29 @@ class PagedLLMEngine:
                 self.paged.max_pages_per_slot - len(hit),
             )
             pages = self._alloc_pages(fresh_n)
+            if pages is None and self._preemption_enabled():
+                if self._reclaim_pages(request, fresh_n):
+                    pages = self._alloc_pages(fresh_n)
             if pages is None:
                 if hit:
                     self.allocator.free(hit)
-                self._pending.appendleft(request)  # keep FIFO order
+                # deferred admission keeps its place: front of its lane,
+                # no fresh virtual-time charge
+                self._fair.requeue(request, request.tenant, request.priority)
                 self.metrics["page_stalls"] += 1
                 return
+            if request.parked:
+                request.parked = False
+                self.metrics["lane_resumes"] += 1
+                emit(
+                    "INFO",
+                    "serve",
+                    f"resuming preempted lane rid={request.rid} "
+                    f"tenant={request.tenant}",
+                    kind="serve.lane_resumed",
+                    rid=request.rid,
+                    tenant=request.tenant,
+                )
             slot.request = request
             slot.pages = list(hit) + pages
             slot.position = 0
@@ -727,6 +925,7 @@ class PagedLLMEngine:
             slot.finished_emit = False
             slot.spec_ctx = None
             slot.spec_inflight = False
+            slot.preempt_pending = False
             self.block_tables[idx, :] = 0
             self.block_tables[idx, : len(slot.pages)] = slot.pages
 
@@ -1394,8 +1593,11 @@ class PagedLLMEngine:
             return  # stale block for an already-retired stream
         if first and request.first_token_at is None:
             request.first_token_at = time.perf_counter()
+            _observe_tenant_ttft(request)
         request.generated += 1
         request.out.put(token)
+        # the resume ledger: a preempted lane folds these into its prompt
+        request.gen_tokens.append(int(token))
         slot.emit_remaining -= 1
         self.metrics["generated_tokens"] += 1
         if not first:  # first tokens are the prefill's output
@@ -1471,8 +1673,10 @@ class PagedLLMEngine:
             self._loop_inner()
         except BaseException as exc:  # noqa: BLE001 - engine death boundary
             self._death_cause = exc
-            while self._pending:  # deferred admissions fail like queued ones
-                self._queue.put(self._pending.popleft())
+            # queued fair-lane requests (deferred admissions included)
+            # fail like freshly queued ones
+            for request in self._fair.drain():
+                self._queue.put(request)
             _fail_all_requests(self.slots, self._queue, exc)
             raise
 
@@ -1522,7 +1726,7 @@ class PagedLLMEngine:
                     self._maybe_retire(i, slot.request)
             occupied = sum(1 for s in self.slots if not s.free)
             self.metrics["ongoing"] = (
-                occupied + self._queue.qsize() + len(self._pending)
+                occupied + self._queue.qsize() + len(self._fair)
             )
             self.metrics["pages_in_use"] = float(
                 pc.num_pages - 1 - self.allocator.available

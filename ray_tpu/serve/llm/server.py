@@ -63,10 +63,24 @@ class LLMServer:
         ambient deadline (router timeout_s → replica context) rides into
         the engine so an expired request is cancelled/evicted instead of
         generating into the void."""
-        from ..context import get_request_deadline
+        from ..context import (
+            get_request_deadline,
+            get_request_priority,
+            get_request_tenant,
+        )
 
         prompt = payload["prompt_tokens"]
         kwargs = {"deadline_ts": get_request_deadline()}
+        # tenant context rides the same ambient channel the deadline does;
+        # payload fields are the fallback for direct (non-handle) callers
+        tenant = get_request_tenant() or payload.get("tenant")
+        if tenant:
+            kwargs["tenant"] = str(tenant)
+        priority = get_request_priority()
+        if priority is None and "priority" in payload:
+            priority = int(payload["priority"])
+        if priority is not None:
+            kwargs["priority"] = int(priority)
         for name, cast in (("top_k", int), ("top_p", float),
                            ("stop_token_ids", list),
                            ("stop_sequences", list)):

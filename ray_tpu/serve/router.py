@@ -17,6 +17,7 @@ import logging
 import random
 import threading
 import time
+from collections import deque
 from typing import Any, Dict, List, Optional, Set
 
 from .. import api
@@ -102,14 +103,22 @@ def _retry_backoff_s(attempt: int) -> float:
 class ReplicaSet:
     """Live replica handles + ongoing counts, shared router/controller.
 
-    Also owns the deployment's admission bound: when `max_queued` >= 0,
-    requests beyond (routable replicas x max_ongoing) + max_queued are
-    shed at pick time with BackPressureError. DRAINING replicas stay
-    known (their ongoing counts must drain to zero before the controller
-    reaps them) but are never picked."""
+    Also owns the deployment's admission bound and pending-dispatch
+    order: at ongoing capacity, resilient unary calls PARK in a
+    weighted-fair queue (per-tenant SCFQ lanes, serve/tenancy.FairQueue)
+    that the reaper grants from as replicas free up — so dispatch order
+    under overload is weight-proportional per tenant, not FIFO. When
+    `max_queued` >= 0, requests beyond the parked bound are shed with
+    BackPressureError carrying a drain-rate Retry-After estimate (and
+    `pick` keeps its ongoing-over-capacity bound for callers that bypass
+    parking). DRAINING replicas stay known (their ongoing counts must
+    drain to zero before the controller reaps them) but are never
+    picked."""
 
     def __init__(self, name: str, *, max_ongoing: int = 8,
                  max_queued: int = -1):
+        from .tenancy import FairQueue
+
         self.name = name
         self._lock = threading.Lock()
         self._replicas: List[Any] = []  # ActorHandles  # guarded-by: _lock
@@ -120,6 +129,11 @@ class ReplicaSet:
         # model-multiplex affinity: model_id -> MRU list of replica keys
         # (reference pow_2_scheduler.py is multiplex-aware the same way)
         self._affinity: Dict[str, List[str]] = {}  # guarded-by: _lock
+        # weighted-fair parked dispatch: _TrackedCall records waiting for
+        # ongoing headroom, granted in SCFQ order (FairQueue self-locks)
+        self._parked = FairQueue()
+        # recent release timestamps -> queue drain-rate Retry-After
+        self._release_times: "deque[float]" = deque(maxlen=32)  # guarded-by: _lock
         # telemetry: per-deployment ongoing gauge + the SLO monitor
         # (watchdog) spins up once any serve_slo_* objective is set
         _register_replica_set(self)
@@ -257,6 +271,73 @@ class ReplicaSet:
         with self._lock:
             if self._ongoing.get(key, 0) > 0:
                 self._ongoing[key] -= 1
+                # drain-rate sample for the Retry-After estimate
+                self._release_times.append(time.monotonic())
+
+    # --------------------------------------------------- parked dispatch
+
+    def _dispatch_headroom(self) -> bool:
+        """True when a routable replica has ongoing capacity to spare —
+        the work-conserving fast path past the parked queue. With no
+        routable replicas this reports True so callers reach pick() and
+        get the typed DeploymentUnavailableError instead of parking."""
+        with self._lock:
+            routable = [
+                r for r in self._replicas
+                if self._key(r) not in self._draining
+            ]
+            if not routable:
+                return True
+            ongoing = sum(
+                self._ongoing.get(self._key(r), 0) for r in routable
+            )
+            return ongoing < len(routable) * max(1, self.max_ongoing)
+
+    def should_park(self) -> bool:
+        """A resilient unary call must queue behind the weighted-fair
+        parked dispatches when the deployment is at ongoing capacity, or
+        when earlier arrivals are already parked (no barging past the
+        fair queue)."""
+        if self.max_ongoing <= 0:
+            return False
+        if len(self._parked):
+            return True
+        return not self._dispatch_headroom()
+
+    def park_would_shed(self) -> bool:
+        return 0 <= self.max_queued <= len(self._parked)
+
+    def park(self, rec: Any, tenant: str, priority: int) -> None:
+        self._parked.push(rec, tenant, priority)
+
+    def try_grant(self, rec: Any) -> bool:
+        """Reaper-side: pop `rec` from the parked queue iff it is the
+        weighted-fair head AND a replica has headroom. The reaper calls
+        this for every parked record each pass, so grants walk the queue
+        strictly in fair order."""
+        if not self._dispatch_headroom():
+            return False
+        return self._parked.pop_if_head(rec)
+
+    def cancel_parked(self, rec: Any) -> bool:
+        return self._parked.remove(rec)
+
+    def parked_count(self) -> int:
+        return len(self._parked)
+
+    def drain_retry_after_s(self) -> Optional[float]:
+        """Retry-After estimate from the recent release rate: roughly how
+        long the current parked backlog takes to drain. None (-> the
+        HTTP layers' 1s default) until enough completions are observed."""
+        with self._lock:
+            times = list(self._release_times)
+        if len(times) < 2:
+            return None
+        span = times[-1] - times[0]
+        if span <= 0:
+            return None
+        rate = (len(times) - 1) / span
+        return min(60.0, max(1.0, (len(self._parked) + 1) / rate))
 
     def total_ongoing(self) -> int:
         with self._lock:
@@ -278,17 +359,23 @@ class DeploymentHandle:
     def __init__(self, replica_set: ReplicaSet, *, stream: bool = False,
                  multiplexed_model_id: Optional[str] = None,
                  timeout_s: Optional[float] = None,
-                 max_retries: Optional[int] = None):
+                 max_retries: Optional[int] = None,
+                 tenant: Optional[str] = None,
+                 priority: Optional[int] = None):
         self._set = replica_set
         self._stream = stream
         self._model_id = multiplexed_model_id
         self._timeout_s = timeout_s
         self._max_retries = max_retries
+        self._tenant = tenant
+        self._priority = priority
 
     def options(self, *, stream: Optional[bool] = None,
                 multiplexed_model_id: Optional[str] = None,
                 timeout_s: Optional[float] = None,
-                max_retries: Optional[int] = None) -> "DeploymentHandle":
+                max_retries: Optional[int] = None,
+                tenant: Optional[str] = None,
+                priority: Optional[int] = None) -> "DeploymentHandle":
         return DeploymentHandle(
             self._set,
             stream=self._stream if stream is None else stream,
@@ -297,19 +384,22 @@ class DeploymentHandle:
             max_retries=(
                 self._max_retries if max_retries is None else max_retries
             ),
+            tenant=self._tenant if tenant is None else tenant,
+            priority=self._priority if priority is None else priority,
         )
 
     def __getattr__(self, method: str) -> "_MethodCaller":
         if method.startswith("_"):
             raise AttributeError(method)
         return _MethodCaller(self._set, method, self._stream, self._model_id,
-                             self._timeout_s, self._max_retries)
+                             self._timeout_s, self._max_retries,
+                             self._tenant, self._priority)
 
     def remote(self, *args, **kwargs):
         """Callable deployments: handle.remote(x) → instance.__call__(x)."""
         return _MethodCaller(
             self._set, "__call__", self._stream, self._model_id,
-            self._timeout_s, self._max_retries,
+            self._timeout_s, self._max_retries, self._tenant, self._priority,
         ).remote(*args, **kwargs)
 
     @property
@@ -348,13 +438,32 @@ class _MethodCaller:
     def __init__(self, replica_set: ReplicaSet, method: str,
                  stream: bool = False, model_id: Optional[str] = None,
                  timeout_s: Optional[float] = None,
-                 max_retries: Optional[int] = None):
+                 max_retries: Optional[int] = None,
+                 tenant: Optional[str] = None,
+                 priority: Optional[int] = None):
         self._set = replica_set
         self._method = method
         self._stream = stream
         self._model_id = model_id
         self._timeout_s = timeout_s
         self._max_retries = max_retries
+        self._tenant = tenant
+        self._priority = priority
+
+    def _resolve_tenant(self):
+        """(tenant | None, priority | None) for this call: the handle's
+        explicit options win, else the ambient request tenant when this
+        call happens inside another serve request (composition hop) —
+        the same inheritance rule the deadline follows."""
+        from . import context as serve_ctx
+
+        tenant = self._tenant
+        if tenant is None:
+            tenant = serve_ctx.get_request_tenant()
+        priority = self._priority
+        if priority is None:
+            priority = serve_ctx.get_request_priority()
+        return tenant, priority
 
     def _resolve_policy(self):
         """(deadline_ts | None, max_attempts >= 1) for this call.
@@ -380,8 +489,11 @@ class _MethodCaller:
 
     def remote(self, *args, **kwargs):
         from ..util import tracing
+        from .tenancy import DEFAULT_TENANT
 
         deadline, max_attempts = self._resolve_policy()
+        tenant, priority = self._resolve_tenant()
+        resilient = max_attempts > 1 or deadline is not None
         # serve.route roots the request's trace (or nests, when called
         # from a traced region): replica pick + submission. The replica's
         # actor.call/actor.execute spans — and the engine's request span
@@ -401,6 +513,50 @@ class _MethodCaller:
                         f"request to {self._set.name!r}.{self._method} "
                         f"expired before routing"
                     )
+            if self._model_id:
+                kwargs["_multiplexed_model_id"] = self._model_id
+            if deadline is not None:
+                kwargs["_deadline_ts"] = deadline
+            if tenant is not None:
+                kwargs["_tenant"] = tenant
+                route_span.set_attribute("tenant", tenant)
+            if priority is not None:
+                kwargs["_priority"] = priority
+            # At ongoing capacity, resilient unary calls PARK instead of
+            # dispatching: the reaper grants parked records in weighted-
+            # fair order as replicas free up, so overload dispatch is
+            # weight-proportional per tenant rather than FIFO. Streams
+            # and non-resilient calls keep the direct path (no promise to
+            # park behind).
+            if resilient and not self._stream and self._set.should_park():
+                if self._set.park_would_shed():
+                    from . import tenancy
+
+                    _counter(
+                        "raytpu_serve_shed_total",
+                        "serve requests shed by admission control",
+                    ).inc()
+                    tenancy.count_shed(tenant or DEFAULT_TENANT)
+                    route_span.set_attribute("shed", True)
+                    raise BackPressureError(
+                        f"deployment {self._set.name!r} is overloaded: "
+                        f"{self._set.parked_count()} parked dispatches "
+                        f"(max_queued_requests={self._set.max_queued})",
+                        retry_after_s=self._set.drain_retry_after_s(),
+                    )
+                promise_ref, promise_oid, rt = _mint_promise()
+                rec = _TrackedCall(
+                    None, self._set, "", promise_oid, rt,
+                    method=self._method, args=args, kwargs=kwargs,
+                    model_id=self._model_id, deadline=deadline,
+                    max_attempts=max_attempts,
+                )
+                rec.parked = True
+                rec.attempts = 0  # first dispatch is attempt 1, not a retry
+                self._set.park(rec, tenant or DEFAULT_TENANT, priority or 0)
+                _Reaper.instance()._track_record(rec)
+                route_span.set_attribute("parked", True)
+                return promise_ref
             try:
                 replica = self._set.pick(self._model_id)
             except BackPressureError:
@@ -411,10 +567,6 @@ class _MethodCaller:
                 route_span.set_attribute("shed", True)
                 raise
             route_span.set_attribute("replica", _rkey(replica)[:12])
-            if self._model_id:
-                kwargs["_multiplexed_model_id"] = self._model_id
-            if deadline is not None:
-                kwargs["_deadline_ts"] = deadline
             try:
                 # replicas are _ReplicaWrapper actors: dispatch by method name
                 call = replica.call
@@ -424,7 +576,6 @@ class _MethodCaller:
             except BaseException:
                 self._set.release(replica)
                 raise
-        resilient = max_attempts > 1 or deadline is not None
         if self._stream:
             if not resilient:
                 _Reaper.instance().track(ref, self._set, replica)
@@ -545,7 +696,7 @@ class _TrackedCall:
     __slots__ = (
         "ref", "rset", "key", "promise_oid", "runtime", "method", "args",
         "kwargs", "model_id", "deadline", "max_attempts", "attempts",
-        "failed_keys", "next_retry_ts", "last_error",
+        "failed_keys", "next_retry_ts", "last_error", "parked",
     )
 
     def __init__(self, ref, rset, key, promise_oid=None, runtime=None,
@@ -566,6 +717,9 @@ class _TrackedCall:
         self.failed_keys: Set[str] = set()
         self.next_retry_ts: Optional[float] = None
         self.last_error: Optional[BaseException] = None
+        # waiting in the rset's weighted-fair parked queue for dispatch
+        # headroom (ref is None until the reaper grants + dispatches)
+        self.parked = False
 
 
 class _Reaper:
@@ -630,6 +784,9 @@ class _Reaper:
             self._tracked.append(rec)
         self._event.set()
         if overflow is not None:
+            if overflow.parked:
+                # never leave a dropped record wedged at the fair head
+                overflow.rset.cancel_parked(overflow)
             overflow.rset.release_key(overflow.key)
             self._seal_error(overflow, RuntimeError(
                 "serve reaper overflow: request dropped to bound tracking "
@@ -714,6 +871,25 @@ class _Reaper:
     def _advance(self, rec: _TrackedCall) -> bool:
         """Step one tracked call; True = finished, drop it."""
         now = time.time()
+        if rec.parked:
+            # waiting for dispatch headroom in the rset's weighted-fair
+            # queue (only this reaper thread grants/cancels, so there is
+            # no pop race with other mutators — park() only pushes)
+            if rec.deadline is not None and now >= rec.deadline:
+                rec.rset.cancel_parked(rec)
+                _counter(
+                    "raytpu_serve_timeouts_total",
+                    "serve requests failed on an expired deadline",
+                ).inc()
+                self._seal_error(rec, RequestTimeoutError(
+                    f"request to {rec.rset.name!r}.{rec.method} exceeded "
+                    f"its deadline while parked for dispatch"
+                ))
+                return True
+            if not rec.rset.try_grant(rec):
+                return False
+            rec.parked = False
+            return self._dispatch_parked(rec)
         # deadline enforcement (promise-backed calls fail fast; plain
         # tracked refs have no promise to seal, their caller owns timeouts)
         if (
@@ -778,6 +954,37 @@ class _Reaper:
             "raytpu_serve_retries_total",
             "serve request attempts retried after a replica failure",
         ).inc()
+        return False
+
+    def _dispatch_parked(self, rec: _TrackedCall) -> bool:
+        """First dispatch of a WFQ-granted parked call: mirrors _resubmit
+        minus the failover counter — a park is queueing, not a retry.
+        admission=False: the call already passed the shed check at park
+        time, and the grant itself consumed the headroom it saw."""
+        try:
+            replica = rec.rset.pick(
+                rec.model_id, exclude=rec.failed_keys, admission=False
+            )
+        except BaseException as pick_err:  # noqa: BLE001
+            # nothing routable right now (controller may be restarting
+            # replicas): burn one attempt waiting, or give up
+            rec.attempts += 1
+            now = time.time()
+            wait = _retry_backoff_s(rec.attempts)
+            if (
+                rec.attempts < rec.max_attempts
+                and (rec.deadline is None or now + wait < rec.deadline)
+            ):
+                rec.next_retry_ts = now + wait
+                return False
+            self._seal_error(rec, rec.last_error or pick_err)
+            return True
+        rec.key = _rkey(replica)
+        rec.attempts += 1
+        try:
+            rec.ref = replica.call.remote(rec.method, *rec.args, **rec.kwargs)
+        except BaseException as err:  # noqa: BLE001
+            return self._on_error(rec, err)
         return False
 
     def _resubmit(self, rec: _TrackedCall) -> bool:

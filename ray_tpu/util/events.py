@@ -90,6 +90,9 @@ EVENT_KINDS: Dict[str, str] = {
     "serve.scaled": "a deployment scaled its replica count",
     "serve.drain": "a serve replica began draining",
     "serve.autoscale": "the serve autoscaler changed a replica target",
+    "serve.shed": "admission control shed a request (quota/backlog)",
+    "serve.lane_preempted": "a low-priority decode lane was parked for pages",
+    "serve.lane_resumed": "a parked decode lane re-admitted after pressure",
     # streaming data plane
     "data.stage_start": "a streaming dataset stage began submitting tasks",
     "data.stage_finish": "a streaming dataset stage drained its last block",

@@ -303,6 +303,70 @@ class ServeSLOMonitor:
                      f"({n} request(s) this window)",
                      kind="watchdog.slo_burn",
                      slo=slo, objective=objective, samples=n)
+        out.update(self._check_tenants())
+        return out
+
+    def _check_tenants(self) -> Dict[str, float]:
+        """Per-tenant TTFT attainment pass: drains the tenancy TTFT
+        window (raw samples reported by the engines, attributed at
+        first-token time) and evaluates each tenant against its own
+        objective (TenantSpec.ttft_slo_s, falling back to the global
+        serve_slo_ttft_p99_s). Ledger entries ride the same
+        ``_attainment`` map — keyed ``ttft_p99:<tenant>`` — so the
+        controller's burn-delta scan (and hence the SLO autoscaler)
+        sees tenant-attributed burn with no extra plumbing."""
+        try:
+            from ..serve import tenancy
+        except Exception:  # serve plane not imported in this process
+            return {}
+        samples = tenancy.drain_ttft_window()
+        out: Dict[str, float] = {}
+        for tenant, ttfts in samples.items():
+            if not ttfts:
+                continue
+            objective = tenancy.ttft_objective(tenant)
+            ordered = sorted(ttfts)
+            p99 = ordered[min(len(ordered) - 1,
+                              int(0.99 * len(ordered)))]
+            slo = f"ttft_p99:{tenant}"
+            out[slo] = p99
+            violated = objective > 0 and p99 > objective
+            with self._lock:
+                led = self._attainment.setdefault(slo, {
+                    "windows": 0, "violated": 0, "requests": 0,
+                    "objective_s": objective, "last_p99_s": 0.0,
+                })
+                led["windows"] += 1
+                led["requests"] += len(ttfts)
+                led["violated"] += 1 if violated else 0
+                led["objective_s"] = objective
+                led["last_p99_s"] = p99
+                attained = 1.0 - led["violated"] / led["windows"]
+            get_or_create_gauge(
+                "raytpu_serve_tenant_slo_attainment",
+                "Fraction of evaluation windows whose per-tenant TTFT "
+                "p99 met the tenant's objective.",
+                tag_keys=("tenant",),
+            ).set(attained, tags={"tenant": tenant})
+            get_or_create_gauge(
+                "raytpu_serve_tenant_ttft_p99_seconds",
+                "Window TTFT p99 per tenant, as observed by the serve "
+                "SLO monitor.",
+                tag_keys=("tenant",),
+            ).set(p99, tags={"tenant": tenant})
+            if violated:
+                get_or_create_counter(
+                    "raytpu_serve_slo_burn_total",
+                    "SLO-violating windows observed by the serve SLO "
+                    "monitor (p99 over objective).",
+                    tag_keys=("slo",),
+                ).inc(tags={"slo": slo})
+                emit("WARNING", "watchdog",
+                     f"serve SLO burn: tenant {tenant!r} ttft p99 = "
+                     f"{p99:.3f}s over objective {objective:.3f}s "
+                     f"({len(ttfts)} request(s) this window)",
+                     kind="watchdog.slo_burn",
+                     slo=slo, objective=objective, samples=len(ttfts))
         return out
 
     def attainment_report(self) -> Dict[str, Dict[str, Any]]:
@@ -368,7 +432,15 @@ def ensure_serve_slo_monitor() -> Optional[ServeSLOMonitor]:
     configured objectives keeps idle deployments thread-free)."""
     from ..core.config import cfg
 
-    if cfg.serve_slo_ttft_p99_s <= 0 and cfg.serve_slo_queue_p99_s <= 0:
+    tenant_slo = False
+    try:
+        from ..serve import tenancy
+
+        tenant_slo = tenancy.any_tenant_slo()
+    except Exception:
+        pass
+    if (cfg.serve_slo_ttft_p99_s <= 0 and cfg.serve_slo_queue_p99_s <= 0
+            and not tenant_slo):
         return None
     monitor = serve_slo_monitor()
     monitor.start()
