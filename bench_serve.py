@@ -329,6 +329,49 @@ def bench_speculative(args, config, params, mesh) -> None:
     })
 
 
+def bench_forensics(args, config, params, mesh) -> None:
+    """Request-recorder overhead A/B: the IDENTICAL open-loop workload
+    with the forensics recorder OFF (baseline) then ON. The recorder is
+    a deque append under a lock per phase mark — the acceptance bar is
+    tokens/s with the recorder on within 2% of off."""
+    from ray_tpu.core.config import cfg
+    from ray_tpu.serve import reqlog
+
+    cfg.set(serve_request_log=False)
+    try:
+        off = _run_open_loop(args, config, params, mesh, prefix_cache=True)
+    finally:
+        cfg.reset()
+    reqlog.log().clear()
+    cfg.set(serve_request_log=True)
+    try:
+        on = _run_open_loop(args, config, params, mesh, prefix_cache=True)
+        recorder = reqlog.log().stats()
+    finally:
+        cfg.reset()
+    ratio = on["tokens_per_s"] / max(1e-9, off["tokens_per_s"])
+    _emit_result({
+        "metric": "serve_forensics_recorder_tokens_per_s_ratio",
+        "value": round(ratio, 4),
+        "unit": "fraction",
+        # overhead budget: recorder-on throughput within 2% of off
+        "vs_baseline": round(ratio, 4),
+        "within_2pct": ratio >= 0.98,
+        "tokens_per_s_recorder_on": round(on["tokens_per_s"], 1),
+        "tokens_per_s_recorder_off": round(off["tokens_per_s"], 1),
+        "p99_ttft_s_recorder_on": round(on["p99_ttft_s"], 4),
+        "p99_ttft_s_recorder_off": round(off["p99_ttft_s"], 4),
+        "marks_recorded": recorder["seq"],
+        "requests_indexed": recorder["indexed_requests"],
+        "requests": args.requests,
+        "arrival_rate_req_s": args.rate,
+        "prompt_len": args.prompt_len,
+        "max_tokens": args.max_tokens,
+        "device_kind": getattr(jax.devices()[0], "device_kind", "unknown"),
+        "tp": args.tp,
+    })
+
+
 def _preemption_drill(config, params) -> dict:
     """Lane-preemption acceptance sub-drill: one slot, a low-priority
     long decode, then a high-priority arrival. The victim must be
@@ -574,6 +617,10 @@ def main() -> None:
     ap.add_argument("--chaos", action="store_true",
                     help="run through a 2-replica serve deployment and kill "
                          "one replica mid-run (recovery drill)")
+    ap.add_argument("--forensics-overhead", action="store_true",
+                    help="A/B the request-forensics recorder: the same "
+                         "open-loop workload with reqlog off vs on; "
+                         "reports the tokens/s ratio (budget: >= 0.98)")
     ap.add_argument("--multitenant", action="store_true",
                     help="run the multi-tenant overload drill: a flooding "
                          "quota-limited tenant vs a paying weighted/"
@@ -611,6 +658,9 @@ def main() -> None:
 
     if args.speculative:
         bench_speculative(args, config, params, mesh)
+        return
+    if args.forensics_overhead:
+        bench_forensics(args, config, params, mesh)
         return
 
     base = _run_open_loop(args, config, params, mesh, prefix_cache=False)

@@ -217,6 +217,44 @@ def _cmd_events(args) -> int:
     return 0
 
 
+def _cmd_request(args) -> int:
+    """Request forensics: `ray_tpu request <id>` renders the causally
+    ordered phase waterfall of one request (cluster-wide marks joined on
+    the shared request id); `ray_tpu request --list [--tenant t]
+    [--slow]` prints the summary table the on-call triages from."""
+    import ray_tpu
+    from .serve import reqlog
+    from .util import state
+
+    _observer_init(args)
+    time.sleep(1.0)  # let the federated _requests table populate
+    try:
+        if args.list or not args.request_id:
+            rows = state.list_requests(
+                tenant=args.tenant, slow_only=args.slow, limit=args.limit
+            )
+            if not rows:
+                print("(no requests recorded)")
+                return 0
+            print(f"{'request_id':<22} {'tenant':<10} {'ttft_s':>8} "
+                  f"{'marks':>5} {'last_phase':<21} terminal")
+            for s in rows:
+                ttft = s.get("ttft_s")
+                ttft_txt = f"{ttft:.4f}" if ttft is not None else "-"
+                print(f"{s['request_id']:<22} "
+                      f"{str(s.get('tenant') or '-'):<10} "
+                      f"{ttft_txt:>8} "
+                      f"{s.get('marks', 0):>5} "
+                      f"{s.get('last_phase', '-'):<21} "
+                      f"{s.get('terminal') or '-'}")
+            return 0
+        marks = state.request_timeline(args.request_id)
+        print(reqlog.render_waterfall(marks))
+        return 0 if marks else 1
+    finally:
+        ray_tpu.shutdown()
+
+
 def _cmd_postmortem(args) -> int:
     """Snapshot events + spans + metrics + node stats + profile metas
     into one bundle archive with a reconstructed Perfetto episode
@@ -441,6 +479,25 @@ def build_parser() -> argparse.ArgumentParser:
     ep.add_argument("--poll", type=float, default=1.0,
                     help="poll interval for --follow, seconds")
 
+    rq = sub.add_parser(
+        "request",
+        help="per-request forensics: timeline waterfall or request list",
+    )
+    rq.add_argument("request_id", nargs="?", default=None,
+                    help="request id to render (x-request-id / the "
+                         "request_id echoed in responses); omit with "
+                         "--list")
+    rq.add_argument("--list", action="store_true",
+                    help="list request summaries instead of one timeline")
+    rq.add_argument("--tenant", default=None,
+                    help="with --list: only this tenant's requests")
+    rq.add_argument("--slow", action="store_true",
+                    help="with --list: only SLO-violating or timed-out "
+                         "requests")
+    rq.add_argument("--limit", type=int, default=50)
+    rq.add_argument("--address", help="head GCS address to join as observer")
+    rq.add_argument("--token", default=None)
+
     pm = sub.add_parser(
         "postmortem", help="snapshot a causal postmortem bundle (.tgz)"
     )
@@ -498,6 +555,7 @@ def main(argv=None) -> int:
         "down": _cmd_down,
         "logs": _cmd_logs,
         "events": _cmd_events,
+        "request": _cmd_request,
         "postmortem": _cmd_postmortem,
         "timeline": _cmd_timeline,
         "profile": _cmd_profile,

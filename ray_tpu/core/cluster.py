@@ -76,7 +76,7 @@ from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
 from .exceptions import ActorDiedError
-from .gcs import EVENT_NS, PREEMPT_CHANNEL
+from .gcs import EVENT_NS, PREEMPT_CHANNEL, REQLOG_NS
 from .gcs_service import PG_NS, GcsClient
 from .ids import ActorID, NodeID, ObjectID
 from .object_transfer import ObjectTransferServer, fetch_object, push_object
@@ -571,6 +571,9 @@ class ClusterContext:
         # flight-recorder federation cursor: last local event seq shipped
         # into the GCS _events table (watch-loop thread only)
         self._events_cursor = 0
+        # request-forensics cursor: last local reqlog mark seq shipped
+        # into the GCS _requests table (watch-loop thread only)
+        self._reqlog_cursor = 0
 
         store.set_cluster_hooks(
             fetch_remote=self._fetch_remote,
@@ -680,6 +683,7 @@ class ClusterContext:
             info = dict(self._info)
         self.gcs.kv_put(self.node_id.hex(), info, namespace=NODE_NS)
         self._federate_events()
+        self._federate_requests()
 
     def _federate_events(self) -> None:
         """Ship this node's new flight-recorder events into the GCS
@@ -704,6 +708,32 @@ class ClusterContext:
             del tail[: len(tail) - cap]
         self.gcs.kv_put(my_hex, tail, namespace=EVENT_NS)
         self._events_cursor = batch[-1]["seq"]
+
+    def _federate_requests(self) -> None:
+        """Ship this node's new request-forensics marks into the GCS
+        `_requests` table (same single-writer key + oldest-first cursor
+        walk as the flight recorder), so the head can answer
+        `state.request_timeline(id)` for a request whose router hop and
+        engine hop ran on different nodes."""
+        from ..serve import reqlog
+        from .config import cfg
+
+        if not reqlog.enabled():
+            return
+        batch = reqlog.log().since(self._reqlog_cursor,
+                                   max_n=cfg.reqlog_federate_batch)
+        if not batch:
+            return
+        my_hex = self.node_id.hex()
+        tail = self.gcs.kv_get(my_hex, namespace=REQLOG_NS) or []
+        tail.extend(
+            m if m.get("node") else dict(m, node=my_hex) for m in batch
+        )
+        cap = cfg.reqlog_table_cap
+        if len(tail) > cap:
+            del tail[: len(tail) - cap]
+        self.gcs.kv_put(my_hex, tail, namespace=REQLOG_NS)
+        self._reqlog_cursor = batch[-1]["seq"]
 
     def _watch_loop(self) -> None:
         from .config import cfg

@@ -236,6 +236,26 @@ define_flag("serve_slo_check_period_s", 5.0,
             "Interval between serve SLO monitor evaluations of the PR-2 "
             "latency histograms.")
 
+# request forensics plane (serve/reqlog.py)
+define_flag("serve_request_log", True,
+            "Record per-request typed phase marks (serve/reqlog.py): "
+            "the ledger behind state.request_timeline / `ray_tpu "
+            "request` / dashboard /api/requests (False = recorder off; "
+            "request ids still thread through).")
+define_flag("serve_request_log_marks", 4096,
+            "Per-process ring capacity for request phase marks; the "
+            "oldest mark is evicted first.")
+define_flag("serve_request_log_requests", 1024,
+            "Per-process cap on request SUMMARIES the recorder indexes "
+            "(oldest request evicted first).")
+define_flag("reqlog_federate_batch", 256,
+            "Max request marks a node ships into the GCS _requests "
+            "table per stats-piggyback period (cursor walk, never "
+            "skips).")
+define_flag("reqlog_table_cap", 2000,
+            "Per-node cap on request marks retained in the GCS "
+            "_requests table (the cluster-wide queryable tail).")
+
 # flight recorder (durable events + federation + goodput accounting)
 define_flag("events_dir", "",
             "Directory for durable per-node event-log segments; each "

@@ -37,6 +37,14 @@ PROFILE_NS = "_profiles"
 # `ray_tpu postmortem` read back.
 EVENT_NS = "_events"
 
+# GCS KV namespace for the federated request-forensics table: node_hex
+# -> bounded list of that node's recent request phase marks
+# (serve/reqlog.py), shipped on the same stats-piggyback path as
+# EVENT_NS. `state.request_timeline()` / `state.list_requests()` merge
+# it with the local ring so one request's cross-node marks stitch into
+# one waterfall.
+REQLOG_NS = "_requests"
+
 
 class KVStore:
     """Namespaced key-value store (reference: gcs_kv_manager.h)."""

@@ -281,6 +281,32 @@ class _Handler(BaseHTTPRequestHandler):
             )
         if name == "cluster_events":
             return state.cluster_events()
+        if name == "requests":
+            # request-forensics summaries (the on-call triage list:
+            # ?tenant=&slow=1&limit=)
+            return state.list_requests(
+                tenant=query.get("tenant"),
+                slow_only=query.get("slow", "0") in ("1", "true"),
+                limit=int(query.get("limit", 200)),
+            )
+        if name == "request":
+            # one request's cluster-wide phase timeline + the rendered
+            # waterfall (the CLI's `ray_tpu request <id>` view)
+            if "id" not in query:
+                raise ValueError("request endpoint needs ?id=<request_id>")
+            from .serve import reqlog
+
+            marks = state.request_timeline(query["id"])
+            return {
+                "request_id": query["id"],
+                "marks": marks,
+                "decomposition": reqlog.decompose(marks),
+                "waterfall": reqlog.render_waterfall(marks),
+            }
+        if name == "engines":
+            # live engine introspection: lane table, page pool, prefix
+            # cache chains, fair-queue depths (this process's engines)
+            return state.engine_snapshot()
         if name == "goodput":
             # serve-side SLO attainment + any train goodput gauges land
             # in /metrics; this endpoint serves the serve ledger

@@ -97,11 +97,20 @@ class _HttpProxy:
             protocol_version = "HTTP/1.1"
 
             def do_POST(self):  # noqa: N802
+                from . import reqlog
+
+                # end-to-end forensics id: honor the client's
+                # x-request-id, else mint one at first touch
+                request_id = (
+                    self.headers.get("x-request-id")
+                    or reqlog.new_request_id()
+                )
                 retry_after = None
                 try:
                     from urllib.parse import parse_qs, urlsplit
 
                     url = urlsplit(self.path)
+                    reqlog.mark(request_id, "http.received", path=url.path)
                     query = parse_qs(url.query)
                     length = int(self.headers.get("Content-Length", 0))
                     payload = json.loads(self.rfile.read(length) or b"{}")
@@ -135,16 +144,23 @@ class _HttpProxy:
                                 int(priority) if priority is not None else None
                             ),
                         )
+                    handle = handle.options(request_id=request_id)
                     method = parts[1] if len(parts) > 1 else "__call__"
                     if query.get("stream", ["0"])[0] in ("1", "true"):
-                        self._stream_response(handle, method, payload)
+                        self._stream_response(handle, method, payload,
+                                              request_id)
                         return
                     ref = getattr(handle, method).remote(payload) if method != "__call__" else handle.remote(payload)
                     result = _core_api.get(ref, timeout=120)
-                    body = json.dumps({"result": result}).encode()
+                    body = json.dumps({
+                        "result": result, "request_id": request_id,
+                    }).encode()
                     self.send_response(200)
                 except KeyError as e:
-                    body = json.dumps({"error": f"not found: {e}"}).encode()
+                    body = json.dumps({
+                        "error": f"not found: {e}",
+                        "request_id": request_id,
+                    }).encode()
                     self.send_response(404)
                 except Exception as e:
                     # typed serve errors keep their HTTP semantics: shed →
@@ -180,16 +196,22 @@ class _HttpProxy:
                         code = 504
                     else:
                         code = 500
-                    body = json.dumps({"error": repr(cause)}).encode()
+                    # request_id rides NEXT TO Retry-After: a shed client
+                    # can quote it straight to `ray_tpu request <id>`
+                    body = json.dumps({
+                        "error": repr(cause), "request_id": request_id,
+                    }).encode()
                     self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
                 if retry_after is not None:
                     self.send_header("Retry-After", str(retry_after))
+                self.send_header("x-request-id", request_id)
                 self.end_headers()
                 self.wfile.write(body)
 
-            def _stream_response(self, handle, method, payload) -> None:
+            def _stream_response(self, handle, method, payload,
+                                 request_id=None) -> None:
                 """Chunked transfer: one JSON line per yielded item
                 (reference: Serve streaming responses over ASGI). Items
                 flow as the replica's generator produces them — backed by
@@ -202,6 +224,8 @@ class _HttpProxy:
                 self.send_response(200)
                 self.send_header("Content-Type", "application/jsonl")
                 self.send_header("Transfer-Encoding", "chunked")
+                if request_id is not None:
+                    self.send_header("x-request-id", request_id)
                 self.end_headers()
 
                 def chunk(data: bytes) -> None:

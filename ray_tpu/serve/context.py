@@ -81,3 +81,26 @@ def _set_request_tenant(tenant: Optional[str], priority: Optional[int]):
 
 def _reset_request_tenant(token) -> None:
     _tenant.reset(token)
+
+
+_request_id: contextvars.ContextVar[Optional[str]] = contextvars.ContextVar(
+    "raytpu_serve_request_id", default=None
+)
+
+
+def get_request_id() -> Optional[str]:
+    """End-to-end id of the serve request currently executing on this
+    thread (the public key the request-forensics plane records marks
+    under and responses echo as `x-request-id`), or None when the call
+    did not arrive through the router with an id."""
+    return _request_id.get()
+
+
+def _set_request_id(request_id: Optional[str]):
+    """Internal: installs the request id for the executing request;
+    returns the reset token (mirrors `_set_request_deadline`)."""
+    return _request_id.set(request_id)
+
+
+def _reset_request_id(token) -> None:
+    _request_id.reset(token)

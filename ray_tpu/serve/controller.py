@@ -46,6 +46,7 @@ class _ReplicaWrapper:
         deadline = kwargs.pop("_deadline_ts", None)
         tenant = kwargs.pop("_tenant", None)
         priority = kwargs.pop("_priority", None)
+        request_id = kwargs.pop("_request_id", None)
         if self._draining:
             # a call that raced the drain mark: bounce it so the router
             # fails over instead of queueing work behind a dying replica
@@ -59,20 +60,24 @@ class _ReplicaWrapper:
         _set_model_id(model_id)
         token = serve_ctx._set_request_deadline(deadline)
         tenant_token = serve_ctx._set_request_tenant(tenant, priority)
+        rid_token = serve_ctx._set_request_id(request_id)
         try:
             result = getattr(self._instance, method)(*args, **kwargs)
             if hasattr(result, "__next__") and (
                 model_id or deadline is not None or tenant is not None
+                or request_id is not None
             ):
                 # generator bodies run at iteration time (the streaming
                 # executor drains them after this returns): re-establish
-                # the model-id + deadline + tenant context around actual
-                # execution
+                # the model-id + deadline + tenant + request-id context
+                # around actual execution
                 return _with_request_context(
-                    result, model_id, deadline, tenant, priority
+                    result, model_id, deadline, tenant, priority,
+                    request_id,
                 )
             return result
         finally:
+            serve_ctx._reset_request_id(rid_token)
             serve_ctx._reset_request_tenant(tenant_token)
             serve_ctx._reset_request_deadline(token)
             _set_model_id(None)
@@ -87,16 +92,19 @@ class _ReplicaWrapper:
 def _with_request_context(gen, model_id: Optional[str],
                           deadline: Optional[float],
                           tenant: Optional[str] = None,
-                          priority: Optional[int] = None):
+                          priority: Optional[int] = None,
+                          request_id: Optional[str] = None):
     from . import context as serve_ctx
     from .multiplex import _set_model_id
 
     _set_model_id(model_id)
     token = serve_ctx._set_request_deadline(deadline)
     tenant_token = serve_ctx._set_request_tenant(tenant, priority)
+    rid_token = serve_ctx._set_request_id(request_id)
     try:
         yield from gen
     finally:
+        serve_ctx._reset_request_id(rid_token)
         serve_ctx._reset_request_tenant(tenant_token)
         serve_ctx._reset_request_deadline(token)
         _set_model_id(None)

@@ -94,6 +94,18 @@ class _Request:
     # True while parked by lane preemption (waiting in the fair queue
     # with its generated prefix folded into the prompt)
     parked: bool = False
+    # request forensics (serve/reqlog.py): the end-to-end public id, and
+    # the TTFT-decomposition accumulators. queue_wait/preempt_wait are
+    # charged at each (re-)admission from enqueued_at, so at first token
+    # prefill_compute = TTFT - queue_wait - preempt_wait by construction.
+    request_id: Optional[str] = None
+    enqueued_at: Optional[float] = None
+    queue_wait_s: float = 0.0
+    preempt_wait_s: float = 0.0
+    cached_tokens: int = 0
+    # latch: the paged admit loop retries a page-stalled admission every
+    # tick — mark engine.page_stall once per stall episode, not per retry
+    stall_marked: bool = False
 
 
 def _start_request_span(request: "_Request", engine_kind: str) -> None:
@@ -102,12 +114,16 @@ def _start_request_span(request: "_Request", engine_kind: str) -> None:
     Shared by the dense and paged engines."""
     from ...util import tracing
 
+    attrs = {"rid": request.rid, "engine": engine_kind,
+             "prompt_tokens": len(request.prompt),
+             "max_tokens": request.max_tokens}
+    if request.request_id is not None:
+        # joins the trace to the request-forensics timeline (reqlog)
+        attrs["request_id"] = request.request_id
     request.span = tracing.tracer().start_span(
         "engine.request",
         lane=f"engine:{engine_kind}",
-        attrs={"rid": request.rid, "engine": engine_kind,
-               "prompt_tokens": len(request.prompt),
-               "max_tokens": request.max_tokens},
+        attrs=attrs,
     )
 
 
@@ -311,20 +327,24 @@ def _queue_bound(config) -> int:
     return bound
 
 
-def _check_admission(engine, deadline_ts, tenant: str = "default") -> None:
+def _check_admission(engine, deadline_ts, tenant: str = "default",
+                     request_id: Optional[str] = None) -> None:
     """Shared submit-time gate for both engines: bound the queue (typed
     BackPressureError on overflow), charge the tenant's token bucket
     (typed shed carrying the bucket's refill time as Retry-After), and
     fail already-expired deadlines fast instead of queueing work nobody
-    will wait for."""
+    will wait for. Every exit records a TERMINAL phase mark so a shed
+    request never appears forever-pending in the forensics plane."""
     from ...core.exceptions import BackPressureError, RequestTimeoutError
-    from .. import tenancy
+    from .. import reqlog, tenancy
 
     bound = _queue_bound(engine.config)
     backlog = engine._queue.qsize() + len(getattr(engine, "_fair", ()))
     if bound >= 0 and backlog >= bound:
         engine.metrics["shed"] = engine.metrics.get("shed", 0.0) + 1
         tenancy.count_shed(tenant)
+        reqlog.mark(request_id, "engine.shed", tenant=tenant,
+                    reason="queue_full", backlog=backlog)
         raise BackPressureError(
             f"engine admit queue is full ({bound} waiting requests)"
         )
@@ -332,35 +352,109 @@ def _check_admission(engine, deadline_ts, tenant: str = "default") -> None:
     if retry_after_s is not None:
         engine.metrics["shed"] = engine.metrics.get("shed", 0.0) + 1
         tenancy.count_shed(tenant, retry_after_s)
+        reqlog.mark(request_id, "engine.shed", tenant=tenant,
+                    reason="quota", retry_after_s=retry_after_s)
         raise BackPressureError(
             f"tenant {tenant!r} is over its token-bucket quota",
             retry_after_s=retry_after_s,
         )
     if deadline_ts is not None and time.time() >= deadline_ts:
         engine.metrics["timeouts"] = engine.metrics.get("timeouts", 0.0) + 1
+        reqlog.mark(request_id, "engine.timeout", tenant=tenant,
+                    reason="expired_before_submit")
         raise RequestTimeoutError("request deadline expired before submit")
     tenancy.count_request(tenant)
 
 
-def _observe_tenant_ttft(request: "_Request") -> None:
+def _charge_wait(request: "_Request") -> float:
+    """Charge the time since the request was (re-)enqueued into the
+    right TTFT-decomposition bucket: preempt_wait for a parked lane
+    being re-admitted, queue_wait otherwise. Called at each successful
+    admission, BEFORE the admit path clears `parked`."""
+    now = time.perf_counter()
+    wait = max(0.0, now - (request.enqueued_at
+                           if request.enqueued_at is not None
+                           else request.submitted_at))
+    if request.parked:
+        request.preempt_wait_s += wait
+    else:
+        request.queue_wait_s += wait
+    request.enqueued_at = None
+    return wait
+
+
+def _ttft_buckets(request: "_Request") -> Dict[str, float]:
+    """TTFT decomposition at the first-token point. The three summed
+    buckets are exact by construction (prefill_compute is the
+    remainder); cache_saved is an informational estimate of the prefill
+    time the prefix cache skipped, NOT part of the sum."""
+    ttft = max(0.0, request.first_token_at - request.submitted_at)
+    queue_wait = min(request.queue_wait_s, ttft)
+    preempt_wait = min(request.preempt_wait_s, max(0.0, ttft - queue_wait))
+    prefill_compute = max(0.0, ttft - queue_wait - preempt_wait)
+    buckets = {
+        "ttft_s": ttft,
+        "queue_wait_s": queue_wait,
+        "preempt_wait_s": preempt_wait,
+        "prefill_compute_s": prefill_compute,
+        "cache_saved_s": 0.0,
+    }
+    prefilled = len(request.prompt) - request.cached_tokens
+    if request.cached_tokens > 0 and prefilled > 0:
+        buckets["cache_saved_s"] = (
+            prefill_compute * request.cached_tokens / prefilled
+        )
+        buckets["cached_tokens"] = request.cached_tokens
+    return buckets
+
+
+def _observe_tenant_ttft(request: "_Request") -> Dict[str, float]:
     """First-token hook shared by both engines: report the request's
     TTFT into the tenancy window ServeSLOMonitor drains for per-tenant
-    attainment."""
+    attainment, push the decomposition into the per-tenant breakdown
+    window + histograms, and return the buckets (the engines attach
+    them to the engine.first_token mark). Only ever called for requests
+    that actually produced a token."""
+    from ...util.metrics import get_or_create_histogram
     from .. import tenancy
 
-    if request.first_token_at is not None:
-        tenancy.observe_ttft(
-            request.tenant, request.first_token_at - request.submitted_at
-        )
+    if request.first_token_at is None:
+        return {}
+    buckets = _ttft_buckets(request)
+    tenancy.observe_ttft(request.tenant, buckets["ttft_s"])
+    tenancy.observe_ttft_breakdown(request.tenant, buckets)
+    tags = {"tenant": request.tenant}
+    bounds = (0.005, 0.025, 0.1, 0.5, 2.5, 10.0)
+    get_or_create_histogram(
+        "raytpu_serve_ttft_queue_wait_seconds",
+        "Per-tenant TTFT bucket: time waiting in admit/fair queues.",
+        boundaries=bounds, tag_keys=("tenant",),
+    ).observe(buckets["queue_wait_s"], tags=tags)
+    get_or_create_histogram(
+        "raytpu_serve_ttft_preempt_wait_seconds",
+        "Per-tenant TTFT bucket: time parked by lane preemption.",
+        boundaries=bounds, tag_keys=("tenant",),
+    ).observe(buckets["preempt_wait_s"], tags=tags)
+    get_or_create_histogram(
+        "raytpu_serve_ttft_prefill_compute_seconds",
+        "Per-tenant TTFT bucket: prompt-ingest compute (TTFT minus the "
+        "wait buckets).",
+        boundaries=bounds, tag_keys=("tenant",),
+    ).observe(buckets["prefill_compute_s"], tags=tags)
+    return buckets
 
 
 def _timeout_request(request: "_Request") -> None:
     """Fail a request on deadline expiry: the stream raises a typed
-    RequestTimeoutError and the request span closes as TIMEOUT."""
+    RequestTimeoutError, the request span closes as TIMEOUT, and the
+    forensics timeline records its terminal phase."""
     from ...core.exceptions import RequestTimeoutError
+    from .. import reqlog
 
     _finish_request_span(request, status="TIMEOUT")
     request.span = None  # _finish must not double-close the span
+    reqlog.mark(request.request_id, "engine.timeout", tenant=request.tenant,
+                generated=request.generated)
     request.out.put(RequestTimeoutError(
         f"request {request.rid} cancelled: deadline exceeded after "
         f"{request.generated} generated token(s)"
@@ -425,6 +519,11 @@ class ResponseStream:
         if self._request.first_token_at is None:
             return None
         return self._request.first_token_at - self._request.submitted_at
+
+    @property
+    def request_id(self) -> Optional[str]:
+        """The end-to-end public request id (forensics/timeline key)."""
+        return self._request.request_id
 
 
 class LLMEngine:
@@ -510,7 +609,10 @@ class LLMEngine:
         deadline_ts: Optional[float] = None,
         tenant: Optional[str] = None,
         priority: Optional[int] = None,
+        request_id: Optional[str] = None,
     ) -> ResponseStream:
+        from .. import reqlog
+
         if len(prompt_tokens) + max_tokens > self.max_seq:
             raise ValueError(
                 f"prompt({len(prompt_tokens)}) + max_tokens({max_tokens}) exceeds "
@@ -522,7 +624,9 @@ class LLMEngine:
                 "engine samples temperature-only); use PagedEngineConfig"
             )
         tenant = tenant or "default"
-        _check_admission(self, deadline_ts, tenant)
+        if request_id is None and reqlog.enabled():
+            request_id = reqlog.new_request_id()
+        _check_admission(self, deadline_ts, tenant, request_id=request_id)
         request = _Request(
             rid=next(self._rid),
             prompt=list(prompt_tokens),
@@ -534,8 +638,13 @@ class LLMEngine:
             deadline_ts=deadline_ts,
             tenant=tenant,
             priority=int(priority or 0),
+            request_id=request_id,
         )
         _start_request_span(request, "dense")
+        reqlog.mark(request_id, "engine.submitted", tenant=tenant,
+                    prompt_tokens=len(request.prompt),
+                    max_tokens=max_tokens)
+        request.enqueued_at = time.perf_counter()
         self._queue.put(request)
         _reject_if_dead(self, request)
         self._wake.set()
@@ -550,6 +659,32 @@ class LLMEngine:
         self._stop.set()
         self._wake.set()
         self._thread.join(timeout=10)
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Live engine introspection (`state.engine_snapshot()`): the
+        dense slot grid has no page pool or fair queue, so the snapshot
+        is just the lane table plus queue depth. Lock-free point-in-time
+        read, same caveats as the paged engine's."""
+        lanes: List[Dict[str, Any]] = []
+        for idx, slot in enumerate(self.slots):
+            request = slot.request
+            lane: Dict[str, Any] = {"lane": idx, "free": request is None}
+            if request is not None:
+                lane.update(
+                    rid=request.rid,
+                    request_id=request.request_id,
+                    tenant=request.tenant,
+                    priority=request.priority,
+                    position=slot.position,
+                    remaining=slot.remaining,
+                    generated=request.generated,
+                )
+            lanes.append(lane)
+        return {
+            "kind": "dense",
+            "lanes": lanes,
+            "queue_depth": self._queue.qsize(),
+        }
 
     # ------------------------------------------------------------ scheduling
 
@@ -584,7 +719,11 @@ class LLMEngine:
 
     def _do_prefill(self, slot_idx: int, slot: _Slot, request: _Request) -> None:
         from ...util import tracing
+        from .. import reqlog
 
+        wait = _charge_wait(request)
+        reqlog.mark(request.request_id, "engine.admitted",
+                    tenant=request.tenant, lane=slot_idx, wait_s=wait)
         if request.span is not None:
             # admit time: everything between submit and this slot freeing
             # up was queue wait
@@ -614,7 +753,9 @@ class LLMEngine:
         temps = jnp.asarray([request.temperature], dtype=jnp.float32)
         first = int(self._sample(last_logits, sub, temps)[0])
         request.first_token_at = time.perf_counter()
-        _observe_tenant_ttft(request)
+        buckets = _observe_tenant_ttft(request)
+        reqlog.mark(request.request_id, "engine.first_token",
+                    tenant=request.tenant, **buckets)
         prefill_span.end(bucket=bucket)
         self.metrics["prefill_tokens"] += float(len(prompt))
         request.generated += 1
@@ -634,7 +775,15 @@ class LLMEngine:
             self._finish(slot)
 
     def _finish(self, slot: _Slot) -> None:
+        from .. import reqlog
+
         if slot.request is not None:
+            if slot.request.span is not None:
+                # span=None means the timeout path already sealed this
+                # request with its own terminal mark
+                reqlog.mark(slot.request.request_id, "engine.finished",
+                            tenant=slot.request.tenant,
+                            generated=slot.request.generated)
             _finish_request_span(slot.request)
             slot.request.out.put(None)
         slot.request = None

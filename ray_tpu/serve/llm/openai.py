@@ -35,6 +35,7 @@ from ...core.exceptions import (
     unwrap_error,
 )
 from .. import api as serve_api
+from .. import reqlog
 from ..api import EgresslessHTTPServer, write_chunk
 
 
@@ -109,20 +110,32 @@ class OpenAIFrontend:
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                rid = getattr(self, "_request_id", None)
+                if rid:
+                    self.send_header("x-request-id", rid)
                 self.end_headers()
                 self.wfile.write(body)
 
             def _error(self, code: int, message: str, etype: str,
                        retry_after: Optional[int] = None) -> None:
-                body = json.dumps({"error": {
+                err: Dict[str, Any] = {
                     "message": message, "type": etype, "param": None,
                     "code": None,
-                }}).encode()
+                }
+                rid = getattr(self, "_request_id", None)
+                if rid:
+                    # the forensics key lands NEXT TO Retry-After so a
+                    # shed/timed-out client can quote it to
+                    # `ray_tpu request <id>`
+                    err["request_id"] = rid
+                body = json.dumps({"error": err}).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
                 if retry_after is not None:
                     self.send_header("Retry-After", str(retry_after))
+                if rid:
+                    self.send_header("x-request-id", rid)
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -141,6 +154,15 @@ class OpenAIFrontend:
                     self._error(404, f"no route {self.path}", "invalid_request_error")
 
             def do_POST(self):  # noqa: N802
+                # stable end-to-end request id: the caller's x-request-id
+                # wins (idempotent client retries keep one forensics
+                # timeline); otherwise mint one here, at first touch
+                self._request_id = (
+                    self.headers.get("x-request-id")
+                    or reqlog.new_request_id()
+                )
+                reqlog.mark(self._request_id, "http.received",
+                            path=self.path.rstrip("/"))
                 try:
                     length = int(self.headers.get("Content-Length", 0))
                     req = json.loads(self.rfile.read(length) or b"{}")
@@ -275,6 +297,9 @@ class OpenAIFrontend:
         tenant, priority = tenancy.resolve_http_tenant(http.headers)
         if tenant is not None or priority is not None:
             handle = handle.options(tenant=tenant, priority=priority)
+        request_id = getattr(http, "_request_id", None)
+        if request_id:
+            handle = handle.options(request_id=request_id)
         payload = self._to_payload(req, chat)
         rid = ("chatcmpl-" if chat else "cmpl-") + uuid.uuid4().hex[:24]
         created = int(time.time())
@@ -303,6 +328,7 @@ class OpenAIFrontend:
         http._json(200, {
             "id": rid, "object": obj, "created": created, "model": model_id,
             "choices": [choice], "usage": result["usage"],
+            "request_id": request_id,
         })
 
     def _stream_sse(self, http, handle, payload, rid, created, model_id,
@@ -322,6 +348,9 @@ class OpenAIFrontend:
         http.send_header("Content-Type", "text/event-stream")
         http.send_header("Cache-Control", "no-cache")
         http.send_header("Transfer-Encoding", "chunked")
+        rid_hdr = getattr(http, "_request_id", None)
+        if rid_hdr:
+            http.send_header("x-request-id", rid_hdr)
         http.end_headers()
 
         def send(data: str) -> None:

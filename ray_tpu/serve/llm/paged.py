@@ -276,6 +276,20 @@ class PrefixCache:
                 "hit_rate": hits / max(1, hits + misses),
             }
 
+    def chain_heads(self, limit: int = 64) -> List[Dict[str, Any]]:
+        """MRU-first view of the cached chain entries for engine
+        introspection (`engine.snapshot()`): each row is one published
+        page keyed by its blake2b chain-hash head, with its live
+        refcount (1 = pinned only by the cache, >1 = shared by slots)."""
+        with self._lock:
+            rows = [
+                {"digest": digest.hex(), "page": page}
+                for digest, page in reversed(self._entries.items())
+            ][:limit]
+        for row in rows:
+            row["refcount"] = self.allocator.refcount(row["page"])
+        return rows
+
 
 # ------------------------------------------------------------------ attention
 

@@ -42,10 +42,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...models.transformer import TransformerConfig
+from .. import reqlog
 from ..tenancy import FairQueue
 from .engine import (
     ResponseStream,
     _Request,
+    _charge_wait,
     _check_admission,
     _fail_all_requests,
     _finish_request_span,
@@ -601,6 +603,7 @@ class PagedLLMEngine:
         deadline_ts: Optional[float] = None,
         tenant: Optional[str] = None,
         priority: Optional[int] = None,
+        request_id: Optional[str] = None,
     ) -> ResponseStream:
         limit = self.paged.max_slot_tokens
         if len(prompt_tokens) + max_tokens > limit:
@@ -613,7 +616,9 @@ class PagedLLMEngine:
         if not 0.0 < top_p <= 1.0:
             raise ValueError(f"top_p must be in (0, 1], got {top_p}")
         tenant = tenant or "default"
-        _check_admission(self, deadline_ts, tenant)
+        if request_id is None and reqlog.enabled():
+            request_id = reqlog.new_request_id()
+        _check_admission(self, deadline_ts, tenant, request_id=request_id)
         request = _Request(
             rid=next(self._rid),
             prompt=list(prompt_tokens),
@@ -627,8 +632,13 @@ class PagedLLMEngine:
             deadline_ts=deadline_ts,
             tenant=tenant,
             priority=int(priority or 0),
+            request_id=request_id,
         )
         _start_request_span(request, "paged")
+        reqlog.mark(request_id, "engine.submitted", tenant=tenant,
+                    prompt_tokens=len(request.prompt),
+                    max_tokens=max_tokens)
+        request.enqueued_at = time.perf_counter()
         self._queue.put(request)
         _reject_if_dead(self, request)
         self._wake.set()
@@ -651,6 +661,57 @@ class PagedLLMEngine:
         if self.prefix_cache is not None:
             for key, val in self.prefix_cache.stats().items():
                 out[f"prefix_cache_{key}"] = val
+        return out
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Live engine introspection (`state.engine_snapshot()` / the
+        dashboard's /api/engines): the lane table, page-pool occupancy,
+        prefix-cache chain heads, and per-tenant fair-queue depths. Read
+        in place, point-in-time, lock-free — the loop thread mutates
+        between field reads, and a forensics read must never stall the
+        engine (a lane row may be a tick stale; that is fine)."""
+        lanes: List[Dict[str, Any]] = []
+        for idx, slot in enumerate(self.slots):
+            request = slot.request
+            lane: Dict[str, Any] = {"lane": idx, "free": request is None}
+            if request is not None:
+                lane.update(
+                    rid=request.rid,
+                    request_id=request.request_id,
+                    tenant=request.tenant,
+                    priority=request.priority,
+                    prefilling=slot.prefilling,
+                    stalled=slot.stalled,
+                    preempt_pending=slot.preempt_pending,
+                    position=slot.position,
+                    prefill_offset=slot.prefill_offset,
+                    pages=len(slot.pages),
+                    blocks_in_flight=slot.blocks_in_flight,
+                    dispatch_remaining=slot.dispatch_remaining,
+                    emit_remaining=slot.emit_remaining,
+                    generated=request.generated,
+                    spec_inflight=slot.spec_inflight,
+                )
+            lanes.append(lane)
+        pc = self.paged
+        out: Dict[str, Any] = {
+            "kind": "paged",
+            "lanes": lanes,
+            "pages": {
+                "total": pc.num_pages - 1,  # page 0 is scratch
+                "free": self.allocator.available,
+                "in_use": pc.num_pages - 1 - self.allocator.available,
+            },
+            "queue_depth": self._queue.qsize(),
+            "fair_depths": self._fair.depths(),
+            "inflight_blocks": self._inflight,
+            "spec_tokens": self.spec_tokens,
+        }
+        if self.prefix_cache is not None:
+            out["prefix_cache"] = dict(
+                self.prefix_cache.stats(),
+                chains=self.prefix_cache.chain_heads(),
+            )
         return out
 
     def shutdown(self) -> None:
@@ -806,6 +867,11 @@ class PagedLLMEngine:
         # parked lanes keep their place: front of their (priority, tenant)
         # lane, no fresh virtual-time charge
         self._fair.requeue(request, request.tenant, request.priority)
+        # park wait charges into the preempt_wait TTFT bucket at resume
+        request.enqueued_at = time.perf_counter()
+        reqlog.mark(request.request_id, "engine.preempted",
+                    tenant=request.tenant, lane=idx, pages=freed,
+                    generated=len(generated))
         self.metrics["lane_preemptions"] += 1
         self.metrics["preempted_pages"] += float(freed)
         emit(
@@ -894,7 +960,15 @@ class PagedLLMEngine:
                 # no fresh virtual-time charge
                 self._fair.requeue(request, request.tenant, request.priority)
                 self.metrics["page_stalls"] += 1
+                if not request.stall_marked:
+                    request.stall_marked = True
+                    reqlog.mark(request.request_id, "engine.page_stall",
+                                tenant=request.tenant, reason="admit",
+                                need_pages=fresh_n)
                 return
+            request.stall_marked = False
+            wait = _charge_wait(request)
+            request.cached_tokens = len(hit) * self.paged.page_size
             if request.parked:
                 request.parked = False
                 self.metrics["lane_resumes"] += 1
@@ -907,6 +981,14 @@ class PagedLLMEngine:
                     rid=request.rid,
                     tenant=request.tenant,
                 )
+                reqlog.mark(request.request_id, "engine.resumed",
+                            tenant=request.tenant, lane=idx, wait_s=wait,
+                            hit_pages=len(hit))
+            else:
+                reqlog.mark(request.request_id, "engine.admitted",
+                            tenant=request.tenant, lane=idx, wait_s=wait,
+                            hit_pages=len(hit),
+                            cached_tokens=request.cached_tokens)
             slot.request = request
             slot.pages = list(hit) + pages
             slot.position = 0
@@ -952,6 +1034,8 @@ class PagedLLMEngine:
             if not slot.stalled:
                 slot.stalled = True
                 self.metrics["page_stalls"] += 1
+                reqlog.mark(slot.request.request_id, "engine.page_stall",
+                            tenant=slot.request.tenant, reason="cow")
             return False
         self.cache = self._copy_page(
             self.cache, jnp.asarray(page, jnp.int32),
@@ -961,6 +1045,9 @@ class PagedLLMEngine:
         slot.pages[page_index] = fresh[0]
         self.block_tables[idx, page_index] = fresh[0]
         self.metrics["prefix_cache_cow"] += 1
+        reqlog.mark(slot.request.request_id, "engine.cow",
+                    tenant=slot.request.tenant, page=page,
+                    fresh_page=fresh[0])
         return True
 
     def _mixed_tick(self) -> bool:
@@ -995,6 +1082,11 @@ class PagedLLMEngine:
             if need > 0:
                 extra = self._alloc_pages(need)
                 if extra is None:
+                    if not slot.stalled:
+                        reqlog.mark(slot.request.request_id,
+                                    "engine.page_stall",
+                                    tenant=slot.request.tenant,
+                                    reason="prefill_growth")
                     slot.stalled = True
                     self.metrics["page_stalls"] += 1
                     continue
@@ -1016,6 +1108,9 @@ class PagedLLMEngine:
             prompt = slot.request.prompt
             n_real = min(ct, len(prompt) - offset)
             self.metrics["prefill_tokens"] += float(n_real)
+            reqlog.mark(slot.request.request_id, "engine.prefill_chunk",
+                        tenant=slot.request.tenant, offset=offset,
+                        tokens=n_real)
             tokens[lane, :n_real] = prompt[offset : offset + n_real]
             page_rows[lane] = self.block_tables[idx]
             window = slot.pages[first_page : first_page + cp]
@@ -1107,8 +1202,10 @@ class PagedLLMEngine:
             )
             self._tokens_dev = merged
             _async_fetch(stacked)
-            for i, _, _ in dec_lanes:
+            for i, request, _ in dec_lanes:
                 slot = self.slots[i]
+                reqlog.mark(request.request_id, "engine.decode_block",
+                            tenant=request.tenant, steps=1)
                 slot.position += 1
                 slot.dispatch_remaining -= 1
                 slot.blocks_in_flight += 1
@@ -1220,6 +1317,10 @@ class PagedLLMEngine:
                     if not slot.stalled:
                         slot.stalled = True
                         self.metrics["page_stalls"] += 1
+                        reqlog.mark(slot.request.request_id,
+                                    "engine.page_stall",
+                                    tenant=slot.request.tenant,
+                                    reason="decode_growth")
                     continue
                 slot.pages.extend(extra)
                 self.block_tables[i, : len(slot.pages)] = slot.pages
@@ -1272,8 +1373,10 @@ class PagedLLMEngine:
             self._tokens_dev, final, jnp.asarray(mask)
         )
         _async_fetch(toks)
-        for i, _, _ in lanes:
+        for i, request, _ in lanes:
             slot = self.slots[i]
+            reqlog.mark(request.request_id, "engine.decode_block",
+                        tenant=request.tenant, steps=useful_steps[i])
             slot.position += useful_steps[i]
             slot.dispatch_remaining -= K
             slot.blocks_in_flight += 1
@@ -1344,6 +1447,10 @@ class PagedLLMEngine:
                     if not slot.stalled:
                         slot.stalled = True
                         self.metrics["page_stalls"] += 1
+                        reqlog.mark(slot.request.request_id,
+                                    "engine.page_stall",
+                                    tenant=slot.request.tenant,
+                                    reason="spec_growth")
                     continue
                 slot.pages.extend(extra)
                 self.block_tables[i, : len(slot.pages)] = slot.pages
@@ -1571,12 +1678,17 @@ class PagedLLMEngine:
             # (admit-time spares below pre_pages stay mapped — trimming
             # them would churn the allocator every round on short prompts)
             keep = max((new_pos - 1) // ps + 1, pre_pages)
+            rolled = 0
             if keep < len(slot.pages):
                 trimmed = slot.pages[keep:]
                 slot.pages = slot.pages[:keep]
                 self.allocator.free(trimmed)
                 self.block_tables[idx, keep:] = 0
-                self.metrics["spec_rollback_pages"] += float(len(trimmed))
+                rolled = len(trimmed)
+                self.metrics["spec_rollback_pages"] += float(rolled)
+            reqlog.mark(request.request_id, "engine.spec_round",
+                        tenant=request.tenant, proposed=count - 1,
+                        accepted=m - 1, rollback_pages=rolled)
             slot.dispatch_remaining -= m
             if slot.dispatch_remaining <= 0:
                 slot.done_dispatching = True
@@ -1593,7 +1705,9 @@ class PagedLLMEngine:
             return  # stale block for an already-retired stream
         if first and request.first_token_at is None:
             request.first_token_at = time.perf_counter()
-            _observe_tenant_ttft(request)
+            buckets = _observe_tenant_ttft(request)
+            reqlog.mark(request.request_id, "engine.first_token",
+                        tenant=request.tenant, **buckets)
         request.generated += 1
         request.out.put(token)
         # the resume ledger: a preempted lane folds these into its prompt
@@ -1621,6 +1735,12 @@ class PagedLLMEngine:
 
     def _finish(self, idx: int, slot: _PagedSlot) -> None:
         if slot.request is not None:
+            if slot.request.span is not None:
+                # span=None means the timeout path already sealed this
+                # request with its own terminal mark
+                reqlog.mark(slot.request.request_id, "engine.finished",
+                            tenant=slot.request.tenant,
+                            generated=slot.request.generated)
             _finish_request_span(slot.request)
             slot.request.out.put(None)
         self.allocator.free(slot.pages)

@@ -65,12 +65,18 @@ class LLMServer:
         generating into the void."""
         from ..context import (
             get_request_deadline,
+            get_request_id,
             get_request_priority,
             get_request_tenant,
         )
 
         prompt = payload["prompt_tokens"]
         kwargs = {"deadline_ts": get_request_deadline()}
+        # end-to-end forensics id: ambient (threaded by the router) wins,
+        # payload field is the fallback for direct callers
+        request_id = get_request_id() or payload.get("request_id")
+        if request_id:
+            kwargs["request_id"] = str(request_id)
         # tenant context rides the same ambient channel the deadline does;
         # payload fields are the fallback for direct (non-handle) callers
         tenant = get_request_tenant() or payload.get("tenant")
@@ -111,6 +117,7 @@ class LLMServer:
             "tokens": tokens,
             "usage": self._usage(prompt, len(tokens)),
             "ttft_s": stream.ttft_s,
+            "request_id": stream.request_id,
         }
 
     def stream_generate(self, payload: Dict[str, Any]):
@@ -127,6 +134,7 @@ class LLMServer:
             "done": True,
             "usage": self._usage(prompt, n),
             "ttft_s": stream.ttft_s,
+            "request_id": stream.request_id,
         }
 
     def metrics(self, _payload: Optional[Dict[str, Any]] = None) -> Dict[str, float]:

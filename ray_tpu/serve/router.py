@@ -34,8 +34,16 @@ from ..core.exceptions import (
 )
 from ..core.rpc import RpcError
 from ..core.streaming import ObjectRefGenerator
+from . import reqlog
 
 logger = logging.getLogger(__name__)
+
+
+def _mark_route(kwargs: Dict[str, Any], phase: str, **attrs) -> None:
+    """Forensics mark keyed by the request id riding the private kwargs
+    channel (`_request_id`); no-op when the call carries no id."""
+    reqlog.mark(kwargs.get("_request_id"), phase,  # raylint: disable=request-phase
+                tenant=kwargs.get("_tenant"), **attrs)
 
 # Errors that indicate the REPLICA or transport failed (not the request):
 # safe to fail over to a different replica. A user-code exception is not
@@ -361,7 +369,8 @@ class DeploymentHandle:
                  timeout_s: Optional[float] = None,
                  max_retries: Optional[int] = None,
                  tenant: Optional[str] = None,
-                 priority: Optional[int] = None):
+                 priority: Optional[int] = None,
+                 request_id: Optional[str] = None):
         self._set = replica_set
         self._stream = stream
         self._model_id = multiplexed_model_id
@@ -369,13 +378,15 @@ class DeploymentHandle:
         self._max_retries = max_retries
         self._tenant = tenant
         self._priority = priority
+        self._request_id = request_id
 
     def options(self, *, stream: Optional[bool] = None,
                 multiplexed_model_id: Optional[str] = None,
                 timeout_s: Optional[float] = None,
                 max_retries: Optional[int] = None,
                 tenant: Optional[str] = None,
-                priority: Optional[int] = None) -> "DeploymentHandle":
+                priority: Optional[int] = None,
+                request_id: Optional[str] = None) -> "DeploymentHandle":
         return DeploymentHandle(
             self._set,
             stream=self._stream if stream is None else stream,
@@ -386,6 +397,9 @@ class DeploymentHandle:
             ),
             tenant=self._tenant if tenant is None else tenant,
             priority=self._priority if priority is None else priority,
+            request_id=(
+                self._request_id if request_id is None else request_id
+            ),
         )
 
     def __getattr__(self, method: str) -> "_MethodCaller":
@@ -393,13 +407,14 @@ class DeploymentHandle:
             raise AttributeError(method)
         return _MethodCaller(self._set, method, self._stream, self._model_id,
                              self._timeout_s, self._max_retries,
-                             self._tenant, self._priority)
+                             self._tenant, self._priority, self._request_id)
 
     def remote(self, *args, **kwargs):
         """Callable deployments: handle.remote(x) → instance.__call__(x)."""
         return _MethodCaller(
             self._set, "__call__", self._stream, self._model_id,
             self._timeout_s, self._max_retries, self._tenant, self._priority,
+            self._request_id,
         ).remote(*args, **kwargs)
 
     @property
@@ -440,7 +455,8 @@ class _MethodCaller:
                  timeout_s: Optional[float] = None,
                  max_retries: Optional[int] = None,
                  tenant: Optional[str] = None,
-                 priority: Optional[int] = None):
+                 priority: Optional[int] = None,
+                 request_id: Optional[str] = None):
         self._set = replica_set
         self._method = method
         self._stream = stream
@@ -449,6 +465,21 @@ class _MethodCaller:
         self._max_retries = max_retries
         self._tenant = tenant
         self._priority = priority
+        self._request_id = request_id
+
+    def _resolve_request_id(self) -> Optional[str]:
+        """The end-to-end forensics id for this call: the handle's
+        explicit option wins, else the ambient id when this call happens
+        inside another serve request (composition hop — the hops share
+        one timeline), else a fresh id when the request log is on."""
+        from . import context as serve_ctx
+
+        rid = self._request_id
+        if rid is None:
+            rid = serve_ctx.get_request_id()
+        if rid is None and reqlog.enabled():
+            rid = reqlog.new_request_id()
+        return rid
 
     def _resolve_tenant(self):
         """(tenant | None, priority | None) for this call: the handle's
@@ -493,6 +524,7 @@ class _MethodCaller:
 
         deadline, max_attempts = self._resolve_policy()
         tenant, priority = self._resolve_tenant()
+        request_id = self._resolve_request_id()
         resilient = max_attempts > 1 or deadline is not None
         # serve.route roots the request's trace (or nests, when called
         # from a traced region): replica pick + submission. The replica's
@@ -502,6 +534,10 @@ class _MethodCaller:
             "serve.route", deployment=self._set.name, method=self._method,
             model_id=self._model_id or "",
         ) as route_span:
+            if request_id is not None:
+                route_span.set_attribute("request_id", request_id)
+            reqlog.mark(request_id, "route.received", tenant=tenant,
+                        deployment=self._set.name, method=self._method)
             if deadline is not None:
                 route_span.set_attribute("deadline_ts", deadline)
                 if time.time() >= deadline:
@@ -509,6 +545,8 @@ class _MethodCaller:
                         "raytpu_serve_timeouts_total",
                         "serve requests failed on an expired deadline",
                     ).inc()
+                    reqlog.mark(request_id, "route.timeout", tenant=tenant,
+                                reason="expired_before_routing")
                     raise RequestTimeoutError(
                         f"request to {self._set.name!r}.{self._method} "
                         f"expired before routing"
@@ -522,6 +560,8 @@ class _MethodCaller:
                 route_span.set_attribute("tenant", tenant)
             if priority is not None:
                 kwargs["_priority"] = priority
+            if request_id is not None:
+                kwargs["_request_id"] = request_id
             # At ongoing capacity, resilient unary calls PARK instead of
             # dispatching: the reaper grants parked records in weighted-
             # fair order as replicas free up, so overload dispatch is
@@ -538,11 +578,15 @@ class _MethodCaller:
                     ).inc()
                     tenancy.count_shed(tenant or DEFAULT_TENANT)
                     route_span.set_attribute("shed", True)
+                    retry_after = self._set.drain_retry_after_s()
+                    reqlog.mark(request_id, "route.shed", tenant=tenant,
+                                reason="parked_queue_full",
+                                retry_after_s=retry_after)
                     raise BackPressureError(
                         f"deployment {self._set.name!r} is overloaded: "
                         f"{self._set.parked_count()} parked dispatches "
                         f"(max_queued_requests={self._set.max_queued})",
-                        retry_after_s=self._set.drain_retry_after_s(),
+                        retry_after_s=retry_after,
                     )
                 promise_ref, promise_oid, rt = _mint_promise()
                 rec = _TrackedCall(
@@ -556,6 +600,8 @@ class _MethodCaller:
                 self._set.park(rec, tenant or DEFAULT_TENANT, priority or 0)
                 _Reaper.instance()._track_record(rec)
                 route_span.set_attribute("parked", True)
+                reqlog.mark(request_id, "route.parked", tenant=tenant,
+                            parked=self._set.parked_count())
                 return promise_ref
             try:
                 replica = self._set.pick(self._model_id)
@@ -565,6 +611,8 @@ class _MethodCaller:
                     "serve requests shed by admission control",
                 ).inc()
                 route_span.set_attribute("shed", True)
+                reqlog.mark(request_id, "route.shed", tenant=tenant,
+                            reason="ongoing_capacity")
                 raise
             route_span.set_attribute("replica", _rkey(replica)[:12])
             try:
@@ -576,6 +624,8 @@ class _MethodCaller:
             except BaseException:
                 self._set.release(replica)
                 raise
+            reqlog.mark(request_id, "route.dispatched", tenant=tenant,
+                        replica=_rkey(replica)[:12], attempt=1)
         if self._stream:
             if not resilient:
                 _Reaper.instance().track(ref, self._set, replica)
@@ -647,12 +697,16 @@ def _stream_failover_loop(proxy: _FailoverStream, rset: ReplicaSet,
                     "raytpu_serve_timeouts_total",
                     "serve requests failed on an expired deadline",
                 ).inc()
+                _mark_route(kwargs, "route.timeout",
+                            reason="stream_deadline", delivered=delivered)
                 proxy._finish(RequestTimeoutError(
                     f"stream from {rset.name!r}.{method} exceeded its "
                     f"deadline after {delivered} items"
                 ))
                 return
             if attempts >= max_attempts or not isinstance(cause, _RETRYABLE):
+                _mark_route(kwargs, "route.failed",
+                            error=type(cause).__name__, attempts=attempts)
                 proxy._finish(err)
                 return
             wait = _retry_backoff_s(attempts)
@@ -661,6 +715,8 @@ def _stream_failover_loop(proxy: _FailoverStream, rset: ReplicaSet,
                     "raytpu_serve_timeouts_total",
                     "serve requests failed on an expired deadline",
                 ).inc()
+                _mark_route(kwargs, "route.timeout",
+                            reason="no_retry_budget", delivered=delivered)
                 proxy._finish(RequestTimeoutError(
                     f"stream from {rset.name!r}.{method}: no retry budget "
                     f"left before the deadline"
@@ -670,6 +726,8 @@ def _stream_failover_loop(proxy: _FailoverStream, rset: ReplicaSet,
             try:
                 replica = rset.pick(model_id, exclude={key}, admission=False)
             except BaseException:
+                _mark_route(kwargs, "route.failed",
+                            error=type(cause).__name__, attempts=attempts)
                 proxy._finish(err)
                 return
             key = _rkey(replica)
@@ -678,14 +736,20 @@ def _stream_failover_loop(proxy: _FailoverStream, rset: ReplicaSet,
                 "raytpu_serve_failovers_total",
                 "serve requests failed over to a different replica",
             ).inc()
+            _mark_route(kwargs, "route.failover",
+                        error=type(cause).__name__, attempt=attempts)
             try:
                 stream = replica.call.options(num_returns="streaming").remote(
                     method, *args, **kwargs
                 )
             except BaseException as sub_err:  # noqa: BLE001
                 rset.release_key(key)
+                _mark_route(kwargs, "route.failed",
+                            error=type(sub_err).__name__, attempts=attempts)
                 proxy._finish(sub_err)
                 return
+            _mark_route(kwargs, "route.dispatched", replica=key[:12],
+                        attempt=attempts)
             skip = delivered
 
 
@@ -788,6 +852,8 @@ class _Reaper:
                 # never leave a dropped record wedged at the fair head
                 overflow.rset.cancel_parked(overflow)
             overflow.rset.release_key(overflow.key)
+            _mark_route(overflow.kwargs, "route.failed",
+                        reason="reaper_overflow")
             self._seal_error(overflow, RuntimeError(
                 "serve reaper overflow: request dropped to bound tracking "
                 f"(serve_reaper_max_tracked={cfg.serve_reaper_max_tracked})"
@@ -881,6 +947,8 @@ class _Reaper:
                     "raytpu_serve_timeouts_total",
                     "serve requests failed on an expired deadline",
                 ).inc()
+                _mark_route(rec.kwargs, "route.timeout",
+                            reason="parked_deadline")
                 self._seal_error(rec, RequestTimeoutError(
                     f"request to {rec.rset.name!r}.{rec.method} exceeded "
                     f"its deadline while parked for dispatch"
@@ -889,6 +957,7 @@ class _Reaper:
             if not rec.rset.try_grant(rec):
                 return False
             rec.parked = False
+            _mark_route(rec.kwargs, "route.granted")
             return self._dispatch_parked(rec)
         # deadline enforcement (promise-backed calls fail fast; plain
         # tracked refs have no promise to seal, their caller owns timeouts)
@@ -902,6 +971,8 @@ class _Reaper:
                 "raytpu_serve_timeouts_total",
                 "serve requests failed on an expired deadline",
             ).inc()
+            _mark_route(rec.kwargs, "route.timeout", reason="deadline",
+                        attempt=rec.attempts)
             self._seal_error(rec, RequestTimeoutError(
                 f"request to {rec.rset.name!r}.{rec.method} exceeded its "
                 f"deadline (attempt {rec.attempts}/{rec.max_attempts})"
@@ -946,6 +1017,9 @@ class _Reaper:
             and (rec.deadline is None or now + wait < rec.deadline)
         )
         if not can_retry:
+            _mark_route(rec.kwargs, "route.failed",
+                        error=type(unwrap_error(err)).__name__,
+                        attempts=rec.attempts)
             self._seal_error(rec, err)
             return True
         rec.next_retry_ts = now + wait
@@ -977,6 +1051,8 @@ class _Reaper:
             ):
                 rec.next_retry_ts = now + wait
                 return False
+            _mark_route(rec.kwargs, "route.failed", reason="no_replica",
+                        attempts=rec.attempts)
             self._seal_error(rec, rec.last_error or pick_err)
             return True
         rec.key = _rkey(replica)
@@ -985,6 +1061,8 @@ class _Reaper:
             rec.ref = replica.call.remote(rec.method, *rec.args, **rec.kwargs)
         except BaseException as err:  # noqa: BLE001
             return self._on_error(rec, err)
+        _mark_route(rec.kwargs, "route.dispatched", replica=rec.key[:12],
+                    attempt=rec.attempts)
         return False
 
     def _resubmit(self, rec: _TrackedCall) -> bool:
@@ -1005,6 +1083,8 @@ class _Reaper:
             ):
                 rec.next_retry_ts = now + wait
                 return False
+            _mark_route(rec.kwargs, "route.failed", reason="no_replica",
+                        attempts=rec.attempts)
             self._seal_error(rec, rec.last_error or pick_err)
             return True
         rec.key = _rkey(replica)
@@ -1013,8 +1093,11 @@ class _Reaper:
             "raytpu_serve_failovers_total",
             "serve requests failed over to a different replica",
         ).inc()
+        _mark_route(rec.kwargs, "route.failover", attempt=rec.attempts)
         try:
             rec.ref = replica.call.remote(rec.method, *rec.args, **rec.kwargs)
         except BaseException as err:  # noqa: BLE001
             return self._on_error(rec, err)
+        _mark_route(rec.kwargs, "route.dispatched", replica=rec.key[:12],
+                    attempt=rec.attempts)
         return False

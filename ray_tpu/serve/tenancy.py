@@ -89,6 +89,8 @@ _specs: Dict[str, TenantSpec] = {}  # guarded-by: _lock
 _buckets: Dict[str, _TokenBucket] = {}  # guarded-by: _lock
 _api_keys: Dict[str, str] = {}  # guarded-by: _lock
 _ttft_window: Dict[str, List[float]] = {}  # guarded-by: _lock
+_ttft_breakdown: Dict[str, List[Dict[str, float]]] = {}  # guarded-by: _lock
+_queue_wait_window: Dict[str, List[float]] = {}  # guarded-by: _lock
 _last_shed_event: Dict[str, float] = {}  # guarded-by: _lock
 
 
@@ -138,6 +140,8 @@ def reset() -> None:
         _buckets.clear()
         _api_keys.clear()
         _ttft_window.clear()
+        _ttft_breakdown.clear()
+        _queue_wait_window.clear()
         _last_shed_event.clear()
 
 
@@ -265,6 +269,36 @@ def drain_ttft_window() -> Dict[str, List[float]]:
     with _lock:
         out = _ttft_window.copy()
         _ttft_window.clear()
+    return out
+
+
+def observe_ttft_breakdown(tenant: str, buckets: Dict[str, float]) -> None:
+    """Record one request's TTFT decomposition (engine._ttft_buckets:
+    queue_wait / preempt_wait / prefill_compute, summing to TTFT) for the
+    SLO monitor to attribute burn to the dominant bucket. Same bound and
+    drain cadence as the plain TTFT window."""
+    with _lock:
+        window = _ttft_breakdown.setdefault(tenant, [])
+        if len(window) < 100_000:
+            window.append(dict(buckets))
+        qw = _queue_wait_window.setdefault(tenant, [])
+        if len(qw) < 100_000:
+            qw.append(float(buckets.get("queue_wait_s", 0.0)))
+
+
+def drain_ttft_breakdown() -> Dict[str, List[Dict[str, float]]]:
+    with _lock:
+        out = _ttft_breakdown.copy()
+        _ttft_breakdown.clear()
+    return out
+
+
+def drain_queue_wait_window() -> Dict[str, List[float]]:
+    """Per-tenant queue-wait samples (the queue_wait_s bucket of each
+    first token), drained by the SLO monitor for queue_wait_p99."""
+    with _lock:
+        out = _queue_wait_window.copy()
+        _queue_wait_window.clear()
     return out
 
 
@@ -432,6 +466,19 @@ class FairQueue:
             if item is None:
                 return out
             out.append(item)
+
+    def depths(self) -> List[Dict[str, Any]]:
+        """Per-lane queue depths for engine introspection
+        (``engine.snapshot()``): one row per occupied (priority, tenant)
+        lane, highest priority first."""
+        with self._lock:
+            rows = [
+                {"priority": key[0], "tenant": key[1], "depth": len(lane)}
+                for key, lane in self._lanes.items()
+                if lane
+            ]
+        rows.sort(key=lambda r: (-r["priority"], r["tenant"]))
+        return rows
 
     def __len__(self) -> int:
         with self._lock:

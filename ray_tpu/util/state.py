@@ -530,6 +530,99 @@ def events(limit: int = 1000, *, kind: Optional[str] = None,
     return out[-limit:] if limit else out
 
 
+def _federated_request_marks() -> List[Dict[str, Any]]:
+    """Every request-forensics mark visible from this process: the local
+    reqlog ring merged with every node's federated tail in the GCS
+    `_requests` table (core/cluster.py ships them on the same stats
+    piggyback as the flight recorder). Deduped by (node, seq), sorted by
+    wall time."""
+    from ..serve import reqlog
+
+    merged: Dict[Any, Dict[str, Any]] = {}
+    for m in reqlog.log().since(0, max_n=1_000_000):
+        merged[(m.get("node"), m.get("seq"))] = m
+    if _rt.is_initialized():
+        from ..core.gcs import REQLOG_NS
+
+        runtime = _rt.get_runtime()
+        ctx = getattr(runtime, "cluster", None)
+        try:
+            if ctx is not None:
+                for key in ctx.gcs.kv_keys(namespace=REQLOG_NS):
+                    for m in ctx.gcs.kv_get(key, namespace=REQLOG_NS) or []:
+                        merged.setdefault((m.get("node"), m.get("seq")), m)
+            else:
+                kv = runtime.gcs.kv
+                for key in kv.keys(namespace=REQLOG_NS):
+                    for m in kv.get(key, namespace=REQLOG_NS) or []:
+                        merged.setdefault((m.get("node"), m.get("seq")), m)
+        except Exception:  # noqa: BLE001 - the local ring still answers
+            pass
+    out = list(merged.values())
+    out.sort(key=lambda m: (m.get("ts", 0.0), m.get("seq", 0)))
+    return out
+
+
+def request_timeline(request_id: str) -> List[Dict[str, Any]]:
+    """Every recorded mark of ONE request, cluster-wide, in causal
+    (wall-clock) order: router marks from the caller's node interleaved
+    with engine marks from the replica's node on the shared request id.
+    Render with `serve.reqlog.render_waterfall(marks)` — the CLI command
+    `ray_tpu request <id>` is a thin wrapper."""
+    return [
+        m for m in _federated_request_marks()
+        if m.get("rid") == request_id
+    ]
+
+
+def list_requests(tenant: Optional[str] = None, slow_only: bool = False,
+                  limit: int = 200) -> List[Dict[str, Any]]:
+    """Cluster-wide request summaries (newest last): request id, tenant,
+    first/last phase, terminal outcome, TTFT and its decomposition
+    buckets. `slow_only` keeps requests whose TTFT exceeded the serve
+    objective or that timed out — the on-call's worklist."""
+    from ..core.config import cfg
+    from ..serve import reqlog
+
+    merged: Dict[str, Dict[str, Any]] = {
+        s["request_id"]: s
+        for s in reqlog.summarize_marks(_federated_request_marks())
+    }
+    # the local summary index survives mark-ring eviction: it wins over
+    # a summary rebuilt from a truncated federated tail
+    for s in reqlog.log().requests(limit=1_000_000):
+        merged[s["request_id"]] = s
+    out = list(merged.values())
+    if tenant is not None:
+        out = [s for s in out if s.get("tenant") == tenant]
+    if slow_only:
+        slo = cfg.serve_slo_ttft_p99_s
+        out = [
+            s for s in out
+            if (s.get("ttft_s") is not None and s["ttft_s"] > slo)
+            or s.get("terminal") in ("route.timeout", "engine.timeout")
+        ]
+    out.sort(key=lambda s: (s.get("last_ts", 0.0), s.get("request_id", "")))
+    return out[-limit:] if limit else out
+
+
+def engine_snapshot() -> Dict[str, Any]:
+    """Live introspection of every LLM engine in THIS process, keyed by
+    engine label: lane table (who holds each lane, position, pages,
+    in-flight blocks), page-pool occupancy, prefix-cache chain heads,
+    and per-tenant fair-queue depths. Point-in-time and lock-free on the
+    engine side — a forensics read never stalls the serving loop."""
+    from ..serve.llm import engine as llm_engine
+
+    out: Dict[str, Any] = {}
+    for label, eng in list(llm_engine._ENGINES.items()):
+        try:
+            out[label] = eng.snapshot()
+        except Exception as e:  # noqa: BLE001 - one bad engine ≠ no answer
+            out[label] = {"error": repr(e)}
+    return out
+
+
 def postmortem(output: str, note: str = "") -> Dict[str, Any]:
     """Snapshot the cluster's observability planes — events, span
     buffers, /metrics/cluster, node stats, profile metas — into one

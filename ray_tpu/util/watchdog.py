@@ -207,6 +207,23 @@ def _histogram_quantile(buckets: List[Tuple[float, int]], total: int,
     return math.inf  # landed in the +Inf overflow bucket
 
 
+def _dominant_ttft_bucket(breakdowns: List[Dict[str, float]]):
+    """(bucket, share) of the largest TTFT component across a window of
+    per-request decompositions, or None with no samples. Buckets are the
+    engine's exact-sum split: queue_wait + preempt_wait + prefill_compute
+    == TTFT, so the shares answer WHERE the window's latency went."""
+    totals = {"queue_wait": 0.0, "preempt_wait": 0.0,
+              "prefill_compute": 0.0}
+    for b in breakdowns:
+        for key in totals:
+            totals[key] += float(b.get(key + "_s", 0.0) or 0.0)
+    spent = sum(totals.values())
+    if spent <= 0:
+        return None
+    dominant = max(totals, key=totals.get)
+    return dominant, totals[dominant] / spent
+
+
 class ServeSLOMonitor:
     """p99 burn detection over the span-derived serve histograms.
 
@@ -320,6 +337,8 @@ class ServeSLOMonitor:
         except Exception:  # serve plane not imported in this process
             return {}
         samples = tenancy.drain_ttft_window()
+        breakdowns = tenancy.drain_ttft_breakdown()
+        queue_waits = tenancy.drain_queue_wait_window()
         out: Dict[str, float] = {}
         for tenant, ttfts in samples.items():
             if not ttfts:
@@ -361,12 +380,61 @@ class ServeSLOMonitor:
                     "monitor (p99 over objective).",
                     tag_keys=("slo",),
                 ).inc(tags={"slo": slo})
+                # the forensics decomposition turns "tenant X burned"
+                # into "…and it burned in the QUEUE, not the engine"
+                dom = _dominant_ttft_bucket(breakdowns.get(tenant, []))
+                dom_txt = (
+                    f"; dominant bucket: {dom[0]} ({dom[1]:.0%} of TTFT)"
+                    if dom else ""
+                )
+                extra = {"dominant_bucket": dom[0]} if dom else {}
                 emit("WARNING", "watchdog",
                      f"serve SLO burn: tenant {tenant!r} ttft p99 = "
                      f"{p99:.3f}s over objective {objective:.3f}s "
-                     f"({len(ttfts)} request(s) this window)",
+                     f"({len(ttfts)} request(s) this window){dom_txt}",
                      kind="watchdog.slo_burn",
-                     slo=slo, objective=objective, samples=len(ttfts))
+                     slo=slo, objective=objective, samples=len(ttfts),
+                     **extra)
+        out.update(self._check_tenant_queue_waits(queue_waits))
+        return out
+
+    def _check_tenant_queue_waits(
+        self, queue_waits: Dict[str, List[float]]
+    ) -> Dict[str, float]:
+        """Per-tenant queue-wait p99 ledger (``queue_wait_p99:<tenant>``
+        in attainment_report): the queue-wait share of each request's
+        TTFT as decomposed by the engine, evaluated against the global
+        queue objective. Burn here with TTFT attained means admission
+        latency is being earned back by prefill headroom — a capacity
+        signal, not a latency incident, so no burn counter/event."""
+        from ..core.config import cfg
+
+        objective = float(cfg.serve_slo_queue_p99_s)
+        out: Dict[str, float] = {}
+        for tenant, waits in queue_waits.items():
+            if not waits:
+                continue
+            ordered = sorted(waits)
+            p99 = ordered[min(len(ordered) - 1, int(0.99 * len(ordered)))]
+            slo = f"queue_wait_p99:{tenant}"
+            out[slo] = p99
+            violated = objective > 0 and p99 > objective
+            with self._lock:
+                led = self._attainment.setdefault(slo, {
+                    "windows": 0, "violated": 0, "requests": 0,
+                    "objective_s": objective, "last_p99_s": 0.0,
+                })
+                led["windows"] += 1
+                led["requests"] += len(waits)
+                led["violated"] += 1 if violated else 0
+                led["objective_s"] = objective
+                led["last_p99_s"] = p99
+            get_or_create_gauge(
+                "raytpu_serve_tenant_queue_wait_p99_seconds",
+                "Window queue-wait p99 per tenant (the queue_wait bucket "
+                "of the engine's TTFT decomposition).",
+                tag_keys=("tenant",),
+            ).set(p99, tags={"tenant": tenant})
         return out
 
     def attainment_report(self) -> Dict[str, Dict[str, Any]]:

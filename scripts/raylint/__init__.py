@@ -13,7 +13,9 @@ comments, committed baseline, text/JSON reporters) carrying:
 - jax-hot-path: no host syncs or recompilation traps in functions
   reachable from jit/shard_map step definitions;
 - event-kinds: every events.emit call site passes a kind registered in
-  the flight-recorder event schema (util/events.py EVENT_KINDS).
+  the flight-recorder event schema (util/events.py EVENT_KINDS);
+- request-phase: every reqlog.mark call site passes a phase registered
+  in the request-forensics schema (serve/reqlog.py PHASES).
 
 Run ``python -m scripts.raylint`` from the repo root; see README
 "Static analysis".
@@ -35,5 +37,6 @@ from . import rules_legacy  # noqa: F401,E402
 from . import rules_locks  # noqa: F401,E402
 from . import rules_jax  # noqa: F401,E402
 from . import rules_events  # noqa: F401,E402
+from . import rules_requests  # noqa: F401,E402
 
 DEFAULT_BASELINE = "scripts/raylint/baseline.json"

@@ -1,0 +1,145 @@
+"""request-phase: every request-forensics mark names a registered phase.
+
+The request forensics plane (ray_tpu/serve/reqlog.py) is TYPED the same
+way the flight recorder is: consumers — the waterfall renderer, the
+TTFT decomposition, ``state.list_requests`` terminal detection — key
+off the ``phase`` field, so a mark with a typo'd phase silently drops
+out of every downstream view (worse: a misspelled terminal phase leaves
+the request forever-pending). This rule holds every ``reqlog.mark(...)``
+/ ``mark(...)`` / ``log().mark(...)`` call site under ``ray_tpu/`` to
+the registry:
+
+- the phase argument (2nd positional, or ``phase=``) must be a string
+  literal — dynamic phases defeat static checking;
+- the literal must be registered: a key of the ``PHASES`` dict literal
+  in serve/reqlog.py, or the first argument of any
+  ``register_phase("...")`` call in the tree.
+
+``ray_tpu/serve/reqlog.py`` itself is exempt (it defines the plumbing
+that forwards ``phase`` through).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Set
+
+from .engine import Finding, Project, Rule, SourceFile, register
+
+REQLOG_MODULE_REL = "ray_tpu/serve/reqlog.py"
+
+
+def registered_phases(project: Project) -> Set[str]:
+    """The static phase registry: PHASES literal keys plus every
+    register_phase("...") string-literal call in the tree."""
+    phases: Set[str] = set()
+    reqlog_sf = project.file(REQLOG_MODULE_REL)
+    if reqlog_sf is not None:
+        for node in ast.walk(reqlog_sf.tree):
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, ast.AnnAssign):  # PHASES: Dict[...] = {}
+                targets = [node.target]
+            else:
+                continue
+            if (any(isinstance(t, ast.Name) and t.id == "PHASES"
+                    for t in targets)
+                    and isinstance(node.value, ast.Dict)):
+                for key in node.value.keys:
+                    if isinstance(key, ast.Constant) and isinstance(key.value, str):
+                        phases.add(key.value)
+    for sf in project.files:
+        for node in ast.walk(sf.tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id == "register_phase"
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                phases.add(node.args[0].value)
+    return phases
+
+
+def _mark_aliases(tree: ast.AST) -> Set[str]:
+    """Local names bound to reqlog's mark via `from ... import`."""
+    aliases: Set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ImportFrom):
+            continue
+        module = node.module or ""
+        if not (module == "reqlog" or module.endswith(".reqlog")
+                or module == "serve.reqlog"):
+            continue
+        for alias in node.names:
+            if alias.name == "mark":
+                aliases.add(alias.asname or alias.name)
+    return aliases
+
+
+def _is_reqlog_receiver(func: ast.AST) -> bool:
+    """True for `reqlog.mark` / `<x>.reqlog.mark` / `log().mark`
+    receivers (the module alias and the singleton factory)."""
+    if isinstance(func, ast.Name) and func.id == "reqlog":
+        return True
+    if isinstance(func, ast.Attribute) and func.attr == "reqlog":
+        return True
+    # log().mark / reqlog.log().mark — the RequestLog singleton
+    return (isinstance(func, ast.Call)
+            and ((isinstance(func.func, ast.Name)
+                  and func.func.id == "log")
+                 or (isinstance(func.func, ast.Attribute)
+                     and func.func.attr == "log")))
+
+
+def mark_call_findings(sf: SourceFile, phases: Set[str],
+                       rule_name: str = "request-phase") -> List[Finding]:
+    aliases = _mark_aliases(sf.tree)
+    out: List[Finding] = []
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        is_mark = (isinstance(func, ast.Name) and func.id in aliases) or (
+            isinstance(func, ast.Attribute) and func.attr == "mark"
+            and _is_reqlog_receiver(func.value)
+        )
+        if not is_mark:
+            continue
+        msg = _check_phase_arg(node, phases)
+        if msg is not None:
+            out.append(Finding(rule_name, sf.rel, node.lineno, msg))
+    return out
+
+
+def _check_phase_arg(call: ast.Call, phases: Set[str]) -> Optional[str]:
+    phase_kw = next((kw for kw in call.keywords if kw.arg == "phase"), None)
+    if phase_kw is None:
+        # positional phase: mark(request_id, phase, ...)
+        if len(call.args) >= 2:
+            phase_kw = ast.keyword(arg="phase", value=call.args[1])
+        else:
+            return ("reqlog.mark without a phase: pass a registered "
+                    "request phase (see PHASES in serve/reqlog.py)")
+    if not (isinstance(phase_kw.value, ast.Constant)
+            and isinstance(phase_kw.value.value, str)):
+        return ("reqlog.mark phase must be a string literal so the "
+                "registry check stays static")
+    phase = phase_kw.value.value
+    if phase not in phases:
+        return (f"reqlog.mark phase={phase!r} is not registered in "
+                f"PHASES (serve/reqlog.py) or via register_phase")
+    return None
+
+
+@register
+class RequestPhaseRule(Rule):
+    name = "request-phase"
+    doc = ("every reqlog.mark call site in ray_tpu/ passes a phase "
+           "string literal registered in the request-phase schema")
+
+    def check(self, project: Project) -> Iterable[Finding]:
+        phases = registered_phases(project)
+        for sf in project.files_under("ray_tpu/"):
+            if sf.rel == REQLOG_MODULE_REL:
+                continue  # the plumbing that forwards phase through
+            yield from mark_call_findings(sf, phases, self.name)

@@ -27,6 +27,7 @@ ALL_RULES = {
     "typed-errors", "metrics-names", "atomic-writes", "lazy-jax",
     "kernel-fallbacks", "lock-discipline", "lock-order",
     "blocking-under-lock", "jax-hot-path", "event-kinds",
+    "request-phase",
 }
 
 
