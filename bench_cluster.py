@@ -86,8 +86,249 @@ def _ordered(evs, victim_hex):
     return announced <= replace <= dead
 
 
+def run_head_outage(args) -> None:
+    """Head fault-tolerance drill: chaos SIGKILLs the HEAD out of its own
+    snapshot loop while (a) a KV writer keeps committing state, (b) task
+    traffic keeps dispatching to a worker agent, and (c) a stateful
+    "trainer" actor keeps stepping on that agent. The head restarts with
+    --restore on the same port; the drill passes when every ACKNOWLEDGED
+    write is still readable, no client surfaced an untyped error, a
+    pre-restart writer is epoch-fenced, and the agent (and the actor in
+    it) rode through without a process restart. Reports
+    recovery-time-to-ready: head death -> first acknowledged write
+    against the restored head."""
+    import signal
+    import socket
+    import subprocess
+
+    from ray_tpu.core.exceptions import RayTpuError, StaleEpochError
+    from ray_tpu.core.gcs_service import GcsClient
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    address = f"127.0.0.1:{port}"
+    workdir = tempfile.mkdtemp(prefix="bench_head_outage_")
+    snap = os.path.join(workdir, "gcs.snap")
+    base_env = {**os.environ, "JAX_PLATFORMS": "cpu",
+                "RAY_TPU_NODE_HEARTBEAT_S": "0.2",
+                "RAY_TPU_NODE_STALE_S": "2.5",
+                "RAY_TPU_GCS_SNAPSHOT_INTERVAL_S": "0.5"}
+    base_env.pop("RAY_TPU_CHAOS", None)
+    chaos_env = {**base_env, "RAY_TPU_CHAOS":
+                 f"kill_head=1,delay_s={args.outage_delay_s},"
+                 "max_injections=1"}
+
+    def spawn(cmd, log_path, env, mode="w"):
+        return subprocess.Popen(cmd, env=env, stdout=open(log_path, mode),
+                                stderr=subprocess.STDOUT, text=True)
+
+    def wait_line(log_path, needle, timeout=90, proc=None):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if proc is not None and proc.poll() is not None:
+                break
+            with open(log_path) as f:
+                if needle in f.read():
+                    return
+            time.sleep(0.2)
+        with open(log_path) as f:
+            raise AssertionError(f"never saw {needle!r} in:\n{f.read()}")
+
+    head_cmd = [sys.executable, "-m", "ray_tpu", "--no-tpu", "start",
+                "--head", "--port", str(port), "--num-cpus", "1",
+                "--snapshot-path", snap]
+    head = spawn(head_cmd, os.path.join(workdir, "head.log"), chaos_env)
+    agent = None
+    rc = 1
+    acked: list = []
+    writer_errors: list = []
+    traffic_ok = [0]
+    traffic_ok_during_outage = [0]
+    traffic_typed: list = []
+    traffic_untyped: list = []
+    stop = threading.Event()
+    outage = threading.Event()
+
+    import ray_tpu
+
+    try:
+        wait_line(os.path.join(workdir, "head.log"), "head up", proc=head)
+        agent = spawn(
+            [sys.executable, "-m", "ray_tpu", "--no-tpu", "start",
+             "--address", address, "--num-cpus", "2",
+             "--resources", '{"drill": 2}'],
+            os.path.join(workdir, "agent.log"), base_env)
+        wait_line(os.path.join(workdir, "agent.log"), "joined", proc=agent)
+
+        ray_tpu.init(address=address, num_cpus=0, detect_accelerators=False)
+        deadline = time.monotonic() + 60
+        while ray_tpu.cluster_resources().get("drill", 0) < 2:
+            assert time.monotonic() < deadline, (
+                f"agent resources never appeared: "
+                f"{ray_tpu.cluster_resources()}")
+            time.sleep(0.2)
+
+        @ray_tpu.remote(num_cpus=0, resources={"drill": 1})
+        def echo(x):
+            return f"ok-{x}"
+
+        @ray_tpu.remote(num_cpus=0, resources={"drill": 1})
+        class Trainer:
+            def __init__(self):
+                self.step_count = 0
+
+            def step(self):
+                import os as _os
+                self.step_count += 1
+                return {"step": self.step_count, "pid": _os.getpid()}
+
+        trainer = Trainer.remote()
+        pre = ray_tpu.get(trainer.step.remote(), timeout=60)
+        assert ray_tpu.get(echo.remote(0), timeout=60) == "ok-0"
+
+        def writer():
+            # the retry window spans kill + restore: every put either
+            # acks or retries invisibly; ANY surfaced error fails the
+            # drill (acked writes are the durability ledger)
+            c = GcsClient(address, retry_window_s=90.0)
+            c.adopt_epoch()
+            i = 0
+            while not stop.is_set():
+                try:
+                    if c.kv_put(f"w{i}", {"i": i}, namespace="bench"):
+                        acked.append(i)
+                except Exception as exc:  # noqa: BLE001 - the verdict
+                    writer_errors.append(exc)
+                i += 1
+                time.sleep(0.05)
+
+        def traffic():
+            # data-plane traffic: dispatch goes DIRECT to the node agent,
+            # so requests should keep succeeding while the head is down;
+            # any failure must at least be TYPED
+            i = 1
+            while not stop.is_set():
+                try:
+                    assert ray_tpu.get(echo.remote(i), timeout=20) == f"ok-{i}"
+                    traffic_ok[0] += 1
+                    if outage.is_set():
+                        traffic_ok_during_outage[0] += 1
+                except RayTpuError as exc:
+                    traffic_typed.append(exc)
+                except Exception as exc:  # noqa: BLE001 - the verdict
+                    traffic_untyped.append(exc)
+                i += 1
+                time.sleep(0.05)
+
+        threads = [threading.Thread(target=writer, daemon=True),
+                   threading.Thread(target=traffic, daemon=True)]
+        for t in threads:
+            t.start()
+
+        zombie = GcsClient(address, retry_window_s=45.0)
+        epoch_before = zombie.adopt_epoch()
+        zombie.pin_epoch(epoch_before)
+
+        # chaos fires outage_delay_s after the head armed it at init
+        head.wait(timeout=120)
+        assert head.returncode == 137, \
+            f"head should die by chaos, got rc={head.returncode}"
+        t_dead = time.monotonic()
+        outage.set()
+        acked_at_death = len(acked)
+        assert agent.poll() is None, "agent must survive the head kill"
+
+        head = spawn(head_cmd + ["--restore"],
+                     os.path.join(workdir, "head2.log"), base_env)
+        wait_line(os.path.join(workdir, "head2.log"), "head up", proc=head)
+
+        probe = GcsClient(address, retry_window_s=45.0)
+        ready_deadline = time.monotonic() + 60
+        while probe.kv_get("w0", namespace="bench") is None:
+            assert time.monotonic() < ready_deadline, "restore never ready"
+            time.sleep(0.05)
+        recovery_ready_s = time.monotonic() - t_dead
+        outage.clear()
+
+        # let post-recovery traffic accumulate, then settle the ledger
+        deadline = time.monotonic() + 30
+        while len(acked) <= acked_at_death + 10 and \
+                time.monotonic() < deadline:
+            time.sleep(0.1)
+        time.sleep(1.0)
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+
+        missing = [i for i in acked
+                   if probe.kv_get(f"w{i}", namespace="bench") is None]
+        fenced = False
+        try:
+            zombie.kv_put("zombie", 1, namespace="bench")
+        except StaleEpochError:
+            fenced = True
+        epoch_after = probe.head_info()["epoch"]
+
+        post = ray_tpu.get(trainer.step.remote(), timeout=60)
+        trainer_rode_through = (post["pid"] == pre["pid"]
+                                and post["step"] > pre["step"])
+
+        ok = (
+            not missing
+            and not writer_errors
+            and not traffic_untyped
+            and len(acked) > acked_at_death + 10
+            and fenced and epoch_after > epoch_before
+            and trainer_rode_through
+            and agent.poll() is None
+        )
+        rc = 0 if ok else 1
+        _emit_result({
+            "metric": "head_outage_recovery_ready_s",
+            "value": round(recovery_ready_s, 3),
+            "unit": "seconds",
+            "vs_baseline": 0.0,
+            "passed": ok,
+            "drill": "head_outage",
+            "acked_writes": len(acked),
+            "acked_writes_at_death": acked_at_death,
+            "acked_writes_lost": len(missing),
+            "writer_errors": len(writer_errors),
+            "traffic_ok": traffic_ok[0],
+            "traffic_ok_during_outage": traffic_ok_during_outage[0],
+            "traffic_typed_errors": len(traffic_typed),
+            "traffic_untyped_errors": len(traffic_untyped),
+            "stale_writer_fenced": fenced,
+            "epoch_before": epoch_before,
+            "epoch_after": epoch_after,
+            "trainer_rode_through": trainer_rode_through,
+            "trainer_steps": post["step"],
+            "agent_survived": agent.poll() is None,
+            "wal": probe.head_info().get("wal"),
+        }, rc)
+    finally:
+        stop.set()
+        try:
+            ray_tpu.shutdown()
+        except Exception:  # noqa: BLE001 - teardown best-effort
+            pass
+        for proc in (head, agent):
+            if proc is not None and proc.poll() is None:
+                proc.send_signal(signal.SIGTERM)
+                try:
+                    proc.wait(timeout=15)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+    sys.exit(rc)
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
+    ap.add_argument("--drill", choices=("spot_fleet", "head_outage"),
+                    default="spot_fleet",
+                    help="spot_fleet: autoscaled preemption episode; "
+                    "head_outage: chaos head SIGKILL + WAL restore")
     ap.add_argument("--steps", type=int, default=60,
                     help="training steps per run")
     ap.add_argument("--workers", type=int, default=2,
@@ -96,7 +337,14 @@ def main() -> None:
                     help="serve replicas (they share one spot node)")
     ap.add_argument("--warning-s", type=float, default=2.0,
                     help="preemption warning window")
+    ap.add_argument("--outage-delay-s", type=float, default=8.0,
+                    help="head_outage: seconds after head start when "
+                    "chaos kills it")
     args = ap.parse_args()
+
+    if args.drill == "head_outage":
+        run_head_outage(args)
+        return
 
     import ray_tpu
     from ray_tpu import serve

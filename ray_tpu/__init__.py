@@ -43,6 +43,7 @@ from .core.exceptions import (  # noqa: F401
     BackPressureError,
     DeploymentUnavailableError,
     GetTimeoutError,
+    HeadUnavailableError,
     ObjectLostError,
     ObjectStoreFullError,
     OutOfResourcesError,
@@ -52,6 +53,7 @@ from .core.exceptions import (  # noqa: F401
     ReplicaDrainingError,
     RequestTimeoutError,
     RuntimeNotInitializedError,
+    StaleEpochError,
     TaskCancelledError,
     TaskError,
 )

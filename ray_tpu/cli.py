@@ -62,8 +62,12 @@ def _cmd_start(args) -> int:
         if not args.head:
             print("--restore only applies to --head", file=sys.stderr)
             return 2
-        if not path or not os.path.exists(path):
-            print(f"--restore: no snapshot at {path!r}", file=sys.stderr)
+        if not path or not (os.path.exists(path)
+                            or os.path.exists(path + ".wal")):
+            # the WAL alone is restorable: a head that died before its
+            # first snapshot still replays every acknowledged write
+            print(f"--restore: no snapshot or WAL at {path!r}",
+                  file=sys.stderr)
             return 2
     rt = ray_tpu.init(
         num_cpus=args.num_cpus,

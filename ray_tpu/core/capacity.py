@@ -552,7 +552,17 @@ class CapacityAutoscaler:
         )
 
     def _loop(self) -> None:
+        from .runtime import head_outage_s
+
         while not self._stop.wait(self.poll_interval_s):
+            if head_outage_s() > 0.0:
+                # head outage: the demand/membership view is frozen at
+                # the moment the head went away — launching or scaling
+                # down real capacity on a blind control plane would
+                # thrash the fleet. Skip ticks until it reconnects.
+                self.stats["degraded_skips"] = (
+                    self.stats.get("degraded_skips", 0) + 1)
+                continue
             try:
                 self.step()
             except Exception as exc:  # noqa: BLE001 - the loop must survive, loudly

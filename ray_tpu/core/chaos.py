@@ -50,6 +50,12 @@ class ChaosConfig:
     name_filter: Optional[str] = None  # substring match on task name
     seed: int = 0
     kill_node: bool = False  # matching task kills THIS process (node death)
+    # kill_head=1 SIGKILLs the HEAD process (os._exit) from its own
+    # periodic loops once `delay_s` has elapsed since chaos was armed —
+    # the head fault-tolerance drill trigger. Fired via maybe_kill_head()
+    # (called from the head's snapshot/heartbeat ticks), never from
+    # maybe_inject, so worker tasks can't take the head down by accident.
+    kill_head: bool = False
     # RPC-layer injection (RpcClient.call): probabilistic transport
     # errors, added call latency, and connection drops — the knobs the
     # serve resilience drills arm (env: RAY_TPU_CHAOS="rpc_error_prob=...")
@@ -69,6 +75,7 @@ class _ChaosState:
         self.injected = 0
         self.rng = np.random.default_rng(0)
         self.lock = threading.Lock()
+        self.armed_ts = 0.0  # monotonic ts of the last set_chaos()
         # callable(node, warning_s, reason) installed by the runtime:
         # node is the scheduler's logical Node when known (task/actor
         # boundaries), None for "this whole process" (agent boundary)
@@ -90,14 +97,16 @@ def set_chaos(
     rpc_drop_prob: float = 0.0,
     preempt_node: bool = False,
     preempt_warning_s: float = 5.0,
+    kill_head: bool = False,
 ) -> None:
     with _state.lock:
         _state.config = ChaosConfig(
             failure_prob, delay_s, max_injections, name_filter, seed,
-            kill_node, rpc_error_prob, rpc_delay_s, rpc_drop_prob,
-            preempt_node, preempt_warning_s,
+            kill_node, kill_head, rpc_error_prob, rpc_delay_s,
+            rpc_drop_prob, preempt_node, preempt_warning_s,
         )
         _state.injected = 0
+        _state.armed_ts = time.monotonic()
         _state.rng = np.random.default_rng(seed)
 
 
@@ -152,7 +161,7 @@ def load_from_env() -> None:
             kwargs[k] = float(v)
         elif k in ("max_injections", "seed"):
             kwargs[k] = int(v)
-        elif k in ("kill_node", "preempt_node"):
+        elif k in ("kill_node", "preempt_node", "kill_head"):
             kwargs[k] = v.strip().lower() in ("1", "true", "yes", "on")
         elif k == "name_filter":
             kwargs[k] = v
@@ -235,6 +244,30 @@ def maybe_inject(task_name: str, node=None) -> None:
         raise ChaosInjectedError(
             f"chaos: injected failure in task {task_name!r} (#{fail_ordinal})"
         )
+
+
+def maybe_kill_head() -> None:
+    """Called from the HEAD process's periodic loops (GCS snapshot tick,
+    head heartbeat). When a `kill_head` injection is armed and `delay_s`
+    has elapsed since arming, the head dies abruptly (os._exit, no
+    cleanup, no final snapshot) — exactly the failure the WAL + restore
+    + reconciliation path must survive. Counts against max_injections
+    so a restarted head re-reading the same RAY_TPU_CHAOS env does not
+    die again unless re-armed."""
+    config = _state.config
+    if config is None or not config.kill_head:
+        return
+    with _state.lock:
+        if 0 <= config.max_injections <= _state.injected:
+            return
+        if time.monotonic() - _state.armed_ts < config.delay_s:
+            return
+        _state.injected += 1
+    from ..util.events import emit
+
+    emit("WARNING", "chaos", "chaos injected kill_head: head dies now",
+         kind="chaos.injected", mode="kill_head")
+    os._exit(137)
 
 
 def rpc_action(method: str) -> Optional[dict]:

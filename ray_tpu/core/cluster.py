@@ -574,6 +574,12 @@ class ClusterContext:
         # request-forensics cursor: last local reqlog mark seq shipped
         # into the GCS _requests table (watch-loop thread only)
         self._reqlog_cursor = 0
+        # head fault tolerance: after the head reconnects (possibly a
+        # RESTARTED process whose liveness views start empty), suppress
+        # death-by-absence declarations until this monotonic deadline —
+        # surviving peers need stale_s to repopulate the head's view
+        self._view_trust_after = 0.0
+        self.gcs.on_head_state(self._on_head_state)
 
         store.set_cluster_hooks(
             fetch_remote=self._fetch_remote,
@@ -629,6 +635,9 @@ class ClusterContext:
                     f"node speaks {PROTOCOL_VERSION}; upgrade/downgrade "
                     f"this node's ray_tpu to match the head"
                 )
+        # epoch fencing: every write from here on carries the head's
+        # current epoch, so a head restart can reject us until we re-adopt
+        self.gcs.adopt_epoch()
         self._heartbeat()
         info = {
             "node_id": self.node_id.hex(),
@@ -640,12 +649,56 @@ class ClusterContext:
             "pid": os.getpid(),
             "hostname": socket.gethostname(),
             "joined_at": time.time(),
+            "epoch": self.gcs.epoch,
         }
         with self._lock:
             self._info = info
         self.gcs.kv_put(self.node_id.hex(), info, namespace=NODE_NS)
         logger.info("node %s joined cluster at %s (gcs %s)",
                     self.node_id.hex()[:12], self.address, self.gcs_address)
+
+    def _on_head_state(self, state: str, outage_s: float) -> None:
+        """GcsClient outage-transition hook (one call per transition, from
+        whichever thread hit the failure/recovery). On reconnect the head
+        may be a RESTARTED process with restored-but-stale tables and a
+        bumped epoch: push the liveness trust window out, then re-adopt
+        and re-announce off-thread (this callback fires inside an RPC
+        call path and must not block it)."""
+        if state != "reconnected":
+            return
+        from .config import cfg
+
+        self._view_trust_after = time.monotonic() + float(cfg.node_stale_s)
+        threading.Thread(
+            target=self._after_head_reconnect, args=(outage_s,), daemon=True,
+            name="ray_tpu-head-reconnect",
+        ).start()
+
+    def _after_head_reconnect(self, outage_s: float) -> None:
+        """Re-announce to a possibly-restarted head: re-adopt its epoch
+        (a bump is how we learn a restart happened at all), re-register
+        our node entry + heartbeat, and un-gate the stats piggyback so
+        the federation cursors — which only advance after a successful
+        put, i.e. buffered for the whole outage — flush immediately."""
+        try:
+            old_epoch = self.gcs.epoch
+            new_epoch = self.gcs.adopt_epoch()
+            self._last_stats_ts = 0.0  # flush buffered federation now
+            self._register()
+            if old_epoch is not None and new_epoch != old_epoch:
+                from ..util.events import emit
+
+                emit("INFO", "cluster",
+                     f"node {self.node_id.hex()[:12]} re-registered with "
+                     f"restarted head (epoch {old_epoch} -> {new_epoch}, "
+                     f"outage {outage_s:.2f}s)",
+                     kind="node.discovered", node=self.node_id.hex(),
+                     epoch=new_epoch, outage_s=round(outage_s, 3))
+        except (RpcError, OSError) as exc:
+            # the head dropped again mid-recovery: the next reconnected
+            # transition (or the watch loop's heartbeat) retries
+            logger.warning(
+                "re-registration after head reconnect failed: %r", exc)
 
     def _heartbeat(self) -> None:
         self.gcs.report_resources(
@@ -664,26 +717,43 @@ class ClusterContext:
         period = cfg.node_stats_period_s
         if period <= 0:
             return
-        now = time.monotonic()
-        if now - self._last_stats_ts < period:
-            return
         collector = getattr(self.runtime, "node_stats", None)
         if collector is None:
             return
+        now = time.monotonic()
+        # gate check-and-set atomically: the head-reconnect thread calls
+        # this path too (forced flush), and two threads passing the gate
+        # together would double-publish the same federation batch
         with self._lock:
-            if not self._info:
+            if now - self._last_stats_ts < period or not self._info:
                 return
-        self._last_stats_ts = now
+            self._last_stats_ts = now
         snap = collector.snapshot()  # sampling /proc+jax stays unlocked
         # raylint lock-discipline: this mutation raced begin_preemption's
         # _info.update() from the signal/pubsub thread; publish a copy so
         # the GCS never sees a dict another thread is mid-mutating
         with self._lock:
             self._info["stats"] = snap
+            self._info["federation_lag"] = self._federation_lag()
             info = dict(self._info)
         self.gcs.kv_put(self.node_id.hex(), info, namespace=NODE_NS)
         self._federate_events()
         self._federate_requests()
+
+    def _federation_lag(self) -> Dict[str, int]:
+        """How many local flight-recorder events / reqlog marks have not
+        yet shipped to the head. Grows for the duration of a head outage
+        (the cursors only advance after a successful put) and drains to
+        ~0 after reconnect — `ray_tpu status` surfaces it per node as the
+        buffered-federation depth."""
+        from ..serve import reqlog
+        from ..util.events import events
+
+        lag = {"events": max(0, events().stats()["seq"] - self._events_cursor)}
+        if reqlog.enabled():
+            lag["requests"] = max(
+                0, reqlog.log().stats()["seq"] - self._reqlog_cursor)
+        return lag
 
     def _federate_events(self) -> None:
         """Ship this node's new flight-recorder events into the GCS
@@ -700,13 +770,19 @@ class ClusterContext:
             return
         my_hex = self.node_id.hex()
         tail = self.gcs.kv_get(my_hex, namespace=EVENT_NS) or []
-        tail.extend(
-            e if e.get("node") else dict(e, node=my_hex) for e in batch
-        )
-        cap = cfg.events_table_cap
-        if len(tail) > cap:
-            del tail[: len(tail) - cap]
-        self.gcs.kv_put(my_hex, tail, namespace=EVENT_NS)
+        # reconnect-flush dedup: the cursor only advances after a
+        # successful put, so a put that landed at the head but whose
+        # reply was lost to an outage gets re-shipped — drop by seq
+        shipped = {e.get("seq") for e in tail}
+        fresh = [e for e in batch if e["seq"] not in shipped]
+        if fresh:
+            tail.extend(
+                e if e.get("node") else dict(e, node=my_hex) for e in fresh
+            )
+            cap = cfg.events_table_cap
+            if len(tail) > cap:
+                del tail[: len(tail) - cap]
+            self.gcs.kv_put(my_hex, tail, namespace=EVENT_NS)
         self._events_cursor = batch[-1]["seq"]
 
     def _federate_requests(self) -> None:
@@ -726,13 +802,17 @@ class ClusterContext:
             return
         my_hex = self.node_id.hex()
         tail = self.gcs.kv_get(my_hex, namespace=REQLOG_NS) or []
-        tail.extend(
-            m if m.get("node") else dict(m, node=my_hex) for m in batch
-        )
-        cap = cfg.reqlog_table_cap
-        if len(tail) > cap:
-            del tail[: len(tail) - cap]
-        self.gcs.kv_put(my_hex, tail, namespace=REQLOG_NS)
+        # same reconnect-flush dedup as _federate_events
+        shipped = {m.get("seq") for m in tail}
+        fresh = [m for m in batch if m["seq"] not in shipped]
+        if fresh:
+            tail.extend(
+                m if m.get("node") else dict(m, node=my_hex) for m in fresh
+            )
+            cap = cfg.reqlog_table_cap
+            if len(tail) > cap:
+                del tail[: len(tail) - cap]
+            self.gcs.kv_put(my_hex, tail, namespace=REQLOG_NS)
         self._reqlog_cursor = batch[-1]["seq"]
 
     def _watch_loop(self) -> None:
@@ -796,7 +876,13 @@ class ClusterContext:
                         "rediscovered" if known is not None else "discovered",
                         node_hex[:12], info["address"])
         # deaths: a known node absent from the live view aged out of
-        # heartbeats (reference: GcsHealthCheckManager marking raylets dead)
+        # heartbeats (reference: GcsHealthCheckManager marking raylets
+        # dead). Suppressed inside the post-reconnect trust window: a
+        # restarted head's view starts EMPTY, and absence there means
+        # "hasn't re-announced yet", not "dead" — peers that really died
+        # stay absent past the window and are declared then.
+        if time.monotonic() < self._view_trust_after:
+            return
         with self._lock:
             known_nodes = list(self._remote_nodes)
         for node_hex in known_nodes:

@@ -97,6 +97,31 @@ define_flag("gcs_snapshot_path", "",
             "File path for periodic GCS table snapshots ('' = disabled).")
 define_flag("gcs_snapshot_interval_s", 5.0,
             "Seconds between GCS snapshots when snapshotting is enabled.")
+define_flag("gcs_wal", True,
+            "Journal every GCS mutation to <gcs_snapshot_path>.wal so "
+            "--restore replays acknowledged writes made after the last "
+            "snapshot (snapshots compact the journal; needs a snapshot "
+            "path).")
+define_flag("gcs_wal_fsync", False,
+            "fsync the GCS WAL after every record: survives host power "
+            "loss, not just head-process death, at a per-write cost.")
+define_flag("gcs_client_retry_s", 3.0,
+            "Bounded window a GcsClient call retries transport errors "
+            "with jittered backoff before raising the typed "
+            "HeadUnavailableError (degraded-mode entry point).")
+define_flag("gcs_client_backoff_s", 0.05,
+            "Base jittered backoff between GcsClient retries during a "
+            "head outage (doubles per attempt, capped at 1s).")
+define_flag("head_outage_grace_s", 30.0,
+            "After head.unreachable, the serve router keeps dispatching "
+            "on cached replica membership and the controller suppresses "
+            "probe-driven replica kills for this long; past it the "
+            "outage is treated as real capacity loss.")
+define_flag("head_reconcile_grace_s", 0.0,
+            "How long a restored head waits for surviving agents to "
+            "re-announce before purging never-returned nodes and "
+            "declaring their restored actors/bundles dead "
+            "(0 = 3x node_stale_s).")
 define_flag("health_check_period_s", 0.5,
             "Interval between node/actor health probes.")
 define_flag("health_check_failures", 3,

@@ -124,6 +124,53 @@ class DeploymentUnavailableError(RayTpuError):
     draining). HTTP layers map it to 503."""
 
 
+class HeadUnavailableError(RayTpuError, ConnectionError):
+    """The GCS head is unreachable and the client's bounded retry budget
+    (``gcs_client_retry_s``) is exhausted.
+
+    Subclasses ConnectionError (an OSError) so every existing
+    ``except (RpcError, OSError)`` degraded-mode catch site — heartbeat
+    loops, federation shippers, watch loops — handles it unchanged,
+    while typed callers (serve router grace window, status surfaces)
+    can distinguish "head down" from a one-off transport fault.
+    """
+
+    def __init__(self, message: str = "GCS head unreachable", *, outage_s: float = 0.0):
+        self.outage_s = outage_s
+        super().__init__(message)
+
+    def __reduce__(self):
+        args = self.args[0] if self.args else "GCS head unreachable"
+        return (_rebuild_head_unavailable, (args, self.outage_s))
+
+
+def _rebuild_head_unavailable(message, outage_s):
+    return HeadUnavailableError(message, outage_s=outage_s)
+
+
+class StaleEpochError(RayTpuError):
+    """A GCS write carried a cluster epoch older than the head's current
+    one: the writer is a zombie from before a head restart (or a
+    superseded head-hosted singleton — serve controller, capacity
+    autoscaler, SLO monitor) and must stop driving the cluster.
+
+    Deliberately NOT an OSError: transport-retry wrappers must never
+    retry a fenced write — the fix is to re-adopt the current epoch
+    (live agents) or stand down (zombies).
+    """
+
+    def __init__(self, message: str = "write fenced: stale cluster epoch",
+                 writer_epoch: Optional[int] = None,
+                 head_epoch: Optional[int] = None):
+        self.writer_epoch = writer_epoch
+        self.head_epoch = head_epoch
+        super().__init__(message)
+
+    def __reduce__(self):
+        args = self.args[0] if self.args else "write fenced: stale cluster epoch"
+        return (StaleEpochError, (args, self.writer_epoch, self.head_epoch))
+
+
 def unwrap_error(err: BaseException) -> BaseException:
     """Peel TaskError wrappers off an exception that crossed task/actor
     boundaries, returning the innermost cause — the type callers (router

@@ -5,13 +5,32 @@ gcs_server/gcs_server.h:90 — internal KV gcs_kv_manager.h, pub/sub, node
 manager gcs_node_manager.h:49, named actors in gcs_actor_manager.h:328).
 The interface is deliberately small and async-free; a gRPC-backed
 implementation for multi-host control can replace it behind the same API.
+
+Durability is two-layer (reference: RedisGcsTableStorage makes the GCS
+restartable; here a file plays Redis):
+
+- periodic atomic pickle **snapshots** of the durable tables
+  (``snapshot``/``restore``), and
+- an append-only mutation **WAL** (``GcsWal``): every durable-table
+  write is journaled per-record at mutation time, so ``--restore``
+  replays acknowledged writes made *after* the newest snapshot instead
+  of losing a snapshot-interval of state. Snapshots compact the log.
+
+Every mutation of the durable tables (``KVStore._data``,
+``GlobalControlStore._named_actors``) must route through the
+``_journal`` hook — enforced statically by the raylint
+``gcs-durable-mutations`` rule; replay/restore internals are listed in
+``WAL_EXEMPT_FUNCTIONS`` (journaling a replay would double-apply every
+record on the next restore).
 """
 
 from __future__ import annotations
 
 import fnmatch
+import hashlib
 import logging
 import os
+import struct
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
@@ -45,6 +64,176 @@ EVENT_NS = "_events"
 # one waterfall.
 REQLOG_NS = "_requests"
 
+# GCS KV namespace for head-identity state. The cluster EPOCH lives
+# here as an ordinary KV value so the standard snapshot+WAL path makes
+# it durable: a restarted head restores it, bumps it, and the bump is
+# itself journaled before any fenced write can observe it.
+HEAD_NS = "_head"
+EPOCH_KEY = "epoch"
+
+# Functions in THIS module allowed to mutate the durable tables without
+# journaling (read by the raylint gcs-durable-mutations rule): restore
+# and WAL replay re-apply already-journaled state, constructors create
+# the empty tables.
+WAL_EXEMPT_FUNCTIONS = (
+    "__init__",
+    "restore",
+    "_apply",
+    "replay_wal",
+)
+
+# ---------------------------------------------------------------------- WAL
+# Record framing (mirrors the events-segment torn-tail discipline from
+# the flight recorder, PR 4/9): fixed header + sha prefix + pickled
+# body, flushed per record so a SIGKILLed head loses nothing it
+# acknowledged. Readers stop at the first short/corrupt record and
+# quarantine the tail bytes instead of guessing.
+_REC_HDR = struct.Struct(">IQ")  # (payload_len, seq)
+_SHA_BYTES = 8
+
+
+def _scan_wal(path: str) -> Tuple[List[Tuple[int, str, tuple]], int, int]:
+    """Scan a WAL file: returns (records, good_offset, total_size) where
+    records are (seq, op, args) and good_offset is the byte length of
+    the valid prefix — anything past it is a torn tail."""
+    import cloudpickle
+
+    records: List[Tuple[int, str, tuple]] = []
+    try:
+        size = os.path.getsize(path)
+    except OSError:
+        return records, 0, 0
+    good = 0
+    with open(path, "rb") as f:
+        while True:
+            hdr = f.read(_REC_HDR.size)
+            if len(hdr) < _REC_HDR.size:
+                break
+            length, seq = _REC_HDR.unpack(hdr)
+            sha = f.read(_SHA_BYTES)
+            blob = f.read(length)
+            if len(sha) < _SHA_BYTES or len(blob) < length:
+                break  # torn tail: the head died mid-append
+            if hashlib.sha256(blob).digest()[:_SHA_BYTES] != sha:
+                break  # corrupt record: trust nothing after it
+            try:
+                op, args = cloudpickle.loads(blob)
+            except Exception:
+                break
+            records.append((seq, op, args))
+            good = f.tell()
+    return records, good, size
+
+
+class GcsWal:
+    """Append-only GCS mutation journal.
+
+    One record per acknowledged durable-table write, appended and
+    flushed BEFORE the RPC reply leaves the head, so "acknowledged"
+    implies "replayable". ``fsync=True`` additionally survives host
+    power loss (``gcs_wal_fsync``). Opening an existing journal resumes
+    its seq numbering and quarantines any torn tail (bytes past the
+    last whole record move to ``<path>.quarantine``)."""
+
+    def __init__(self, path: str, fsync: bool = False):
+        self.path = path
+        self.fsync = fsync
+        self._lock = threading.Lock()
+        self.last_seq = 0
+        self.records_appended = 0
+        self.quarantined_bytes = 0
+        parent = os.path.dirname(os.path.abspath(path))
+        os.makedirs(parent, exist_ok=True)
+        records, good, size = _scan_wal(path)
+        if records:
+            self.last_seq = records[-1][0]
+        if size > good:
+            self.quarantined_bytes = self._quarantine_tail(path, good, size)
+        self._fh = open(path, "ab")
+
+    @staticmethod
+    def _quarantine_tail(path: str, good: int, size: int) -> int:
+        """Move the torn/corrupt suffix aside (never silently discard
+        bytes — a postmortem may want them) and truncate the journal to
+        its valid prefix so appends resume on a record boundary."""
+        with open(path, "rb") as f:
+            f.seek(good)
+            tail = f.read()
+        qpath = path + ".quarantine"
+        with open(qpath, "ab") as q:
+            q.write(tail)
+        with open(path, "rb+") as f:
+            f.truncate(good)
+        logger.warning(
+            "gcs wal: quarantined %d torn-tail byte(s) from %s -> %s",
+            len(tail), path, qpath)
+        return len(tail)
+
+    @staticmethod
+    def _encode(seq: int, op: str, args: tuple) -> bytes:
+        import cloudpickle
+
+        blob = cloudpickle.dumps((op, args))
+        return (_REC_HDR.pack(len(blob), seq)
+                + hashlib.sha256(blob).digest()[:_SHA_BYTES] + blob)
+
+    def append(self, op: str, args: tuple) -> int:
+        """Journal one mutation; returns its seq. Raises if the args
+        cannot be pickled (callers decide whether that key's loss is
+        tolerable — live handles are not durable by design)."""
+        with self._lock:
+            seq = self.last_seq + 1
+            rec = self._encode(seq, op, args)
+            self._fh.write(rec)
+            self._fh.flush()
+            if self.fsync:
+                os.fsync(self._fh.fileno())
+            self.last_seq = seq
+            self.records_appended += 1
+            return seq
+
+    def compact(self, cutoff_seq: int) -> int:
+        """Drop records already covered by a snapshot (seq <= cutoff):
+        atomically rewrite the journal with only the newer records.
+        Returns the number of records retained."""
+        with self._lock:
+            # the rewrite MUST hold the append lock: a record journaled
+            # mid-compact would land in the file being replaced and be
+            # lost — blocking appends for the rewrite is the contract
+            self._fh.close()
+            records, _, _ = _scan_wal(self.path)
+            keep = [r for r in records if r[0] > cutoff_seq]
+            tmp = self.path + ".tmp"
+            with open(tmp, "wb") as f:  # raylint: disable=blocking-under-lock
+                for seq, op, args in keep:
+                    f.write(self._encode(seq, op, args))
+                f.flush()
+                if self.fsync:
+                    os.fsync(f.fileno())
+            os.replace(tmp, self.path)
+            self._fh = open(self.path, "ab")  # raylint: disable=blocking-under-lock
+            return len(keep)
+
+    def stats(self) -> Dict[str, Any]:
+        try:
+            size = os.path.getsize(self.path)
+        except OSError:
+            size = 0
+        return {
+            "path": self.path,
+            "size_bytes": size,
+            "last_seq": self.last_seq,
+            "records_appended": self.records_appended,
+            "quarantined_bytes": self.quarantined_bytes,
+        }
+
+    def close(self) -> None:
+        with self._lock:
+            try:
+                self._fh.close()
+            except OSError:
+                pass
+
 
 class KVStore:
     """Namespaced key-value store (reference: gcs_kv_manager.h)."""
@@ -52,6 +241,10 @@ class KVStore:
     def __init__(self):
         self._data: Dict[Tuple[str, str], Any] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
+        # Installed by GlobalControlStore.attach_wal; invoked UNDER
+        # _lock so journal order always equals apply order (two racing
+        # puts on one key must replay in the order they landed).
+        self._journal: Optional[Callable[[str, tuple], None]] = None
 
     def put(self, key: str, value: Any, namespace: str = "default", overwrite: bool = True) -> bool:
         with self._lock:
@@ -59,6 +252,8 @@ class KVStore:
             if not overwrite and k in self._data:
                 return False
             self._data[k] = value
+            if self._journal is not None:
+                self._journal("kv_put", (key, value, namespace))
             return True
 
     def get(self, key: str, namespace: str = "default", default: Any = None) -> Any:
@@ -67,7 +262,10 @@ class KVStore:
 
     def delete(self, key: str, namespace: str = "default") -> bool:
         with self._lock:
-            return self._data.pop((namespace, key), None) is not None
+            existed = self._data.pop((namespace, key), None) is not None
+            if existed and self._journal is not None:
+                self._journal("kv_delete", (key, namespace))
+            return existed
 
     def keys(self, pattern: str = "*", namespace: str = "default") -> List[str]:
         with self._lock:
@@ -149,6 +347,69 @@ class GlobalControlStore:
         self.pubsub = PubSub()
         self._named_actors: Dict[Tuple[str, str], Any] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
+        self._wal: Optional[GcsWal] = None
+        # one-shot warning ledgers: keys whose values could not be
+        # pickled into the snapshot / journaled into the WAL (live
+        # handles are legitimately not durable; say so ONCE per key).
+        # Lock-free on purpose: set.add is atomic, and a membership-race
+        # at worst double-warns once.
+        self._snap_warned: set = set()
+        self._wal_warned: set = set()
+        self.last_restore: Dict[str, Any] = {}
+        self.last_snapshot_ts: float = 0.0
+
+    # --------------------------------------------------------------- WAL
+    def attach_wal(self, path: str, fsync: bool = False) -> GcsWal:
+        """Start journaling every durable-table mutation to `path`.
+        Mutations made BEFORE attach are only as durable as the next
+        snapshot — attach at init, before serving."""
+        wal = GcsWal(path, fsync=fsync)
+        self._wal = wal
+        self.kv._journal = self._journal
+        return wal
+
+    def _journal(self, op: str, args: tuple) -> None:
+        """The single WAL write path (raylint gcs-durable-mutations
+        requires every durable mutation to route through here). An
+        unpicklable value is skipped with a one-shot warning per key —
+        the same contract as snapshot: live handles are not durable."""
+        wal = self._wal
+        if wal is None:
+            return
+        try:
+            wal.append(op, args)
+        except Exception as exc:
+            key = (op, args[0] if args else None)
+            if key not in self._wal_warned:
+                self._wal_warned.add(key)
+                logger.warning(
+                    "gcs wal: cannot journal %s %r (value not picklable; "
+                    "further failures for this key suppressed): %r",
+                    op, key[1], exc)
+
+    def detach_wal(self) -> None:
+        """Stop journaling and close the journal file (shutdown path)."""
+        wal, self._wal = self._wal, None
+        self.kv._journal = None
+        if wal is not None:
+            wal.close()
+
+    def wal_stats(self) -> Optional[Dict[str, Any]]:
+        return self._wal.stats() if self._wal is not None else None
+
+    # ------------------------------------------------------------- epoch
+    def current_epoch(self) -> int:
+        """The cluster epoch: bumped on every head restore so writes
+        from before the restart are fenceable (reference: the GCS
+        restart counter raylets carry on reconnect)."""
+        return int(self.kv.get(EPOCH_KEY, namespace=HEAD_NS, default=0))
+
+    def bump_epoch(self) -> int:
+        """Advance the epoch (journaled like any KV write). Called once
+        by the runtime after restore, before the RPC server opens."""
+        epoch = self.current_epoch() + 1
+        self.kv.put(EPOCH_KEY, epoch, namespace=HEAD_NS)
+        return epoch
 
     # Named actors (reference: gcs_actor_manager.h GetActorByName path).
     def register_named_actor(self, name: str, handle: Any, namespace: str = "default") -> None:
@@ -160,6 +421,9 @@ class GlobalControlStore:
             if self._named_actors.get(key) is not None:
                 raise ValueError(f"Actor name {name!r} already taken in namespace {namespace!r}")
             self._named_actors[key] = handle
+            # journal the NAME only: handles are not durable, the
+            # restored entry is a None placeholder either way
+            self._journal("actor_register", (name, namespace))
         self.pubsub.publish("actors", {"event": "registered", "name": name})
 
     def get_named_actor(self, name: str, namespace: str = "default") -> Optional[Any]:
@@ -168,7 +432,9 @@ class GlobalControlStore:
 
     def unregister_named_actor(self, name: str, namespace: str = "default") -> None:
         with self._lock:
-            self._named_actors.pop((namespace, name), None)
+            existed = self._named_actors.pop((namespace, name), None) is not None
+            if existed:
+                self._journal("actor_unregister", (name, namespace))
 
     def list_named_actors(self, namespace: str = "default") -> List[str]:
         with self._lock:
@@ -179,9 +445,10 @@ class GlobalControlStore:
     # makes the GCS restartable. Inversion: one atomic pickle snapshot of
     # the durable tables (KV + named-actor registry + whatever the
     # runtime passes in `extra`, e.g. job records), written periodically
-    # and restored at init. Live handles are NOT durable across a process
-    # restart — names are recorded so a restarted control plane knows
-    # what existed; actors themselves must be re-created.
+    # and restored at init; the WAL covers the gap since the newest
+    # snapshot. Live handles are NOT durable across a process restart —
+    # names are recorded so a restarted control plane knows what
+    # existed; actors themselves must be re-created.
 
     def snapshot(self, path: str, extra: Optional[Dict[str, Any]] = None) -> None:
         import cloudpickle
@@ -189,14 +456,25 @@ class GlobalControlStore:
         # Copy the table under the lock, serialize OUTSIDE it: kv_put
         # rides every cluster heartbeat, and pickling the whole store
         # under kv._lock stalled all of them for the snapshot duration.
+        # The WAL cutoff is read under the same lock hold: journaling
+        # happens under kv._lock too, so every kv record with
+        # seq <= wal_seq is already IN `items` — replaying seq > wal_seq
+        # over this snapshot can only re-apply, never miss.
         with self.kv._lock:
             items = list(self.kv._data.items())
+            wal_seq = self._wal.last_seq if self._wal is not None else -1
         kv_items = []
         for k, v in items:
             try:
                 blob = cloudpickle.dumps(v)
             except Exception:
-                logger.warning("gcs snapshot: skipping unpicklable key %r", k)
+                # one-shot per key: this fires every snapshot interval
+                # for the same legitimately-live handle otherwise
+                if k not in self._snap_warned:
+                    self._snap_warned.add(k)
+                    logger.warning(
+                        "gcs snapshot: skipping unpicklable key %r "
+                        "(further snapshots suppress this warning)", k)
                 continue
             kv_items.append((k, blob))
         with self._lock:
@@ -206,15 +484,22 @@ class GlobalControlStore:
             "named_actors": actor_names,
             "extra": extra or {},
             "ts": time.time(),
+            "wal_seq": wal_seq,
+            "epoch": self.current_epoch(),
         }
         os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
         tmp = path + ".tmp"
         with open(tmp, "wb") as f:
             cloudpickle.dump(payload, f)
         os.replace(tmp, path)  # atomic: a crash never leaves a torn snapshot
+        self.last_snapshot_ts = time.time()
+        if self._wal is not None:
+            # records <= wal_seq are now redundant with the snapshot
+            self._wal.compact(wal_seq)
 
-    def restore(self, path: str) -> Dict[str, Any]:
-        """Load a snapshot into this store; returns the `extra` payload.
+    def restore(self, path: str, wal_path: Optional[str] = None) -> Dict[str, Any]:
+        """Load a snapshot into this store, then replay WAL records
+        newer than the snapshot's cutoff; returns the `extra` payload.
         Restored named-actor entries map to None (the actor process is
         gone) so lookups distinguish 'never existed' from 'existed before
         the restart'."""
@@ -236,4 +521,57 @@ class GlobalControlStore:
         with self._lock:
             for key in payload["named_actors"]:
                 self._named_actors.setdefault(key, None)
+        self.last_restore = {
+            "snapshot_ts": payload.get("ts", 0.0),
+            "snapshot_wal_seq": payload.get("wal_seq", -1),
+            "wal_records_applied": 0,
+            "wal_quarantined_bytes": 0,
+        }
+        if wal_path and os.path.exists(wal_path):
+            self.replay_wal(wal_path, payload.get("wal_seq", -1))
         return payload.get("extra", {})
+
+    def replay_wal(self, wal_path: str, cutoff_seq: int) -> int:
+        """Apply journal records newer than the snapshot cutoff, in
+        order. Replay is idempotent (puts overwrite, deletes tolerate
+        absence, actor names setdefault) so records straddling the
+        cutoff are harmless. Returns the number applied."""
+        records, good, size = _scan_wal(wal_path)
+        applied = 0
+        for seq, op, args in records:
+            if seq <= cutoff_seq:
+                continue
+            self._apply(op, args)
+            applied += 1
+        self.last_restore["wal_records_applied"] = applied
+        self.last_restore["wal_quarantined_bytes"] = max(0, size - good)
+        if applied or size > good:
+            logger.info(
+                "gcs restore: replayed %d WAL record(s) past snapshot "
+                "cutoff %d (%d torn-tail byte(s) ignored)",
+                applied, cutoff_seq, max(0, size - good))
+        return applied
+
+    def _apply(self, op: str, args: tuple) -> None:
+        """Apply one journal record WITHOUT re-journaling it (raylint
+        exempt: this is the replay side of the write path)."""
+        if op == "kv_put":
+            key, value, namespace = args
+            with self.kv._lock:
+                self.kv._data[(namespace, key)] = value
+        elif op == "kv_delete":
+            key, namespace = args
+            with self.kv._lock:
+                self.kv._data.pop((namespace, key), None)
+        elif op == "actor_register":
+            name, namespace = args
+            with self._lock:
+                # placeholder, exactly like snapshot restore: the actor
+                # process behind the name did not survive the head
+                self._named_actors.setdefault((namespace, name), None)
+        elif op == "actor_unregister":
+            name, namespace = args
+            with self._lock:
+                self._named_actors.pop((namespace, name), None)
+        else:
+            logger.warning("gcs wal: unknown op %r ignored", op)

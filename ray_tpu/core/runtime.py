@@ -222,6 +222,60 @@ class Runtime:
         from ..util import profiling as _profiling
 
         self.profiles = _profiling.ProfileStore()
+        # GCS durability: restore (newest snapshot + WAL replay) BEFORE
+        # the head serves its GCS over RPC, so joining agents only ever
+        # observe the fully recovered tables and the post-restart epoch —
+        # never a half-restored store.
+        self._snapshot_stop = threading.Event()
+        self._snapshot_path = cfg.gcs_snapshot_path or None
+        self._wal_path = (
+            self._snapshot_path + ".wal"
+            if self._snapshot_path and cfg.gcs_wal else None
+        )
+        self._gcs_restored = False
+        self._restored_nodes: set = set()
+        self._reconcile_state: Dict[str, Any] = {}
+        if self._snapshot_path:
+            import os as _os
+
+            if _os.path.exists(self._snapshot_path):
+                self._restore_gcs(self._snapshot_path, self._wal_path)
+            elif self._wal_path and _os.path.exists(self._wal_path):
+                # died before the first snapshot ever committed: the
+                # journal alone holds everything that was acknowledged
+                try:
+                    self.gcs.replay_wal(self._wal_path, -1)
+                    self._gcs_restored = True
+                except Exception:  # noqa: BLE001 - a bad WAL must not brick init
+                    import logging
+
+                    logging.getLogger(__name__).exception(
+                        "gcs WAL %s is unreadable; starting fresh",
+                        self._wal_path,
+                    )
+            if self._wal_path:
+                self.gcs.attach_wal(self._wal_path, fsync=cfg.gcs_wal_fsync)
+            if self._gcs_restored:
+                from .cluster import NODE_NS as _node_ns
+                from ..util.events import emit as _emit
+
+                # fence every pre-crash writer: the bump is journaled (and
+                # snapshotted) so it survives the NEXT crash too. Capture
+                # the restored node table first — reconciliation compares
+                # it against who actually re-announces.
+                self._restored_nodes = set(
+                    self.gcs.kv.keys(namespace=_node_ns))
+                new_epoch = self.gcs.bump_epoch()
+                _emit("INFO", "gcs",
+                      f"cluster epoch bumped to {new_epoch} after restore",
+                      kind="gcs.restored", phase="epoch_bump",
+                      epoch=new_epoch,
+                      restored_nodes=len(self._restored_nodes))
+            interval = cfg.gcs_snapshot_interval_s
+            threading.Thread(
+                target=self._snapshot_loop, args=(interval,), daemon=True,
+                name="gcs-snapshot",
+            ).start()
         # multi-process cluster membership (core/cluster.py): the head
         # serves its GCS over RPC; workers join an existing head. Either
         # way this process gains a node server + remote dispatch.
@@ -241,17 +295,15 @@ class Runtime:
 
         self._preempt_timers: List[threading.Timer] = []
         _chaos.set_preemption_hook(self._chaos_preempt)
-        self._snapshot_stop = threading.Event()
-        self._snapshot_path = cfg.gcs_snapshot_path or None
-        if self._snapshot_path:
-            import os as _os
-
-            if _os.path.exists(self._snapshot_path):
-                self._restore_gcs(self._snapshot_path)
-            interval = cfg.gcs_snapshot_interval_s
+        # epoch-fenced reconciliation: restored tables name nodes, actors
+        # and placement groups that may not have survived the outage.
+        # Give the survivors one grace window to re-announce themselves
+        # against the new epoch, then declare whatever never returned
+        # dead — through the SAME failure paths ordinary deaths use.
+        if self._gcs_restored and head and self.cluster is not None:
             threading.Thread(
-                target=self._snapshot_loop, args=(interval,), daemon=True,
-                name="gcs-snapshot",
+                target=self._reconcile_after_restore, daemon=True,
+                name="gcs-reconcile",
             ).start()
 
     # ------------------------------------------------------------ persistence
@@ -273,12 +325,12 @@ class Runtime:
                 ]
         self.gcs.snapshot(self._snapshot_path, extra=extra)
 
-    def _restore_gcs(self, path: str) -> None:
+    def _restore_gcs(self, path: str, wal_path: Optional[str] = None) -> None:
         from .. import jobs as jobs_mod
         from ..jobs import JobStatus, default_job_manager
 
         try:
-            extra = self.gcs.restore(path)
+            extra = self.gcs.restore(path, wal_path=wal_path)
         except Exception:  # noqa: BLE001 - a bad snapshot must not brick init
             import logging
 
@@ -286,10 +338,13 @@ class Runtime:
                 "gcs snapshot %s is unreadable; starting fresh", path
             )
             return
+        self._gcs_restored = True
         from ..util.events import emit
 
         emit("INFO", "gcs", f"restored GCS snapshot from {path}",
-             kind="gcs.restored")
+             kind="gcs.restored",
+             wal_records_applied=self.gcs.last_restore.get(
+                 "wal_records_applied", 0))
         for info in extra.get("jobs", ()):  # job records survive restarts
             if info.status in (JobStatus.PENDING, JobStatus.RUNNING):
                 # the driver process died with the old control plane
@@ -299,13 +354,126 @@ class Runtime:
                 mgr._jobs.setdefault(info.job_id, info)
 
     def _snapshot_loop(self, interval: float) -> None:
+        from . import chaos as _chaos
+
         while not self._snapshot_stop.wait(interval):
+            if getattr(self.cluster, "is_head", False):
+                # head chaos drill trigger: a `kill_head` injection dies
+                # HERE — between persistence ticks, so the WAL (not the
+                # snapshot) is what carries the most recent writes
+                _chaos.maybe_kill_head()
             try:
                 self._snapshot_gcs()
             except Exception:  # noqa: BLE001 - persistence must not kill the runtime
                 import logging
 
                 logging.getLogger(__name__).exception("gcs snapshot failed")
+
+    def _reconcile_after_restore(self) -> None:
+        """Head-only post-restore convergence. Restored tables are a
+        snapshot of the PAST: some of the nodes, actors and placement
+        groups they name died during the head outage. Wait one grace
+        window for survivors to re-announce (registration + heartbeats
+        repopulate the live view), then purge whatever never returned —
+        feeding the purges into the same node-death paths an ordinary
+        heartbeat timeout uses, so surviving processes are never
+        restarted and genuinely-dead state is reclaimed exactly once."""
+        from .config import cfg
+        from .cluster import ACTOR_NS, NODE_NS
+        from .gcs_service import PG_NS
+        from ..util.events import emit
+
+        grace = float(cfg.head_reconcile_grace_s) or 3.0 * float(
+            cfg.node_stale_s)
+        self._reconcile_state = {
+            "phase": "waiting", "grace_s": grace,
+            "restored_nodes": len(self._restored_nodes),
+        }
+        if self._snapshot_stop.wait(grace):
+            return  # runtime shut down before the grace window closed
+        my_hex = self.scheduler.head_node().node_id.hex()
+        syncer = getattr(getattr(self.cluster, "gcs_server", None),
+                         "syncer", None)
+        live = set()
+        if syncer is not None:
+            try:
+                live = set(syncer.cluster_view().get("nodes", {}))
+            except Exception:  # noqa: BLE001 - view read must not abort reconcile
+                pass
+        purged = []
+        for node_hex in sorted(self._restored_nodes):
+            if node_hex == my_hex or node_hex in live:
+                continue
+            try:
+                self.gcs.kv.delete(node_hex, namespace=NODE_NS)
+            except Exception:  # noqa: BLE001
+                pass
+            purged.append(node_hex)
+            emit("WARNING", "cluster",
+                 f"node {node_hex[:12]} never re-announced within "
+                 f"{grace:.0f}s of head restart; purged",
+                 kind="node.purged", node=node_hex, grace_s=grace)
+        purged_set = set(purged)
+        actors_purged = 0
+        pgs_failed = 0
+        if purged_set:
+            # named-actor directory entries hosted on purged nodes: the
+            # process died with its node — release the name so recreate
+            # paths (get_if_exists / options(name=...)) can reclaim it
+            for key in list(self.gcs.kv.keys(namespace=ACTOR_NS)):
+                rec = self.gcs.kv.get(key, namespace=ACTOR_NS) or {}
+                if rec.get("node_hex") not in purged_set:
+                    continue
+                try:
+                    self.gcs.kv.delete(key, namespace=ACTOR_NS)
+                except Exception:  # noqa: BLE001
+                    pass
+                ns, _, name = key.partition("/")
+                if name:
+                    self.gcs.unregister_named_actor(name, ns)
+                actors_purged += 1
+            # placement groups OWNED by a purged node: the owner's FSM
+            # died with it, so nobody will ever drive these records again
+            # — mark them failed so dependents stop waiting
+            for key in list(self.gcs.kv.keys(namespace=PG_NS)):
+                rec = self.gcs.kv.get(key, namespace=PG_NS) or {}
+                if rec.get("owner") not in purged_set:
+                    continue
+                if rec.get("state") in ("FAILED", "REMOVED"):
+                    continue
+                rec = dict(rec)
+                rec["state"] = "FAILED"
+                rec["failure_reason"] = (
+                    "owner node lost during head outage")
+                try:
+                    self.gcs.kv.put(key, rec, namespace=PG_NS)
+                except Exception:  # noqa: BLE001
+                    pass
+                pgs_failed += 1
+        self._reconcile_state = {
+            "phase": "done", "grace_s": grace,
+            "restored_nodes": len(self._restored_nodes),
+            "survivors": len(self._restored_nodes) - len(purged)
+            - (1 if my_hex in self._restored_nodes else 0),
+            "nodes_purged": len(purged),
+            "actors_purged": actors_purged,
+            "pgs_failed": pgs_failed,
+            "completed_ts": time.time(),
+        }
+        emit("INFO", "gcs",
+             f"head reconciliation complete: {len(purged)} node(s) purged, "
+             f"{actors_purged} actor record(s) released, "
+             f"{pgs_failed} placement group(s) failed",
+             kind="head.reconciled", **{
+                 k: v for k, v in self._reconcile_state.items()
+                 if k != "phase"
+             })
+        try:
+            # persist the converged tables immediately: a crash right
+            # after reconciliation must not resurrect the purged state
+            self._snapshot_gcs()
+        except Exception:  # noqa: BLE001
+            pass
 
     # ------------------------------------------------------------------ store
 
@@ -1055,6 +1223,10 @@ class Runtime:
                 self._snapshot_gcs()  # final snapshot: durable state survives
             except Exception:
                 pass
+        try:
+            self.gcs.detach_wal()  # flush + close the journal cleanly
+        except Exception:
+            pass
         with self._lock:
             actors = list(self._actors.values())
         for rt in actors:
@@ -1175,6 +1347,20 @@ def get_or_init_runtime() -> Runtime:
 
 def is_initialized() -> bool:
     return _global_runtime is not None
+
+
+def head_outage_s() -> float:
+    """Seconds the GCS head has currently been unreachable from this
+    process (0.0 = reachable, no cluster, or no runtime). Control loops
+    (serve controller/router, capacity autoscaler, SLO monitor) key
+    their degraded-mode behavior off this probe."""
+    cluster = getattr(_global_runtime, "cluster", None)
+    if cluster is None:
+        return 0.0
+    try:
+        return cluster.gcs.outage_s()
+    except Exception:  # noqa: BLE001 - a liveness probe must never throw
+        return 0.0
 
 
 def shutdown_runtime() -> None:

@@ -237,6 +237,10 @@ class _Handler(BaseHTTPRequestHandler):
             # capacity-plane status: managed nodes by type/class, pending
             # demand by origin, scale/replace/blocked counters
             return state.autoscaler_summary() or {}
+        if name == "head":
+            # head fault-tolerance health: epoch, WAL lag/size, snapshot
+            # age, restore/reconcile provenance, buffered federation
+            return state.head_summary() or {}
         if name == "status":
             return {"report": state.status_report()}
         if name == "actors":

@@ -20,7 +20,7 @@ from .. import api
 from ..core.actors import ActorState
 from ..core.exceptions import ReplicaDrainingError, RequestTimeoutError
 from .deployment import Application, Deployment
-from .router import _counter, _rkey, DeploymentHandle, ReplicaSet
+from .router import _counter, _head_outage_s, _rkey, DeploymentHandle, ReplicaSet
 
 logger = logging.getLogger(__name__)
 
@@ -320,7 +320,43 @@ class ServeController:
             self._thread.start()
 
     def _loop(self) -> None:
+        frozen_since = 0.0
         while not self._stop.wait(self._interval):
+            outage = _head_outage_s()
+            if outage > 0.0:
+                # Head outage: replica CALLS still flow (direct to node
+                # agents), but scaling decisions need the head (named-
+                # actor registration, placement). Freeze reconciliation
+                # for the grace window instead of churning replicas on a
+                # blind control plane; past the window, resume and let
+                # typed HeadUnavailableError surface per decision.
+                from ..core.config import cfg as _cfg
+
+                if outage <= float(_cfg.head_outage_grace_s):
+                    if not frozen_since:
+                        frozen_since = time.monotonic()
+                        from ..util.events import emit
+
+                        emit("WARNING", "serve",
+                             "serve controller frozen: head unreachable; "
+                             "serving on cached replica membership",
+                             kind="serve.degraded", outage_s=round(outage, 2))
+                    continue
+            if frozen_since:
+                # probes issued before the freeze are all overdue by now;
+                # clearing probe state prevents a mass prune on unfreeze
+                with self._lock:
+                    states = list(self._states.values())
+                for state in states:
+                    state.probe_refs.clear()
+                    state.last_probe.clear()
+                from ..util.events import emit
+
+                emit("INFO", "serve",
+                     "serve controller resumed after "
+                     f"{time.monotonic() - frozen_since:.1f}s frozen",
+                     kind="serve.degraded", resumed=True)
+                frozen_since = 0.0
             with self._lock:
                 states = list(self._states.values())
                 condemned = list(self._condemned)

@@ -75,6 +75,17 @@ def _retryable(err: BaseException) -> bool:
     return isinstance(unwrap_error(err), _RETRYABLE)
 
 
+def _head_outage_s() -> float:
+    """Seconds the GCS head has currently been unreachable from this
+    process (0.0 = reachable, or no cluster). The serve data plane keys
+    degraded-mode behavior off this: replica calls go DIRECT to node
+    agents, so dispatch works fine without the head — only membership
+    updates stall."""
+    from ..core.runtime import head_outage_s
+
+    return head_outage_s()
+
+
 # live deployments' replica sets, for the ongoing-requests gauge (weak:
 # a deleted deployment's series disappears instead of pinning the set)
 import weakref  # noqa: E402 - scoped to the telemetry plumbing below
@@ -163,7 +174,23 @@ class ReplicaSet:
                 self.max_queued = int(max_queued)
 
     def set_replicas(self, replicas: List[Any]) -> None:
+        from ..core.config import cfg
+
         with self._lock:
+            if not replicas and self._replicas:
+                # Degraded mode: an EMPTY membership computed while the
+                # head is unreachable reflects control-plane blindness,
+                # not replica death — keep dispatching on the cached
+                # handles (replica calls go direct to node agents) for
+                # the grace window. Past it, accept the empty set and
+                # shed with typed errors.
+                outage = _head_outage_s()
+                if 0.0 < outage <= float(cfg.head_outage_grace_s):
+                    logger.warning(
+                        "deployment %r: ignoring empty replica membership "
+                        "during head outage (%.1fs); serving on cached "
+                        "replicas", self.name, outage)
+                    return
             self._replicas = list(replicas)
             # draining replicas keep their ongoing entries: the controller
             # watches them hit zero before killing the actor
@@ -270,7 +297,15 @@ class ReplicaSet:
                 mru.insert(0, ck)
                 del mru[2:]  # at most 2 replicas per model keep affinity
             self._ongoing[self._key(chosen)] += 1
-            return chosen
+        if _head_outage_s() > 0.0:
+            # dispatched on cached membership while the head is down —
+            # the drill's "traffic rode through the outage" evidence
+            _counter(
+                "raytpu_serve_degraded_dispatch_total",
+                "Requests dispatched while the GCS head was unreachable "
+                "(served on cached replica membership).",
+            ).inc()
+        return chosen
 
     def release(self, replica: Any) -> None:
         self.release_key(self._key(replica))

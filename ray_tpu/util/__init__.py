@@ -19,6 +19,7 @@ from .state import (  # noqa: F401
     cluster_metrics,
     get_profile,
     get_trace,
+    head_summary,
     list_actors,
     list_nodes,
     list_objects,

@@ -91,6 +91,7 @@ EVENT_KINDS: Dict[str, str] = {
     "serve.drain": "a serve replica began draining",
     "serve.autoscale": "the serve autoscaler changed a replica target",
     "serve.shed": "admission control shed a request (quota/backlog)",
+    "serve.degraded": "the serve controller froze/resumed over a head outage",
     "serve.lane_preempted": "a low-priority decode lane was parked for pages",
     "serve.lane_resumed": "a parked decode lane re-admitted after pressure",
     # streaming data plane
@@ -108,6 +109,12 @@ EVENT_KINDS: Dict[str, str] = {
     # control plane
     "gcs.restored": "the GCS restored its tables from a snapshot",
     "gcs.subscriber_error": "a pubsub subscriber raised (first failure)",
+    # head fault tolerance
+    "head.unreachable": "the GCS head stopped answering; degraded mode began",
+    "head.reconnected": "the GCS head answered again after an outage",
+    "head.stale_epoch": "a write was fenced for carrying a pre-restart epoch",
+    "head.reconciled": "a restored head finished reconciling restored state",
+    "node.purged": "a restored node never re-announced and was purged",
     "health.dead": "the health-check manager declared a target dead",
     "health.oom": "the OOM policy killed a worker",
     "metrics.sampler_error": "a gauge callback raised (first failure)",

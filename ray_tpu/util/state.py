@@ -110,6 +110,43 @@ def summary() -> Dict[str, Any]:
     }
 
 
+def head_summary() -> Optional[Dict[str, Any]]:
+    """Head fault-tolerance health: cluster epoch, WAL lag/size, last
+    snapshot age, restore/reconcile provenance, plus each node's
+    buffered-federation depth (how many events/reqlog marks are waiting
+    to ship — grows during a head outage, drains after reconnect).
+    None when nothing durability-related is on (no WAL, no cluster)."""
+    runtime = _runtime()
+    ctx = getattr(runtime, "cluster", None)
+    out: Dict[str, Any]
+    if ctx is None or getattr(ctx, "is_head", False):
+        gcs = runtime.gcs
+        out = {
+            "epoch": gcs.current_epoch(),
+            "wal": gcs.wal_stats(),
+            "last_snapshot_ts": gcs.last_snapshot_ts,
+            "restore": dict(gcs.last_restore),
+            "reconcile": dict(getattr(runtime, "_reconcile_state", {})),
+        }
+        if ctx is None and out["wal"] is None and not out["epoch"]:
+            return None  # single-process, no durability armed: stay quiet
+    else:
+        try:
+            out = ctx.gcs.head_info()
+        except (Exception,):  # noqa: BLE001 - degraded mode is a valid answer
+            return {"unreachable_s": round(ctx.gcs.outage_s(), 2)}
+    if ctx is not None:
+        lag = {}
+        for info in ctx.nodes():
+            depth = info.get("federation_lag")
+            if depth:
+                lag[info["node_id"]] = depth
+        if lag:
+            out["federation_lag"] = lag
+        out["head_outage_s"] = round(ctx.gcs.outage_s(), 2)
+    return out
+
+
 def autoscaler_summary() -> Optional[Dict[str, Any]]:
     """status() of the active capacity-plane autoscaler, or None when
     no autoscaler is running in this process."""
@@ -227,6 +264,49 @@ def status_report(verbose: bool = False) -> str:
                         f"{_fmt_bytes(last.get('bytes', 0))}"
                     )
                 lines.append("    " + "; ".join(parts))
+    head = head_summary()
+    if head:
+        lines.append("")
+        if "unreachable_s" in head:
+            lines.append(
+                f"Head: UNREACHABLE for {head['unreachable_s']:.1f}s "
+                f"(degraded mode: buffering federation, cached membership)"
+            )
+        else:
+            wal = head.get("wal") or {}
+            snap_ts = head.get("last_snapshot_ts") or 0.0
+            snap_age = (
+                f"{time.time() - snap_ts:.1f}s ago" if snap_ts else "never"
+            )
+            lines.append(
+                f"Head: epoch {head.get('epoch', 0)}; "
+                f"wal seq={wal.get('last_seq', 0)} "
+                f"size={_fmt_bytes(wal.get('size_bytes', 0))}"
+                + (f" quarantined={_fmt_bytes(wal['quarantined_bytes'])}"
+                   if wal.get("quarantined_bytes") else "")
+                + f"; last snapshot {snap_age}"
+            )
+            restore = head.get("restore") or {}
+            if restore:
+                lines.append(
+                    f"  restored: {restore.get('wal_records_applied', 0)} "
+                    f"WAL record(s) replayed over snapshot "
+                    f"(cutoff seq {restore.get('snapshot_wal_seq', -1)})"
+                )
+            rec = head.get("reconcile") or {}
+            if rec:
+                lines.append(
+                    "  reconcile: " + ", ".join(
+                        f"{k}={v}" for k, v in sorted(rec.items())
+                        if k != "completed_ts"
+                    )
+                )
+            for node_hex, depth in sorted(
+                    (head.get("federation_lag") or {}).items()):
+                lines.append(
+                    f"  node {node_hex[:12]} buffered federation: "
+                    + ", ".join(f"{k}={v}" for k, v in sorted(depth.items()))
+                )
     task_demand = runtime.scheduler.pending_task_demand()
     gang_demand = runtime.scheduler.pending_gang_demand()
     lines.append("")

@@ -466,7 +466,14 @@ class ServeSLOMonitor:
             return
 
         def loop():
+            from ..core.runtime import head_outage_s
+
             while not self._stop.wait(period):
+                if head_outage_s() > 0.0:
+                    # head outage stalls sample federation: a window's
+                    # p99 computed now would burn SLOs (and drive the
+                    # autoscaler) on missing data, not real latency
+                    continue
                 try:
                     self.check()
                 except Exception:  # noqa: BLE001 - the monitor must not die

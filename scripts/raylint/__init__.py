@@ -15,7 +15,10 @@ comments, committed baseline, text/JSON reporters) carrying:
 - event-kinds: every events.emit call site passes a kind registered in
   the flight-recorder event schema (util/events.py EVENT_KINDS);
 - request-phase: every reqlog.mark call site passes a phase registered
-  in the request-forensics schema (serve/reqlog.py PHASES).
+  in the request-forensics schema (serve/reqlog.py PHASES);
+- gcs-durable-mutations: every durable GCS table write is WAL-journaled
+  (core/gcs.py _journal hook or WAL_EXEMPT_FUNCTIONS; no direct table
+  mutation outside gcs.py).
 
 Run ``python -m scripts.raylint`` from the repo root; see README
 "Static analysis".
@@ -38,5 +41,6 @@ from . import rules_locks  # noqa: F401,E402
 from . import rules_jax  # noqa: F401,E402
 from . import rules_events  # noqa: F401,E402
 from . import rules_requests  # noqa: F401,E402
+from . import rules_gcs  # noqa: F401,E402
 
 DEFAULT_BASELINE = "scripts/raylint/baseline.json"

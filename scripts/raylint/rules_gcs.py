@@ -1,0 +1,178 @@
+"""gcs-durable-mutations: every durable GCS table write is journaled.
+
+The head fault-tolerance contract (core/gcs.py) is that an acknowledged
+write survives a head SIGKILL: the WAL records each mutation of the
+durable tables (``KVStore._data``, ``GlobalControlStore._named_actors``)
+at mutation time, and ``--restore`` replays the journal over the newest
+snapshot. A mutation that bypasses the ``_journal`` hook silently
+narrows that guarantee — the write works until the first head restart,
+then vanishes. This rule holds the write path statically:
+
+- inside ``ray_tpu/core/gcs.py``: any function that mutates a durable
+  table (subscript assign/del, or a mutating method call — pop,
+  setdefault, clear, update, popitem) must also call ``_journal(...)``
+  in its body, or be named in the ``WAL_EXEMPT_FUNCTIONS`` tuple
+  literal (replay/restore internals re-apply already-journaled state;
+  journaling them would double-apply every record on the next restore);
+- outside gcs.py: no reaching into ``._data`` / ``._named_actors`` of a
+  KV/GCS receiver to mutate it — go through ``kv.put``/``kv.delete``/
+  ``register_named_actor``/``unregister_named_actor`` so the journal
+  hook sees the write.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Set, Tuple
+
+from .engine import Finding, Project, Rule, SourceFile, register
+
+GCS_MODULE_REL = "ray_tpu/core/gcs.py"
+
+# attributes that ARE the durable tables
+_DURABLE_ATTRS = {"_data", "_named_actors"}
+# method calls on a table that mutate it
+_MUTATING_METHODS = {"pop", "setdefault", "clear", "update", "popitem"}
+
+
+def exempt_functions(project: Project) -> Set[str]:
+    """The WAL_EXEMPT_FUNCTIONS tuple literal in core/gcs.py."""
+    out: Set[str] = set()
+    sf = project.file(GCS_MODULE_REL)
+    if sf is None:
+        return out
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(isinstance(t, ast.Name) and t.id == "WAL_EXEMPT_FUNCTIONS"
+                   for t in node.targets):
+            continue
+        if isinstance(node.value, (ast.Tuple, ast.List)):
+            for elt in node.value.elts:
+                if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                    out.add(elt.value)
+    return out
+
+
+def _durable_attr(node: ast.AST) -> Optional[ast.Attribute]:
+    """The `<recv>._data` / `<recv>._named_actors` attribute at the root
+    of an expression, unwrapping subscripts (`x._data[k]` -> `x._data`)."""
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    if isinstance(node, ast.Attribute) and node.attr in _DURABLE_ATTRS:
+        return node
+    return None
+
+
+def _mutations(tree: ast.AST) -> Iterable[Tuple[int, ast.Attribute]]:
+    """(lineno, table_attribute) for every durable-table mutation site:
+    subscript assignment, subscript deletion, augmented assignment, and
+    mutating method calls."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for target in node.targets:
+                if isinstance(target, ast.Subscript):
+                    attr = _durable_attr(target)
+                    if attr is not None:
+                        yield node.lineno, attr
+        elif isinstance(node, ast.AugAssign):
+            if isinstance(node.target, ast.Subscript):
+                attr = _durable_attr(node.target)
+                if attr is not None:
+                    yield node.lineno, attr
+        elif isinstance(node, ast.Delete):
+            for target in node.targets:
+                if isinstance(target, ast.Subscript):
+                    attr = _durable_attr(target)
+                    if attr is not None:
+                        yield node.lineno, attr
+        elif isinstance(node, ast.Call):
+            func = node.func
+            if (isinstance(func, ast.Attribute)
+                    and func.attr in _MUTATING_METHODS):
+                attr = _durable_attr(func.value)
+                if attr is not None:
+                    yield node.lineno, attr
+
+
+def _calls_journal(fn: ast.AST) -> bool:
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if isinstance(func, ast.Attribute) and func.attr == "_journal":
+            return True
+        if isinstance(func, ast.Name) and func.id == "_journal":
+            return True
+    return False
+
+
+def _gcs_receiver(attr: ast.Attribute) -> bool:
+    """Whether `<recv>._data` plausibly IS a GCS durable table: the
+    receiver chain mentions the kv store or the gcs itself (`self.kv`,
+    `gcs.kv`, `store._named_actors`, ...). `_named_actors` is specific
+    enough to match on its own; `_data` is a common private name, so
+    require a kv/gcs-ish receiver to avoid claiming unrelated caches."""
+    if attr.attr == "_named_actors":
+        return True
+    names: List[str] = []
+    node: ast.AST = attr.value
+    while isinstance(node, ast.Attribute):
+        names.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        names.append(node.id)
+    return any(n in ("kv", "gcs", "store", "gcs_store") for n in names)
+
+
+def module_findings(sf: SourceFile, exempt: Set[str],
+                    rule_name: str) -> List[Finding]:
+    """gcs.py itself: unjournaled mutating functions."""
+    out: List[Finding] = []
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if node.name in exempt:
+            continue
+        sites = [ln for ln, attr in _mutations(node)]
+        if not sites:
+            continue
+        if _calls_journal(node):
+            continue
+        out.append(Finding(
+            rule_name, sf.rel, sites[0],
+            f"function {node.name!r} mutates a durable GCS table without "
+            f"calling _journal; journal the write or add the function to "
+            f"WAL_EXEMPT_FUNCTIONS with a reason"))
+    return out
+
+
+def external_findings(sf: SourceFile, rule_name: str) -> List[Finding]:
+    """Outside gcs.py: direct durable-table mutations bypass the WAL."""
+    out: List[Finding] = []
+    for lineno, attr in _mutations(sf.tree):
+        if not _gcs_receiver(attr):
+            continue
+        out.append(Finding(
+            rule_name, sf.rel, lineno,
+            f"direct mutation of GCS durable table {attr.attr!r} bypasses "
+            f"the WAL; use kv.put/kv.delete or the named-actor registry "
+            f"so the write is journaled"))
+    return out
+
+
+@register
+class GcsDurableMutationsRule(Rule):
+    name = "gcs-durable-mutations"
+    doc = ("every mutation of the durable GCS tables (KVStore._data, "
+           "named-actor registry) is WAL-journaled: in-module mutators "
+           "call _journal or sit in WAL_EXEMPT_FUNCTIONS; nothing "
+           "outside core/gcs.py touches the tables directly")
+
+    def check(self, project: Project) -> Iterable[Finding]:
+        exempt = exempt_functions(project)
+        for sf in project.files_under("ray_tpu/"):
+            if sf.rel == GCS_MODULE_REL:
+                yield from module_findings(sf, exempt, self.name)
+            else:
+                yield from external_findings(sf, self.name)

@@ -27,7 +27,7 @@ ALL_RULES = {
     "typed-errors", "metrics-names", "atomic-writes", "lazy-jax",
     "kernel-fallbacks", "lock-discipline", "lock-order",
     "blocking-under-lock", "jax-hot-path", "event-kinds",
-    "request-phase",
+    "request-phase", "gcs-durable-mutations",
 }
 
 
@@ -528,6 +528,83 @@ def test_lazy_jax_rule_through_registry(tmp_path):
     assert len(result.findings) == 1
     assert result.findings[0].path == "ray_tpu/util/profiling.py"
     assert "module-level jax import" in result.findings[0].message
+
+
+# ---------------------------------------------------------- gcs-durable-mutations
+
+
+_GCS_FIXTURE_HEADER = """
+    WAL_EXEMPT_FUNCTIONS = ("__init__", "restore", "_apply", "replay_wal")
+
+    class KVStore:
+        def __init__(self):
+            self._data = {}
+            self._journal = None
+"""
+
+
+def test_gcs_durable_mutations_flags_unjournaled_writer(tmp_path):
+    proj = _project(tmp_path, {"ray_tpu/core/gcs.py": _GCS_FIXTURE_HEADER + """
+        def put(self, key, value, namespace="default"):
+            self._data[(namespace, key)] = value
+
+        def delete(self, key, namespace="default"):
+            return self._data.pop((namespace, key), None)
+    """})
+    result = run(proj, rules=["gcs-durable-mutations"])
+    assert {f.line for f in result.findings}, result.findings
+    assert all("_journal" in f.message for f in result.findings)
+    assert len(result.findings) == 2  # put and delete both unjournaled
+
+
+def test_gcs_durable_mutations_journaled_and_exempt_pass(tmp_path):
+    proj = _project(tmp_path, {"ray_tpu/core/gcs.py": _GCS_FIXTURE_HEADER + """
+        def put(self, key, value, namespace="default"):
+            self._data[(namespace, key)] = value
+            if self._journal is not None:
+                self._journal("kv_put", (key, value, namespace))
+
+        def restore(self, payload):
+            for k, v in payload:
+                self._data[k] = v  # replay: exempt by name
+    """})
+    result = run(proj, rules=["gcs-durable-mutations"])
+    assert result.findings == [], [f.message for f in result.findings]
+
+
+def test_gcs_durable_mutations_flags_external_table_reach(tmp_path):
+    proj = _project(tmp_path, {
+        "ray_tpu/core/gcs.py": _GCS_FIXTURE_HEADER,
+        "ray_tpu/core/other.py": """
+            def sneak(runtime, key, value):
+                runtime.gcs.kv._data[("default", key)] = value
+
+            def scrub(gcs, name):
+                gcs._named_actors.pop(("default", name), None)
+
+            def fine(runtime, key, value):
+                runtime.gcs.kv.put(key, value)
+
+            def unrelated(cache, key):
+                cache._data[key] = 1  # not a kv/gcs receiver: no claim
+        """,
+    })
+    result = run(proj, rules=["gcs-durable-mutations"])
+    locs = sorted(f.line for f in result.findings)
+    assert len(result.findings) == 2, [f.message for f in result.findings]
+    assert all("bypasses" in f.message for f in result.findings)
+    assert locs == [3, 6]
+
+
+def test_gcs_durable_mutations_production_write_path_is_journaled():
+    """Production evidence: the REAL core/gcs.py passes the rule — every
+    durable-table mutator journals or is WAL-exempt — and the journal
+    hook + exemption tuple the rule keys on actually exist."""
+    gcs_src = (REPO / "ray_tpu" / "core" / "gcs.py").read_text()
+    assert "WAL_EXEMPT_FUNCTIONS" in gcs_src
+    assert "_journal" in gcs_src
+    result = run(Project(REPO), rules=["gcs-durable-mutations"])
+    assert result.findings == [], [f.location for f in result.findings]
 
 
 # ------------------------------------------------------------------ tier-1 gate
