@@ -200,6 +200,64 @@ def _goodput_block(acct) -> dict:
     }
 
 
+def step_forensics_overhead_bench() -> dict:
+    """Recorder overhead A/B (the train-side mirror of bench_serve's
+    forensics bench): the SAME LMTrainer loop on the tiny model with the
+    step-phase recorder off, then on at the default sampling rate.
+    Emits the tokens/s ratio — the acceptance bar is >= 0.98, i.e. the
+    sampled `block_until_ready` syncs plus the mark ring cost under 2%
+    of throughput."""
+    import numpy as np
+
+    from ray_tpu.core.config import cfg
+    from ray_tpu.models import get_config
+    from ray_tpu.train import steplog
+    from ray_tpu.train.trainer import LMTrainer
+
+    n_steps = 64
+    b, s = 8, 128
+    config = get_config("gpt2-tiny")
+    trainer = LMTrainer(config, learning_rate=1e-3, total_steps=4 + 2 * n_steps)
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, config.vocab_size, size=(b, s + 1), dtype=np.int32)
+
+    def run(tag: str) -> float:
+        t0 = time.perf_counter()
+        trainer.train(({"tokens": tokens} for _ in range(n_steps)),
+                      num_steps=n_steps, report_every=n_steps,
+                      run_name=f"bench-forensics-{tag}")
+        jax.block_until_ready(trainer.state)
+        return n_steps * b * s / (time.perf_counter() - t0)
+
+    # warm the step compile AND the report path's cost-analysis cache so
+    # both timed sides pay neither
+    trainer.train(({"tokens": tokens} for _ in range(4)), num_steps=4,
+                  report_every=2, run_name="bench-forensics-warmup")
+    steplog.log().clear()
+    cfg.set(train_step_log=False)
+    try:
+        off_tps = run("off")
+        cfg.set(train_step_log=True)  # default sampling rate
+        sample_every = cfg.step_log_sample_every
+        on_tps = run("on")
+        stats = steplog.log().stats()
+    finally:
+        cfg.reset()
+    ratio = on_tps / off_tps
+    return {
+        "metric": "train_step_forensics_tokens_per_s_ratio",
+        "value": round(ratio, 4),
+        "unit": "ratio",
+        "within_2pct": ratio >= 0.98,
+        "tokens_per_s_recorder_off": round(off_tps, 1),
+        "tokens_per_s_recorder_on": round(on_tps, 1),
+        "sample_every": sample_every,
+        "steps_per_side": n_steps,
+        "marks_recorded": stats["buffered_marks"],
+        "steps_indexed": stats["indexed_steps"],
+    }
+
+
 def main() -> None:
     from ray_tpu.models import count_params, get_config
     from ray_tpu.parallel import MeshSpec, build_mesh
@@ -299,6 +357,12 @@ def main() -> None:
         goodput = _goodput_block(acct)
     except Exception:  # noqa: BLE001 - the headline number must still print
         goodput = {}
+    try:
+        # training-forensics rider: the recorder-overhead A/B tracked
+        # every round next to the headline number
+        step_forensics = step_forensics_overhead_bench()
+    except Exception as exc:  # noqa: BLE001 - headline must still print
+        step_forensics = {"error": repr(exc)}
     print(
         json.dumps(
             {
@@ -314,6 +378,7 @@ def main() -> None:
                 "seq": SEQ,
                 "profiling": profiling_block,
                 "goodput": goodput,
+                "step_forensics": step_forensics,
                 "telemetry": telemetry,
                 **ring,
                 **attn,
@@ -324,4 +389,10 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    import sys
+
+    if "--step-forensics-overhead" in sys.argv[1:]:
+        # standalone recorder A/B (one BENCH JSON line), CPU-runnable
+        print(json.dumps(step_forensics_overhead_bench()))
+    else:
+        main()

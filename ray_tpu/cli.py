@@ -259,6 +259,44 @@ def _cmd_request(args) -> int:
         ray_tpu.shutdown()
 
 
+def _cmd_steps(args) -> int:
+    """Training forensics: `ray_tpu steps <run>` renders the per-rank
+    step-phase waterfall of one run's sampled steps (buckets sum to step
+    wall time, skew footers name the straggler rank and its dominant
+    bucket); `ray_tpu steps --list` prints the cluster-wide sampled-step
+    table."""
+    import ray_tpu
+    from .train import steplog
+    from .util import state
+
+    _observer_init(args)
+    time.sleep(1.0)  # let the federated _steps table populate
+    try:
+        if args.list or not args.run:
+            rows = state.list_steps(run=args.run, limit=args.limit)
+            if not rows:
+                print("(no sampled steps recorded)")
+                return 0
+            print(f"{'run':<18} {'step':>7} {'rank':>4} {'wall_s':>9} "
+                  f"dominant_bucket")
+            for s in rows:
+                buckets = s.get("buckets") or {}
+                top = max(buckets, key=buckets.get) if buckets else "-"
+                wall = s.get("wall_s")
+                wall_txt = f"{wall:.4f}" if wall is not None else "-"
+                print(f"{str(s.get('run', '-')):<18} "
+                      f"{s.get('step', 0):>7} "
+                      f"{s.get('rank', 0):>4} "
+                      f"{wall_txt:>9} "
+                      f"{top}")
+            return 0
+        summaries = state.step_timeline(args.run, rank=args.rank)
+        print(steplog.render_waterfall(summaries))
+        return 0 if summaries else 1
+    finally:
+        ray_tpu.shutdown()
+
+
 def _cmd_postmortem(args) -> int:
     """Snapshot events + spans + metrics + node stats + profile metas
     into one bundle archive with a reconstructed Perfetto episode
@@ -502,6 +540,22 @@ def build_parser() -> argparse.ArgumentParser:
     rq.add_argument("--address", help="head GCS address to join as observer")
     rq.add_argument("--token", default=None)
 
+    st = sub.add_parser(
+        "steps",
+        help="training forensics: per-rank step waterfall or step list",
+    )
+    st.add_argument("run", nargs="?", default=None,
+                    help="run name to render (RunConfig.name); omit with "
+                         "--list")
+    st.add_argument("--list", action="store_true",
+                    help="list sampled-step summaries instead of one run's "
+                         "waterfall")
+    st.add_argument("--rank", type=int, default=None,
+                    help="only this world rank's steps")
+    st.add_argument("--limit", type=int, default=50)
+    st.add_argument("--address", help="head GCS address to join as observer")
+    st.add_argument("--token", default=None)
+
     pm = sub.add_parser(
         "postmortem", help="snapshot a causal postmortem bundle (.tgz)"
     )
@@ -560,6 +614,7 @@ def main(argv=None) -> int:
         "logs": _cmd_logs,
         "events": _cmd_events,
         "request": _cmd_request,
+        "steps": _cmd_steps,
         "postmortem": _cmd_postmortem,
         "timeline": _cmd_timeline,
         "profile": _cmd_profile,

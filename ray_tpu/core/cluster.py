@@ -76,7 +76,7 @@ from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
 from .exceptions import ActorDiedError
-from .gcs import EVENT_NS, PREEMPT_CHANNEL, REQLOG_NS
+from .gcs import EVENT_NS, PREEMPT_CHANNEL, REQLOG_NS, STEPLOG_NS
 from .gcs_service import PG_NS, GcsClient
 from .ids import ActorID, NodeID, ObjectID
 from .object_transfer import ObjectTransferServer, fetch_object, push_object
@@ -91,6 +91,18 @@ from .scheduler import (
 from .worker_pool import WorkerCrashedError
 
 logger = logging.getLogger(__name__)
+
+
+def _loaded_steplog():
+    """The training-forensics recorder IFF the train package is already
+    loaded in this process. A process that never imported the train
+    stack has no step marks to federate, and importing
+    `ray_tpu.train.steplog` here would execute the train package init
+    (jax/flax/optax) inside a lightweight cluster agent's stats thread
+    — seconds of import stalling the very loop the head heartbeats on."""
+    import sys
+
+    return sys.modules.get("ray_tpu.train.steplog")
 
 PROTO_NS = "_protocol"   # GCS KV: "version" -> wire-protocol generation
 NODE_NS = "_nodes"       # GCS KV: node_id hex -> node info dict
@@ -574,6 +586,9 @@ class ClusterContext:
         # request-forensics cursor: last local reqlog mark seq shipped
         # into the GCS _requests table (watch-loop thread only)
         self._reqlog_cursor = 0
+        # training-forensics cursor: last local steplog mark seq shipped
+        # into the GCS _steps table (watch-loop thread only)
+        self._steplog_cursor = 0
         # head fault tolerance: after the head reconnects (possibly a
         # RESTARTED process whose liveness views start empty), suppress
         # death-by-absence declarations until this monotonic deadline —
@@ -739,13 +754,14 @@ class ClusterContext:
         self.gcs.kv_put(self.node_id.hex(), info, namespace=NODE_NS)
         self._federate_events()
         self._federate_requests()
+        self._federate_steps()
 
     def _federation_lag(self) -> Dict[str, int]:
-        """How many local flight-recorder events / reqlog marks have not
-        yet shipped to the head. Grows for the duration of a head outage
-        (the cursors only advance after a successful put) and drains to
-        ~0 after reconnect — `ray_tpu status` surfaces it per node as the
-        buffered-federation depth."""
+        """How many local flight-recorder events / reqlog marks / steplog
+        marks have not yet shipped to the head. Grows for the duration of
+        a head outage (the cursors only advance after a successful put)
+        and drains to ~0 after reconnect — `ray_tpu status` surfaces it
+        per node as the buffered-federation depth."""
         from ..serve import reqlog
         from ..util.events import events
 
@@ -753,6 +769,10 @@ class ClusterContext:
         if reqlog.enabled():
             lag["requests"] = max(
                 0, reqlog.log().stats()["seq"] - self._reqlog_cursor)
+        steplog = _loaded_steplog()
+        if steplog is not None and steplog.enabled():
+            lag["steps"] = max(
+                0, steplog.log().stats()["seq"] - self._steplog_cursor)
         return lag
 
     def _federate_events(self) -> None:
@@ -814,6 +834,37 @@ class ClusterContext:
                 del tail[: len(tail) - cap]
             self.gcs.kv_put(my_hex, tail, namespace=REQLOG_NS)
         self._reqlog_cursor = batch[-1]["seq"]
+
+    def _federate_steps(self) -> None:
+        """Ship this node's new training-forensics step marks into the
+        GCS `_steps` table (same single-writer key + oldest-first cursor
+        walk as the flight recorder), so the head can answer
+        `state.step_timeline(run)` across every rank of a multihost gang
+        and the skew matrix can compare hosts that never share a
+        process."""
+        from .config import cfg
+
+        steplog = _loaded_steplog()
+        if steplog is None or not steplog.enabled():
+            return
+        batch = steplog.log().since(self._steplog_cursor,
+                                    max_n=cfg.steplog_federate_batch)
+        if not batch:
+            return
+        my_hex = self.node_id.hex()
+        tail = self.gcs.kv_get(my_hex, namespace=STEPLOG_NS) or []
+        # same reconnect-flush dedup as _federate_events
+        shipped = {m.get("seq") for m in tail}
+        fresh = [m for m in batch if m["seq"] not in shipped]
+        if fresh:
+            tail.extend(
+                m if m.get("node") else dict(m, node=my_hex) for m in fresh
+            )
+            cap = cfg.steplog_table_cap
+            if len(tail) > cap:
+                del tail[: len(tail) - cap]
+            self.gcs.kv_put(my_hex, tail, namespace=STEPLOG_NS)
+        self._steplog_cursor = batch[-1]["seq"]
 
     def _watch_loop(self) -> None:
         from .config import cfg

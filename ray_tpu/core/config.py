@@ -281,6 +281,34 @@ define_flag("reqlog_table_cap", 2000,
             "Per-node cap on request marks retained in the GCS "
             "_requests table (the cluster-wide queryable tail).")
 
+# training forensics plane (train/steplog.py)
+define_flag("train_step_log", True,
+            "Record per-rank typed step phase marks on sampled training "
+            "steps (train/steplog.py): the ledger behind "
+            "state.step_timeline / `ray_tpu steps` / dashboard "
+            "/api/steps (False = mark() is a no-op).")
+define_flag("step_log_sample_every", 32,
+            "Sample every Nth training step for the step-phase "
+            "decomposition; only sampled steps pay a block_until_ready, "
+            "every other step stays fully async (0 = never sample).")
+define_flag("train_step_log_marks", 4096,
+            "Per-process ring capacity for step phase marks; the "
+            "oldest mark is evicted first.")
+define_flag("train_step_log_steps", 1024,
+            "Per-process cap on step SUMMARIES the recorder indexes "
+            "(oldest sampled step evicted first).")
+define_flag("steplog_federate_batch", 256,
+            "Max step marks a node ships into the GCS _steps table "
+            "per stats-piggyback period (cursor walk, never skips).")
+define_flag("steplog_table_cap", 2000,
+            "Per-node cap on step marks retained in the GCS _steps "
+            "table (the cluster-wide queryable tail).")
+define_flag("steplog_dp_bandwidth_gbs", 100.0,
+            "Assumed interconnect bandwidth (GB/s) used to ESTIMATE "
+            "the dp_sync share of device step time on sampled steps "
+            "(the gradient sync is fused into the XLA step program and "
+            "cannot be host-timed separately).")
+
 # flight recorder (durable events + federation + goodput accounting)
 define_flag("events_dir", "",
             "Directory for durable per-node event-log segments; each "

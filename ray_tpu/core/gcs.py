@@ -64,6 +64,14 @@ EVENT_NS = "_events"
 # one waterfall.
 REQLOG_NS = "_requests"
 
+# GCS KV namespace for the federated training-forensics table:
+# node_hex -> bounded list of that node's recent step phase marks
+# (train/steplog.py), shipped on the same stats-piggyback path as
+# EVENT_NS. `state.step_timeline()` / `state.list_steps()` merge it
+# with the local ring so a gang's cross-rank sampled steps stitch into
+# one skew-attributed waterfall.
+STEPLOG_NS = "_steps"
+
 # GCS KV namespace for head-identity state. The cluster EPOCH lives
 # here as an ordinary KV value so the standard snapshot+WAL path makes
 # it durable: a restarted head restores it, bumps it, and the bump is

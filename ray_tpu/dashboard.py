@@ -307,6 +307,25 @@ class _Handler(BaseHTTPRequestHandler):
                 "decomposition": reqlog.decompose(marks),
                 "waterfall": reqlog.render_waterfall(marks),
             }
+        if name == "steps":
+            # training-forensics sampled-step summaries (?run=&limit=),
+            # or with ?run= plus ?waterfall=1 the run's rendered
+            # per-rank waterfall + skew matrix (`ray_tpu steps <run>`)
+            run = query.get("run")
+            if run and query.get("waterfall", "0") in ("1", "true"):
+                from .train import steplog
+
+                summaries = state.step_timeline(run)
+                return {
+                    "run": run,
+                    "steps": summaries,
+                    "skew": steplog.skew_matrix(summaries),
+                    "waterfall": steplog.render_waterfall(summaries),
+                }
+            return state.list_steps(
+                run=run,
+                limit=int(query.get("limit", 200)),
+            )
         if name == "engines":
             # live engine introspection: lane table, page pool, prefix
             # cache chains, fair-queue depths (this process's engines)

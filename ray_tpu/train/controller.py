@@ -479,13 +479,28 @@ class TrainController:
             for i, p in enumerate(polls):
                 for metrics, ckpt_step, rank, ts in p["reports"]:
                     cursors[i] += 1
-                    self.stall_watchdog.observe_report(rank, ts)
+                    # RESERVED metrics keys from the trainer: the
+                    # worker's monotonic clock (_mono, the wall-skew-
+                    # proof watchdog feed) and its sampled-step records
+                    # (_steplog) — popped before any metric publication
+                    mono = None
+                    step_records = None
+                    if isinstance(metrics, dict):
+                        mono = metrics.pop("_mono", None)
+                        step_records = metrics.pop("_steplog", None)
+                    self.stall_watchdog.observe_report(rank, ts, mono=mono)
+                    if step_records:
+                        self._observe_step_records(step_records)
                     if not self._attempt_reported:
                         # first report of the attempt: bring-up is over
                         # (unless a preemption window is already open)
                         self._attempt_reported = True
                         if notice is None:
                             self.goodput.begin("step_compute")
+                    if isinstance(metrics, dict) and not metrics:
+                        # a reserved-keys-only report (trailing steplog
+                        # flush): control-plane only, nothing to publish
+                        continue
                     if rank == 0:
                         self.metrics_history.append(metrics)
                         self.goodput.observe_report_metrics(metrics)
@@ -597,6 +612,51 @@ class TrainController:
                 tag_keys=("run", "resource"),
             ).set(float(metrics["roofline_hbm"]),
                   tags={**tags, "resource": "hbm"})
+
+    def _observe_step_records(self, records: Any) -> None:
+        """Fan a worker's sampled step-phase records (the _steplog
+        payload riding the report plane) into every consumer at once:
+        the controller-side steplog ring (for state.step_timeline /
+        skew_matrix / federation), the stall watchdog's per-rank bucket
+        ledger (so a stall warning can name the straggler's dominant
+        bucket), and the raytpu_train_step_seconds{run,bucket}
+        histograms. Forensics must never kill a training run, so the
+        whole fan-out is best-effort."""
+        if not isinstance(records, (list, tuple)):
+            return
+        try:
+            from ..util.metrics import (
+                STEP_SECONDS_BOUNDARIES, get_or_create_histogram,
+            )
+            from . import steplog
+
+            hist = get_or_create_histogram(
+                "raytpu_train_step_seconds",
+                "Per-phase wall seconds of sampled train steps "
+                "(train/steplog decomposition; buckets sum to step "
+                "wall time).",
+                boundaries=STEP_SECONDS_BOUNDARIES,
+                tag_keys=("run", "bucket"),
+            )
+            clean = [r for r in records if isinstance(r, dict)]
+            # re-ring on the controller node: in-process gangs share the
+            # singleton with their trainer, so ingest() dedups by
+            # (run, rank, step, phase) and only fresh records re-record
+            steplog.log().ingest(clean)
+            for rec in clean:
+                buckets = rec.get("buckets")
+                rank = rec.get("rank")
+                if not isinstance(buckets, dict):
+                    continue
+                if isinstance(rank, int):
+                    self.stall_watchdog.observe_step_buckets(rank, buckets)
+                run = str(rec.get("run", self.run_config.name))
+                for phase, dur in buckets.items():
+                    if isinstance(dur, (int, float)):
+                        hist.observe(dur, tags={"run": run,
+                                                "bucket": str(phase)})
+        except Exception:  # noqa: BLE001 - forensics must not kill training
+            pass
 
     def _got_emergency_ckpt(self, baseline: Optional[int]) -> bool:
         """A checkpoint newer than the pre-notice state has landed."""

@@ -391,11 +391,15 @@ def make_train_step(
             metric_sharding=metric_sharding,
         )
 
+    # named_scope labels match the train/steplog STEP_PHASES so device
+    # traces (`ray_tpu profile`) line up with the step-phase waterfall
     def step_fn(state: TrainState, batch: Dict[str, jax.Array]):
         tokens = batch["tokens"]
-        loss, ntok, grads = microbatch_grads(state.params, tokens)
-        updates, new_opt = optimizer.update(grads, state.opt_state, state.params)
-        new_params = optax.apply_updates(state.params, updates)
+        with jax.named_scope("steplog.fwd_bwd_compute"):
+            loss, ntok, grads = microbatch_grads(state.params, tokens)
+        with jax.named_scope("steplog.optimizer_update"):
+            updates, new_opt = optimizer.update(grads, state.opt_state, state.params)
+            new_params = optax.apply_updates(state.params, updates)
         gnorm = optax.global_norm(grads)
         new_state = TrainState(
             step=state.step + 1,
@@ -458,9 +462,13 @@ def _make_explicit_dp_step(
         k: PartitionSpec() for k in ("loss", "grad_norm", "num_tokens")
     }
 
+    # named_scope labels match the train/steplog STEP_PHASES so device
+    # traces line up with the step-phase waterfall (the host can only
+    # ESTIMATE dp_sync; the trace scope is where the truth lives)
     def local_step(state: TrainState, batch: Dict[str, jax.Array]):
         tokens = batch["tokens"]
-        loss, ntok, grads = microbatch_grads(state.params, tokens)
+        with jax.named_scope("steplog.fwd_bwd_compute"):
+            loss, ntok, grads = microbatch_grads(state.params, tokens)
         grows = jax.tree.map(lambda g: _to_rows(g, n, dp_quant_block), grads)
         if quantized:
             if state.ef is None:
@@ -474,12 +482,13 @@ def _make_explicit_dp_step(
 
         if dp_shard_update:
             if quantized:
-                synced = jax.tree.map(
-                    lambda r: quantized_psum_scatter_rows(
-                        r, axis, block=dp_quant_block
-                    ),
-                    grows,
-                )
+                with jax.named_scope("steplog.dp_sync"):
+                    synced = jax.tree.map(
+                        lambda r: quantized_psum_scatter_rows(
+                            r, axis, block=dp_quant_block
+                        ),
+                        grows,
+                    )
                 own = jax.tree.map(lambda se: se[0] / n, synced,
                                    is_leaf=lambda x: isinstance(x, tuple))
                 new_ef = jax.tree.map(lambda se: se[1][None], synced,
@@ -520,10 +529,11 @@ def _make_explicit_dp_step(
                 new_opt_local,
             )
         else:
-            synced = jax.tree.map(
-                lambda r: quantized_psum_rows(r, axis, block=dp_quant_block),
-                grows,
-            )
+            with jax.named_scope("steplog.dp_sync"):
+                synced = jax.tree.map(
+                    lambda r: quantized_psum_rows(r, axis, block=dp_quant_block),
+                    grows,
+                )
             new_ef = jax.tree.map(lambda se: se[1][None], synced,
                                   is_leaf=lambda x: isinstance(x, tuple))
             g_sync = jax.tree.map(

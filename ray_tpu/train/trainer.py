@@ -128,6 +128,13 @@ class LMTrainer:
         ) if explicit_dp else (
             dp_sync_bytes(count_params(self.state.params), n_dp)
         )
+        # per-step dp_sync ESTIMATE for the step-phase decomposition
+        # (train/steplog): wire bytes over the assumed interconnect
+        # bandwidth — 0 on a single replica, where nothing syncs
+        self._dp_sync_est_s = (
+            self.dp_sync_bytes / (cfg.steplog_dp_bandwidth_gbs * 1e9)
+            if n_dp > 1 else 0.0
+        )
         # cost_analysis() of the compiled step (util/profiling), computed
         # once the first time a report needs it (one extra AOT compile;
         # disable with profile_cost_accounting=False)
@@ -164,17 +171,28 @@ class LMTrainer:
         num_steps: Optional[int] = None,
         report_every: int = 10,
         report_fn: Optional[Callable[[Dict[str, Any]], None]] = None,
+        run_name: Optional[str] = None,
     ) -> Dict[str, Any]:
         """Drive the step over a batch iterator. Returns final metrics incl.
         tokens/sec. `report_fn` defaults to session.report when inside a
-        worker, else a no-op."""
-        if report_fn is None:
-            from .session import _local
+        worker, else a no-op. `run_name` keys the step-forensics records
+        (default: the session's run name, else "local")."""
+        from . import steplog
+        from .session import _local
 
-            session = getattr(_local, "session", None)
+        session = getattr(_local, "session", None)
+        if report_fn is None:
             report_fn = session.report if session is not None else (lambda m: None)
+        if run_name is None:
+            run_name = session.context.run_name if session is not None else "local"
+        rank = session.context.world_rank if session is not None else 0
 
         ckpt_every = self.ckpt_config.checkpoint_every if self.ckpt_config else 0
+        # step forensics (train/steplog): every sample_every-th step is
+        # decomposed into typed phase buckets. ONLY sampled steps sync
+        # (block_until_ready); the rest keep jax async dispatch rolling.
+        sample_every = steplog.sample_every() if steplog.enabled() else 0
+        pending_steps: list = []
         t0 = time.perf_counter()
         tokens_done = 0.0
         last_metrics: Dict[str, Any] = {}
@@ -185,23 +203,36 @@ class LMTrainer:
         # report reaches the controller
         window_input_wait = 0.0
         window_ckpt_save = 0.0
+        window_dp_sync = 0.0
         batch_iter = iter(batches)
         while True:
-            t_in = time.perf_counter()
+            t_step0 = time.perf_counter()
             try:
                 batch = next(batch_iter)  # input pipeline wait happens HERE
             except StopIteration:
                 break
-            window_input_wait += time.perf_counter() - t_in
+            t_data = time.perf_counter()
+            window_input_wait += t_data - t_step0
             if num_steps is not None and steps >= num_steps:
                 break
+            sampled = sample_every > 0 and steps % sample_every == 0
             tokens = batch["tokens"]
             if isinstance(tokens, np.ndarray):
                 batch = {"tokens": jax.numpy.asarray(tokens)}
+            if sampled:
+                # the ONE deliberate sync before dispatch: land the batch
+                # so h2d separates from device compute in the timeline
+                jax.block_until_ready(batch["tokens"])
+            t_h2d = time.perf_counter()
             self.state, metrics = self.step_fn(self.state, batch)
+            if sampled:
+                jax.block_until_ready(self.state)
+            t_dev = time.perf_counter()
             steps += 1
             window_steps += 1
+            window_dp_sync += self._dp_sync_est_s
             tokens_done += float(tokens.shape[0] * (tokens.shape[1] - 1))
+            t_rep0 = time.perf_counter()
             if steps % report_every == 0 or (num_steps is not None and steps == num_steps):
                 metrics = {k: float(v) for k, v in metrics.items()}
                 now = time.perf_counter()
@@ -210,7 +241,8 @@ class LMTrainer:
                 metrics["step"] = int(self.state.step)
                 metrics["input_wait_s"] = round(window_input_wait, 6)
                 metrics["ckpt_save_s"] = round(window_ckpt_save, 6)
-                window_input_wait = window_ckpt_save = 0.0
+                metrics["dp_sync_s"] = round(window_dp_sync, 6)
+                window_input_wait = window_ckpt_save = window_dp_sync = 0.0
                 # MFU/roofline from the compiled step's cost_analysis()
                 # over this window's measured step time (the first window
                 # absorbs the compile, so its MFU reads low)
@@ -219,15 +251,86 @@ class LMTrainer:
                 ))
                 window_t0, window_steps = now, 0
                 last_metrics = metrics
-                report_fn(metrics)
+                # sampled-step records + the worker's monotonic clock
+                # ride the report on RESERVED keys (popped controller-
+                # side before any metric publication)
+                payload = dict(metrics)
+                payload["_mono"] = time.perf_counter()
+                if pending_steps:
+                    payload["_steplog"] = pending_steps
+                    pending_steps = []
+                report_fn(payload)
+            t_rep1 = time.perf_counter()
+            ckpt_dur = 0.0
             if ckpt_every and steps % ckpt_every == 0 and self.ckpt_mgr is not None:
                 t_ck = time.perf_counter()
                 self.save_checkpoint()
-                window_ckpt_save += time.perf_counter() - t_ck
+                ckpt_dur = time.perf_counter() - t_ck
+                window_ckpt_save += ckpt_dur
+            if sampled:
+                pending_steps.append(self._mark_sampled_step(
+                    run_name, rank, int(self.state.step),
+                    data_wait=t_data - t_step0,
+                    h2d=t_h2d - t_data,
+                    device=t_dev - t_h2d,
+                    report=t_rep1 - t_rep0,
+                    ckpt=ckpt_dur,
+                    wall=time.perf_counter() - t_step0,
+                ))
+                del pending_steps[:-64]  # bounded if reports never drain
+        if pending_steps and session is not None:
+            # trailing sampled steps with no report behind them: ship a
+            # reserved-keys-only report (the controller drops it from
+            # metric publication after popping the steplog payload)
+            report_fn({"_steplog": pending_steps,
+                       "_mono": time.perf_counter()})
         if self.ckpt_mgr is not None and self.ckpt_config.checkpoint_every:
             self.save_checkpoint()
             self.ckpt_mgr.wait_until_finished()
         return last_metrics
+
+    def _mark_sampled_step(self, run: str, rank: int, step: int, *,
+                           data_wait: float, h2d: float, device: float,
+                           report: float, ckpt: float,
+                           wall: float) -> Dict[str, Any]:
+        """Decompose one SAMPLED step into the typed steplog buckets.
+
+        The fused XLA program is one opaque device interval: dp_sync is
+        the wire-byte ESTIMATE (cfg.steplog_dp_bandwidth_gbs; exactly 0
+        on one replica), fwd_bwd_compute the device remainder, and
+        optimizer_update stays 0 (fused into the step program). `other`
+        is wall minus every measured bucket, so the recorded buckets sum
+        EXACTLY to wall_s — the invariant the tests enforce."""
+        from . import steplog
+
+        dp_sync = min(self._dp_sync_est_s, device)
+        fwd_bwd = device - dp_sync
+        measured = data_wait + h2d + device + report + ckpt
+        other = wall - measured
+        if other < 0.0:  # clock jitter: wall is then the measured sum
+            other, wall = 0.0, measured
+        steplog.mark("data_wait", data_wait, run=run, rank=rank, step=step)
+        steplog.mark("h2d", h2d, run=run, rank=rank, step=step)
+        steplog.mark("fwd_bwd_compute", fwd_bwd, run=run, rank=rank,
+                     step=step)
+        steplog.mark("dp_sync", dp_sync, run=run, rank=rank, step=step,
+                     estimated=True)
+        steplog.mark("optimizer_update", 0.0, run=run, rank=rank, step=step)
+        steplog.mark("ckpt_save", ckpt, run=run, rank=rank, step=step)
+        steplog.mark("report", report, run=run, rank=rank, step=step)
+        steplog.mark("other", other, run=run, rank=rank, step=step,
+                     wall_s=wall)
+        return {
+            "run": run, "rank": rank, "step": step,
+            "node": steplog._default_node(), "ts": time.time(),
+            "wall_s": wall,
+            "buckets": {
+                "data_wait": data_wait, "h2d": h2d,
+                "fwd_bwd_compute": fwd_bwd, "dp_sync": dp_sync,
+                "optimizer_update": 0.0, "ckpt_save": ckpt,
+                "report": report, "other": other,
+            },
+        }
 
     def step_cost(self, batch: Dict[str, Any]):
         """cost_analysis() of the compiled train step at this batch's

@@ -121,6 +121,10 @@ class WorkerGroup:
         # updated by poll() — the stall watchdog's straggler ranking and
         # `ray_tpu status` read gang progress from here
         self.last_report_ts: List[float] = [0.0] * num_workers
+        # telemetry: sampled step-phase records (train/steplog) each
+        # worker has shipped on the report plane — a zero here with
+        # cfg.train_step_log on means that rank's forensics are dark
+        self.steplog_records: List[int] = [0] * num_workers
 
     def start(self) -> None:
         if self.pg is None:
@@ -188,12 +192,21 @@ class WorkerGroup:
             for _metrics, _ckpt, _rank, ts in p.get("reports", ()):
                 if i < len(self.last_report_ts):
                     self.last_report_ts[i] = max(self.last_report_ts[i], ts)
+                if isinstance(_metrics, dict) and i < len(self.steplog_records):
+                    recs = _metrics.get("_steplog")
+                    if isinstance(recs, (list, tuple)):
+                        self.steplog_records[i] += len(recs)
         return polls
 
     def step_timestamps(self) -> List[float]:
         """Per-worker newest report wall timestamps (0.0 = no report
         yet) — gang progress for straggler ranking."""
         return list(self.last_report_ts)
+
+    def steplog_record_counts(self) -> List[int]:
+        """Per-worker sampled step-phase records shipped so far (the
+        train/steplog forensics feed riding the report plane)."""
+        return list(self.steplog_records)
 
     def finish(self, result_refs, timeout=None):
         """Block for the run() results, raising any worker exception."""

@@ -11,6 +11,9 @@ partition over named buckets:
 - ``compile``       explicitly-reported XLA compile time (split out of
                     init when the trainer reports ``compile_s``)
 - ``step_compute``  productive training steps — the GOODPUT
+- ``dp_sync``       data-parallel gradient sync share of the step
+                    windows (reported ``dp_sync_s``, the train/steplog
+                    wire-byte estimate)
 - ``input_wait``    host input pipeline stalls (reported ``input_wait_s``)
 - ``ckpt_save``     checkpoint saves, incl. the emergency-save window
                     after a preemption notice
@@ -40,8 +43,8 @@ import time
 from typing import Any, Dict, Optional
 
 BUCKETS = (
-    "init", "compile", "step_compute", "input_wait", "ckpt_save",
-    "ckpt_restore", "preempt_restart", "stall", "other",
+    "init", "compile", "step_compute", "dp_sync", "input_wait",
+    "ckpt_save", "ckpt_restore", "preempt_restart", "stall", "other",
 )
 
 # the productive share — everything else is badput
@@ -184,6 +187,10 @@ class GoodputAccountant:
     _REPORT_TRANSFERS = {
         "input_wait_s": ("step_compute", "input_wait"),
         "ckpt_save_s": ("step_compute", "ckpt_save"),
+        # the steplog-estimated gradient-sync share of the window: sync
+        # seconds stop being silently folded into step_compute (still
+        # summing to wall time — transfer only moves seconds)
+        "dp_sync_s": ("step_compute", "dp_sync"),
         "compile_s": ("init", "compile"),
     }
 

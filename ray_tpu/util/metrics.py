@@ -227,6 +227,15 @@ def get_or_create_gauge(name: str, description: str = "",
     return Gauge(name, description, tag_keys, fn=fn)
 
 
+# Shared boundaries for per-phase step-time histograms
+# (raytpu_train_step_seconds{run,bucket}, train/steplog): phase durations
+# span sub-millisecond host bookkeeping up to multi-second checkpoint
+# saves, so the grid is log-spaced across five decades.
+STEP_SECONDS_BOUNDARIES = (
+    0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+)
+
+
 def get_or_create_histogram(name: str, description: str = "",
                             boundaries: Sequence[float] = (),
                             tag_keys: Sequence[str] = ()) -> Histogram:

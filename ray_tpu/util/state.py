@@ -686,6 +686,92 @@ def list_requests(tenant: Optional[str] = None, slow_only: bool = False,
     return out[-limit:] if limit else out
 
 
+def _federated_step_marks() -> List[Dict[str, Any]]:
+    """Every training-forensics step mark visible from this process: the
+    local steplog ring merged with every node's federated tail in the
+    GCS `_steps` table (core/cluster.py ships them on the same stats
+    piggyback as the flight recorder). Deduped by the SEMANTIC key
+    (run, rank, step, phase) — one sampled step's mark can reach the
+    table both via its worker node's own federation and via the
+    controller's re-ring after ingest — and sorted by wall time."""
+    from ..train import steplog
+
+    def _key(m: Dict[str, Any]) -> Any:
+        return (m.get("run"), m.get("rank"), m.get("step"), m.get("phase"))
+
+    merged: Dict[Any, Dict[str, Any]] = {}
+    for m in steplog.log().since(0, max_n=1_000_000):
+        merged[_key(m)] = m
+    if _rt.is_initialized():
+        from ..core.gcs import STEPLOG_NS
+
+        runtime = _rt.get_runtime()
+        ctx = getattr(runtime, "cluster", None)
+        try:
+            if ctx is not None:
+                for key in ctx.gcs.kv_keys(namespace=STEPLOG_NS):
+                    for m in ctx.gcs.kv_get(key, namespace=STEPLOG_NS) or []:
+                        merged.setdefault(_key(m), m)
+            else:
+                kv = runtime.gcs.kv
+                for key in kv.keys(namespace=STEPLOG_NS):
+                    for m in kv.get(key, namespace=STEPLOG_NS) or []:
+                        merged.setdefault(_key(m), m)
+        except Exception:  # noqa: BLE001 - the local ring still answers
+            pass
+    out = list(merged.values())
+    out.sort(key=lambda m: (m.get("ts", 0.0), m.get("seq", 0)))
+    return out
+
+
+def step_timeline(run: str, rank: Optional[int] = None) -> List[Dict[str, Any]]:
+    """Per-rank step-phase summaries of ONE training run, cluster-wide
+    (sampled steps only), ordered by (step, rank). Each summary's
+    buckets sum to its step wall time exactly — render with
+    `train.steplog.render_waterfall(summaries)`; the CLI command
+    `ray_tpu steps <run>` is a thin wrapper."""
+    from ..train import steplog
+
+    out = [
+        s for s in steplog.summarize_steps(_federated_step_marks())
+        if s.get("run") == run and (rank is None or s.get("rank") == rank)
+    ]
+    out.sort(key=lambda s: (s.get("step", 0), s.get("rank", 0)))
+    return out
+
+
+def list_steps(run: Optional[str] = None,
+               limit: int = 200) -> List[Dict[str, Any]]:
+    """Cluster-wide sampled-step summaries (newest last): run, rank,
+    step, wall seconds, phase buckets. The local summary index survives
+    mark-ring eviction, so it wins over a summary rebuilt from a
+    truncated federated tail."""
+    from ..train import steplog
+
+    merged: Dict[Any, Dict[str, Any]] = {
+        (s.get("run"), s.get("rank"), s.get("step")): s
+        for s in steplog.summarize_steps(_federated_step_marks())
+    }
+    for s in steplog.log().steps(run=run, limit=1_000_000):
+        merged[(s.get("run"), s.get("rank"), s.get("step"))] = s
+    out = list(merged.values())
+    if run is not None:
+        out = [s for s in out if s.get("run") == run]
+    out.sort(key=lambda s: (s.get("ts", 0.0), s.get("step", 0),
+                            s.get("rank", 0)))
+    return out[-limit:] if limit else out
+
+
+def step_skew(run: str) -> List[Dict[str, Any]]:
+    """Cross-rank skew matrix of one run's sampled steps: per step, each
+    rank's wall time and buckets, the spread, the straggler rank, and
+    the phase bucket where that rank lost the time vs its fastest peer
+    (`train.steplog.skew_matrix`)."""
+    from ..train import steplog
+
+    return steplog.skew_matrix(step_timeline(run))
+
+
 def engine_snapshot() -> Dict[str, Any]:
     """Live introspection of every LLM engine in THIS process, keyed by
     engine label: lane table (who holds each lane, position, pages,

@@ -66,21 +66,53 @@ class StallWatchdog:
         self._started = now
         self._lock = threading.Lock()
         self._last_ts: Dict[int, float] = {}   # rank -> last report wall ts
+        # monotonic plumbing (the wall-skew fix): _mono is the WORKER's
+        # perf_counter carried in its report (valid for per-rank
+        # intervals; never comparable across hosts), _rx the controller-
+        # local perf_counter at receipt (the one shared monotonic basis
+        # every rank's lag can be measured on)
+        self._mono: Dict[int, float] = {}
+        self._rx: Dict[int, float] = {}
         self._ewma: Dict[int, float] = {}      # rank -> EWMA step interval
         self._reports: Dict[int, int] = {}
         self._done: set = set()  # finished ranks are not stragglers
+        # rank -> latest sampled-step phase buckets (train/steplog):
+        # lets a stall warning name WHERE the straggler's time goes
+        self._buckets: Dict[int, Dict[str, float]] = {}
         self.stalled = False
         self.stall_reason = ""
         self.straggler: Optional[int] = None
+        self.straggler_bucket: Optional[str] = None
         _stalled_gauge().set(0, tags={"run": run_name})
 
     # ------------------------------------------------------------- feeding
 
-    def observe_report(self, rank: int, ts: Optional[float] = None) -> None:
+    def observe_report(self, rank: int, ts: Optional[float] = None,
+                       mono: Optional[float] = None) -> None:
+        """One drained worker report. `mono` is the WORKER's monotonic
+        clock at report time (reserved metrics key `_mono`): when
+        carried, step intervals and straggler lags run on monotonic
+        clocks, so cross-host wall-clock skew cannot misrank stragglers.
+        Without it (legacy feeds, unit drives) the wall path applies."""
         ts = time.time() if ts is None else float(ts)
+        rx = time.perf_counter()
         with self._lock:
             prev = self._last_ts.get(rank)
-            if prev is not None and ts > prev:
+            prev_mono = self._mono.get(rank)
+            if mono is not None:
+                mono = float(mono)
+                # per-rank interval on the rank's OWN monotonic clock
+                # (a worker restart resets it; negative deltas skipped)
+                if prev_mono is not None and mono > prev_mono:
+                    interval = mono - prev_mono
+                    ewma = self._ewma.get(rank)
+                    self._ewma[rank] = (
+                        interval if ewma is None
+                        else self.alpha * interval + (1 - self.alpha) * ewma
+                    )
+                self._mono[rank] = max(mono, prev_mono or mono)
+                self._rx[rank] = rx
+            elif prev is not None and ts > prev:
                 interval = ts - prev
                 ewma = self._ewma.get(rank)
                 self._ewma[rank] = (
@@ -89,6 +121,47 @@ class StallWatchdog:
                 )
             self._last_ts[rank] = max(ts, prev or 0.0)
             self._reports[rank] = self._reports.get(rank, 0) + 1
+
+    def observe_step_buckets(self, rank: int,
+                             buckets: Optional[Dict[str, Any]]) -> None:
+        """Latest sampled-step phase decomposition of one rank (the
+        `_steplog` records the controller drains): kept so the stall
+        warning names the straggler's dominant bucket, not just the
+        rank."""
+        if not isinstance(buckets, dict):
+            return
+        clean = {
+            str(phase): dur for phase, dur in buckets.items()
+            if isinstance(dur, (int, float))
+        }
+        if clean:
+            with self._lock:
+                self._buckets[rank] = clean
+
+    def dominant_bucket(self, rank: int
+                        ) -> Optional[Tuple[str, float]]:
+        """(phase, excess_s) that best explains this rank's step time
+        vs its peers: the bucket where its latest sampled step exceeds
+        the fastest other rank's the most. With no peer samples it
+        degenerates to the rank's largest bucket. None before any
+        sampled step arrived."""
+        with self._lock:
+            mine = self._buckets.get(rank)
+            others = [
+                dict(b) for r, b in self._buckets.items() if r != rank
+            ]
+        if not mine:
+            return None
+        best: Optional[str] = None
+        best_excess = -math.inf
+        for phase, dur in mine.items():
+            floor = min((o.get(phase, 0.0) for o in others), default=0.0)
+            excess = dur - floor
+            if excess > best_excess:
+                best, best_excess = phase, excess
+        if best is None:
+            return None
+        return best, max(best_excess, 0.0)
 
     def mark_done(self, rank: int) -> None:
         """A worker finished its loop cleanly: silence from it is
@@ -101,15 +174,27 @@ class StallWatchdog:
     def straggler_ranking(self, now: Optional[float] = None
                           ) -> List[Tuple[int, float]]:
         """Ranks ordered most-behind first: (rank, seconds since its
-        last report). Workers that never reported rank by time since
-        watchdog start."""
+        last report). A rank whose reports carry the monotonic clock is
+        measured on the controller's RECEIPT perf_counter — the one
+        monotonic basis every rank shares — so a gang host with a
+        skewed wall clock can no longer be misranked as (or hide as)
+        the straggler. Ranks without monotonic feeds (legacy planes,
+        unit drives) fall back to wall timestamps; workers that never
+        reported rank by time since watchdog start."""
         now = time.time() if now is None else now
+        rx_now = time.perf_counter()
         with self._lock:
-            lags = [
-                (rank, now - self._last_ts.get(rank, self._started))
-                for rank in range(self.num_workers)
-                if rank not in self._done
-            ]
+            lags = []
+            for rank in range(self.num_workers):
+                if rank in self._done:
+                    continue
+                rx = self._rx.get(rank)
+                if rx is not None:
+                    lags.append((rank, rx_now - rx))
+                else:
+                    lags.append(
+                        (rank, now - self._last_ts.get(rank, self._started))
+                    )
         return sorted(lags, key=lambda rl: -rl[1])
 
     def check(self, now: Optional[float] = None) -> bool:
@@ -124,15 +209,22 @@ class StallWatchdog:
             return False
         straggler = ranking[0][0]
         reason = ""
-        # (1) no progress anywhere (among unfinished ranks) in the window
+        # (1) no progress anywhere (among unfinished ranks) in the
+        # window: the SMALLEST per-rank lag (each measured on that
+        # rank's correct clock basis) is how long the gang's freshest
+        # rank has been silent
         with self._lock:
-            newest = max(
-                (ts for r, ts in self._last_ts.items() if r not in self._done),
-                default=self._started,
-            )
-        if now - newest > self.window_s:
+            reported = {
+                r for r, n in self._reports.items()
+                if n and r not in self._done
+            }
+        gang_gap = min(
+            (lag for rank, lag in ranking if rank in reported),
+            default=now - self._started,
+        )
+        if gang_gap > self.window_s:
             reason = (
-                f"no worker reported for {now - newest:.1f}s "
+                f"no worker reported for {gang_gap:.1f}s "
                 f"(window {self.window_s:.1f}s); slowest is rank {straggler}"
             )
         else:
@@ -157,21 +249,32 @@ class StallWatchdog:
 
     def _transition(self, stalled: bool, straggler: Optional[int],
                     reason: str) -> None:
+        dom = (
+            self.dominant_bucket(straggler)
+            if stalled and straggler is not None else None
+        )
         if stalled == self.stalled:
             self.straggler = straggler if stalled else None
+            self.straggler_bucket = dom[0] if dom else None
             self.stall_reason = reason
             return
         self.stalled = stalled
         self.straggler = straggler if stalled else None
+        self.straggler_bucket = dom[0] if dom else None
         self.stall_reason = reason
         _stalled_gauge().set(1.0 if stalled else 0.0,
                              tags={"run": self.run_name})
         if stalled:
+            where = (
+                f", dominant bucket {dom[0]} (+{dom[1]:.3f}s vs fastest "
+                f"peer)" if dom else ""
+            )
             emit("WARNING", "watchdog",
                  f"run {self.run_name} STALLED: {reason} "
-                 f"(straggler rank {straggler})",
+                 f"(straggler rank {straggler}{where})",
                  kind="watchdog.stall",
-                 run=self.run_name, straggler_rank=straggler)
+                 run=self.run_name, straggler_rank=straggler,
+                 dominant_bucket=dom[0] if dom else None)
         else:
             emit("INFO", "watchdog",
                  f"run {self.run_name} recovered from stall",

@@ -16,6 +16,8 @@ comments, committed baseline, text/JSON reporters) carrying:
   the flight-recorder event schema (util/events.py EVENT_KINDS);
 - request-phase: every reqlog.mark call site passes a phase registered
   in the request-forensics schema (serve/reqlog.py PHASES);
+- step-phase: every steplog.mark call site passes a phase registered in
+  the training-forensics schema (train/steplog.py STEP_PHASES);
 - gcs-durable-mutations: every durable GCS table write is WAL-journaled
   (core/gcs.py _journal hook or WAL_EXEMPT_FUNCTIONS; no direct table
   mutation outside gcs.py).
@@ -41,6 +43,7 @@ from . import rules_locks  # noqa: F401,E402
 from . import rules_jax  # noqa: F401,E402
 from . import rules_events  # noqa: F401,E402
 from . import rules_requests  # noqa: F401,E402
+from . import rules_steps  # noqa: F401,E402
 from . import rules_gcs  # noqa: F401,E402
 
 DEFAULT_BASELINE = "scripts/raylint/baseline.json"

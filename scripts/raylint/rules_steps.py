@@ -1,0 +1,156 @@
+"""step-phase: every training-forensics mark names a registered phase.
+
+The training forensics plane (ray_tpu/train/steplog.py) is TYPED the
+same way the request plane is: consumers — the per-rank waterfall, the
+cross-rank skew matrix, the watchdog's dominant-bucket attribution, the
+``raytpu_train_step_seconds`` histograms — key off the ``phase`` field,
+and the exact-sum invariant (buckets sum to step wall time) only holds
+when every mark lands in a known bucket. A typo'd phase silently drops
+out of every downstream view AND skews the ``other`` remainder. This
+rule holds every ``steplog.mark(...)`` / imported ``mark(...)`` /
+``steplog.log().mark(...)`` call site under ``ray_tpu/`` to the
+registry:
+
+- the phase argument (1st positional, or ``phase=``) must be a string
+  literal — dynamic phases defeat static checking;
+- the literal must be registered: a key of the ``STEP_PHASES`` dict
+  literal in train/steplog.py, or the first argument of any
+  ``register_step_phase("...")`` call in the tree.
+
+``ray_tpu/train/steplog.py`` itself is exempt (it defines the plumbing
+that forwards ``phase`` through).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Set
+
+from .engine import Finding, Project, Rule, SourceFile, register
+
+STEPLOG_MODULE_REL = "ray_tpu/train/steplog.py"
+
+
+def registered_step_phases(project: Project) -> Set[str]:
+    """The static phase registry: STEP_PHASES literal keys plus every
+    register_step_phase("...") string-literal call in the tree."""
+    phases: Set[str] = set()
+    steplog_sf = project.file(STEPLOG_MODULE_REL)
+    if steplog_sf is not None:
+        for node in ast.walk(steplog_sf.tree):
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, ast.AnnAssign):  # STEP_PHASES: Dict[...] = {}
+                targets = [node.target]
+            else:
+                continue
+            if (any(isinstance(t, ast.Name) and t.id == "STEP_PHASES"
+                    for t in targets)
+                    and isinstance(node.value, ast.Dict)):
+                for key in node.value.keys:
+                    if isinstance(key, ast.Constant) and isinstance(key.value, str):
+                        phases.add(key.value)
+    for sf in project.files:
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            func = node.func
+            is_registrar = (
+                isinstance(func, ast.Name)
+                and func.id == "register_step_phase"
+            ) or (
+                isinstance(func, ast.Attribute)
+                and func.attr == "register_step_phase"
+            )
+            if (is_registrar
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                phases.add(node.args[0].value)
+    return phases
+
+
+def _steplog_mark_aliases(tree: ast.AST) -> Set[str]:
+    """Local names bound to steplog's mark via `from ... import`."""
+    aliases: Set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ImportFrom):
+            continue
+        module = node.module or ""
+        if not (module == "steplog" or module.endswith(".steplog")
+                or module == "train.steplog"):
+            continue
+        for alias in node.names:
+            if alias.name == "mark":
+                aliases.add(alias.asname or alias.name)
+    return aliases
+
+
+def _is_steplog_receiver(func: ast.AST) -> bool:
+    """True for `steplog.mark` / `<x>.steplog.mark` /
+    `steplog.log().mark` receivers (the module alias and the StepLog
+    singleton reached THROUGH the module — a bare `log()` stays the
+    request plane's receiver, request-phase covers it)."""
+    if isinstance(func, ast.Name) and func.id == "steplog":
+        return True
+    if isinstance(func, ast.Attribute) and func.attr == "steplog":
+        return True
+    # steplog.log().mark — the singleton factory, module-qualified
+    return (isinstance(func, ast.Call)
+            and isinstance(func.func, ast.Attribute)
+            and func.func.attr == "log"
+            and _is_steplog_receiver(func.func.value))
+
+
+def step_mark_call_findings(sf: SourceFile, phases: Set[str],
+                            rule_name: str = "step-phase") -> List[Finding]:
+    aliases = _steplog_mark_aliases(sf.tree)
+    out: List[Finding] = []
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        is_mark = (isinstance(func, ast.Name) and func.id in aliases) or (
+            isinstance(func, ast.Attribute) and func.attr == "mark"
+            and _is_steplog_receiver(func.value)
+        )
+        if not is_mark:
+            continue
+        msg = _check_step_phase_arg(node, phases)
+        if msg is not None:
+            out.append(Finding(rule_name, sf.rel, node.lineno, msg))
+    return out
+
+
+def _check_step_phase_arg(call: ast.Call, phases: Set[str]) -> Optional[str]:
+    phase_kw = next((kw for kw in call.keywords if kw.arg == "phase"), None)
+    if phase_kw is None:
+        # positional phase: mark(phase, dur_s, ...)
+        if call.args:
+            phase_kw = ast.keyword(arg="phase", value=call.args[0])
+        else:
+            return ("steplog.mark without a phase: pass a registered "
+                    "step phase (see STEP_PHASES in train/steplog.py)")
+    if not (isinstance(phase_kw.value, ast.Constant)
+            and isinstance(phase_kw.value.value, str)):
+        return ("steplog.mark phase must be a string literal so the "
+                "registry check stays static")
+    phase = phase_kw.value.value
+    if phase not in phases:
+        return (f"steplog.mark phase={phase!r} is not registered in "
+                f"STEP_PHASES (train/steplog.py) or via "
+                f"register_step_phase")
+    return None
+
+
+@register
+class StepPhaseRule(Rule):
+    name = "step-phase"
+    doc = ("every steplog.mark call site in ray_tpu/ passes a phase "
+           "string literal registered in the step-phase schema")
+
+    def check(self, project: Project) -> Iterable[Finding]:
+        phases = registered_step_phases(project)
+        for sf in project.files_under("ray_tpu/"):
+            if sf.rel == STEPLOG_MODULE_REL:
+                continue  # the plumbing that forwards phase through
+            yield from step_mark_call_findings(sf, phases, self.name)

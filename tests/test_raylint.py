@@ -27,7 +27,7 @@ ALL_RULES = {
     "typed-errors", "metrics-names", "atomic-writes", "lazy-jax",
     "kernel-fallbacks", "lock-discipline", "lock-order",
     "blocking-under-lock", "jax-hot-path", "event-kinds",
-    "request-phase", "gcs-durable-mutations",
+    "request-phase", "step-phase", "gcs-durable-mutations",
 }
 
 
@@ -528,6 +528,94 @@ def test_lazy_jax_rule_through_registry(tmp_path):
     assert len(result.findings) == 1
     assert result.findings[0].path == "ray_tpu/util/profiling.py"
     assert "module-level jax import" in result.findings[0].message
+
+
+# ----------------------------------------------------------------- step-phase
+
+
+_STEPLOG_FIXTURE = """
+    STEP_PHASES = {
+        "data_wait": "input wait",
+        "fwd_bwd_compute": "device compute",
+        "other": "seal",
+    }
+
+    def register_step_phase(phase, doc=""):
+        STEP_PHASES.setdefault(phase, doc)
+
+    def mark(phase, dur_s, **kw):
+        pass
+"""
+
+
+def test_step_phase_flags_unregistered_and_dynamic(tmp_path):
+    proj = _project(tmp_path, {
+        "ray_tpu/train/steplog.py": _STEPLOG_FIXTURE,
+        "ray_tpu/train/loop.py": """
+            from . import steplog
+
+            def f(run, dur, name):
+                steplog.mark("data_wait", dur, run=run, rank=0, step=1)
+                steplog.mark("fwd_bwd", dur, run=run, rank=0, step=1)
+                steplog.mark(name, dur, run=run, rank=0, step=1)
+        """,
+    })
+    result = run(proj, rules=["step-phase"])
+    msgs = [f.message for f in result.findings]
+    assert len(msgs) == 2, msgs
+    assert any("'fwd_bwd' is not registered" in m for m in msgs)
+    assert any("string literal" in m for m in msgs)
+
+
+def test_step_phase_honors_registry_and_aliases(tmp_path):
+    proj = _project(tmp_path, {
+        "ray_tpu/train/steplog.py": _STEPLOG_FIXTURE,
+        "ray_tpu/train/custom.py": """
+            from .steplog import mark, register_step_phase
+
+            register_step_phase("grad_clip", "custom backend phase")
+
+            def f(dur):
+                mark("grad_clip", dur, run="r", rank=0, step=1)
+                mark("other", dur, run="r", rank=0, step=1, wall_s=dur)
+        """,
+        "ray_tpu/train/singleton.py": """
+            from . import steplog
+
+            def g(dur):
+                steplog.log().mark("data_wait", dur, run="r", rank=0, step=1)
+        """,
+    })
+    assert run(proj, rules=["step-phase"]).findings == []
+
+
+def test_step_phase_exempts_steplog_module_and_other_marks(tmp_path):
+    proj = _project(tmp_path, {
+        # steplog.py itself forwards dynamic phases: exempt
+        "ray_tpu/train/steplog.py": _STEPLOG_FIXTURE + """
+    def remark(phase, dur_s):
+        mark(phase, dur_s)
+        """,
+        # an unrelated .mark receiver makes no step-phase claim
+        "ray_tpu/train/spans.py": """
+            def f(tracer, dur):
+                tracer.mark(dur)
+        """,
+    })
+    assert run(proj, rules=["step-phase"]).findings == []
+
+
+def test_step_phase_production_call_sites_are_typed():
+    """Production evidence: the REAL tree passes the rule, the trainer's
+    decomposition marks every registered phase, and the schema the rule
+    keys on exists."""
+    from ray_tpu.train.steplog import STEP_PHASES
+
+    trainer_src = (REPO / "ray_tpu" / "train" / "trainer.py").read_text()
+    for phase in STEP_PHASES:
+        assert f'steplog.mark("{phase}"' in trainer_src, phase
+    result = run(Project(REPO), rules=["step-phase"])
+    assert result.findings == [], [f.location for f in result.findings]
 
 
 # ---------------------------------------------------------- gcs-durable-mutations
